@@ -1,0 +1,18 @@
+"""kyverno-tpu: a TPU-native policy-evaluation framework.
+
+A ground-up re-design of Kyverno's capabilities (reference: the Go
+implementation surveyed in SURVEY.md) for TPU hardware:
+
+- **Host plane** (pure Python): policy model + YAML loading, autogen,
+  JSON context + JMESPath, scalar oracle engine, CLI, report building.
+- **Device plane** (JAX/XLA/Pallas): policies compiled to vectorized
+  clause programs, resources encoded as padded path/value tensors, the
+  policy x resource cross-product evaluated under jit/vmap/pjit over a
+  device mesh.
+
+The scalar engine in `kyverno_tpu.engine` is semantics-complete and is
+the oracle the TPU evaluator in `kyverno_tpu.tpu` is parity-tested
+against.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,63 @@
+"""Kubernetes ``resource.Quantity`` parsing and comparison.
+
+The reference compares quantities in the scalar pattern language
+(pkg/engine/pattern/pattern.go:243 compareQuantity via
+k8s.io/apimachinery ParseQuantity/Cmp). Grammar:
+
+    quantity      = signedNumber suffix?
+    suffix        = binarySI | decimalSI | decimalExponent
+    binarySI      = Ki | Mi | Gi | Ti | Pi | Ei          (2^10k)
+    decimalSI     = n | u | m | "" | k | M | G | T | P | E (10^3k)
+    decimalExponent = (e|E) signedNumber
+
+We parse to an exact ``fractions.Fraction`` so comparisons are exact
+for mixed suffixes (1024Mi == 1Gi, 0.1 < 100m+eps, etc.).
+"""
+
+from __future__ import annotations
+
+import re
+from fractions import Fraction
+from typing import Optional
+
+_QTY_RE = re.compile(
+    r"^([+-]?(?:\d+(?:\.\d*)?|\.\d+))"
+    r"(Ki|Mi|Gi|Ti|Pi|Ei|[eE][+-]?\d+|n|u|m|k|M|G|T|P|E)?$"
+)
+
+_BINARY = {"Ki": 2**10, "Mi": 2**20, "Gi": 2**30, "Ti": 2**40, "Pi": 2**50, "Ei": 2**60}
+_DECIMAL = {
+    "n": Fraction(1, 10**9),
+    "u": Fraction(1, 10**6),
+    "m": Fraction(1, 10**3),
+    "k": 10**3,
+    "M": 10**6,
+    "G": 10**9,
+    "T": 10**12,
+    "P": 10**15,
+    "E": 10**18,
+}
+
+
+def parse_quantity(s: object) -> Optional[Fraction]:
+    """Parse a quantity string to an exact Fraction; None if invalid."""
+    if not isinstance(s, str):
+        return None
+    # no whitespace trimming: apiresource.ParseQuantity rejects it
+    m = _QTY_RE.match(s)
+    if not m:
+        return None
+    num_str, suffix = m.group(1), m.group(2)
+    try:
+        base = Fraction(num_str)
+    except (ValueError, ZeroDivisionError):
+        return None
+    if suffix is None:
+        return base
+    if suffix in _BINARY:
+        return base * _BINARY[suffix]
+    if suffix in _DECIMAL:
+        return base * _DECIMAL[suffix]
+    # decimal exponent: e.g. "12e6"
+    exp = int(suffix[1:])
+    return base * (Fraction(10) ** exp if exp >= 0 else Fraction(1, 10 ** (-exp)))

@@ -1,0 +1,10 @@
+"""Test env setup: force CPU backend with 8 virtual devices so
+multi-chip sharding tests run without TPU hardware. Must run before
+jax initializes its backend, hence at conftest import time."""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

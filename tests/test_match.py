@@ -1,0 +1,248 @@
+"""Match/exclude resolver tests (pkg/engine/utils/match.go semantics)."""
+
+from kyverno_tpu.api.policy import Rule
+from kyverno_tpu.engine.match import (
+    RequestInfo,
+    check_kind,
+    matches_resource_description,
+)
+from kyverno_tpu.utils.kube import parse_kind_selector
+
+
+def pod(name="nginx", ns="default", labels=None, annotations=None):
+    meta = {"name": name, "namespace": ns}
+    if labels:
+        meta["labels"] = labels
+    if annotations:
+        meta["annotations"] = annotations
+    return {"apiVersion": "v1", "kind": "Pod", "metadata": meta}
+
+
+def rule(match=None, exclude=None):
+    return Rule.from_dict({"name": "r", "match": match or {}, "exclude": exclude or {}})
+
+
+class TestParseKindSelector:
+    def test_forms(self):
+        assert parse_kind_selector("Pod") == ("*", "*", "Pod", "")
+        assert parse_kind_selector("v1/Pod") == ("*", "v1", "Pod", "")
+        assert parse_kind_selector("apps/v1/Deployment") == ("apps", "v1", "Deployment", "")
+        assert parse_kind_selector("apps/v1/Deployment/scale") == ("apps", "v1", "Deployment", "scale")
+        assert parse_kind_selector("Pod.status") == ("*", "*", "Pod", "status")
+        assert parse_kind_selector("*/*") == ("*", "*", "*", "*")
+        assert parse_kind_selector("Pod/status") == ("*", "*", "Pod", "status")
+        assert parse_kind_selector("*") == ("*", "*", "*", "")
+
+
+class TestCheckKind:
+    def test_plain(self):
+        assert check_kind(["Pod"], ("", "v1", "Pod"))
+        assert not check_kind(["Pod"], ("apps", "v1", "Deployment"))
+        assert check_kind(["Deployment"], ("apps", "v1", "Deployment"))
+        assert check_kind(["apps/v1/Deployment"], ("apps", "v1", "Deployment"))
+        assert not check_kind(["apps/v2/Deployment"], ("apps", "v1", "Deployment"))
+        assert check_kind(["*"], ("batch", "v1", "Job"))
+
+    def test_subresource(self):
+        assert check_kind(["Pod/status"], ("", "v1", "Pod"), "status")
+        assert not check_kind(["Pod/status"], ("", "v1", "Pod"), "")
+        assert not check_kind(["Pod"], ("", "v1", "Pod"), "status")
+        # ephemeralcontainers backward-compat (match/kind.go)
+        assert check_kind(["Pod"], ("", "v1", "Pod"), "ephemeralcontainers")
+
+
+class TestMatch:
+    def test_kind_match(self):
+        r = rule(match={"resources": {"kinds": ["Pod"]}})
+        assert matches_resource_description(pod(), r) == []
+        dep = {"apiVersion": "apps/v1", "kind": "Deployment", "metadata": {"name": "d"}}
+        assert matches_resource_description(dep, r) != []
+
+    def test_name_wildcard(self):
+        r = rule(match={"resources": {"kinds": ["Pod"], "name": "ngi*"}})
+        assert matches_resource_description(pod("nginx"), r) == []
+        assert matches_resource_description(pod("httpd"), r) != []
+
+    def test_names_list(self):
+        r = rule(match={"resources": {"kinds": ["Pod"], "names": ["a", "ngi*"]}})
+        assert matches_resource_description(pod("nginx"), r) == []
+        assert matches_resource_description(pod("b"), r) != []
+
+    def test_namespaces(self):
+        r = rule(match={"resources": {"kinds": ["Pod"], "namespaces": ["prod-*"]}})
+        assert matches_resource_description(pod(ns="prod-eu"), r) == []
+        assert matches_resource_description(pod(ns="dev"), r) != []
+
+    def test_namespace_resource_uses_name(self):
+        # checkNameSpace (match.go:18): for Namespace kind, the name is used
+        ns_resource = {"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": "prod-eu"}}
+        r = rule(match={"resources": {"kinds": ["Namespace"], "namespaces": ["prod-*"]}})
+        assert matches_resource_description(ns_resource, r) == []
+
+    def test_selector(self):
+        r = rule(
+            match={
+                "resources": {
+                    "kinds": ["Pod"],
+                    "selector": {"matchLabels": {"app": "nginx"}},
+                }
+            }
+        )
+        assert matches_resource_description(pod(labels={"app": "nginx"}), r) == []
+        assert matches_resource_description(pod(labels={"app": "httpd"}), r) != []
+        assert matches_resource_description(pod(), r) != []
+
+    def test_selector_wildcard(self):
+        r = rule(
+            match={
+                "resources": {
+                    "kinds": ["Pod"],
+                    "selector": {"matchLabels": {"app.kubernetes.io/*": "nginx"}},
+                }
+            }
+        )
+        assert (
+            matches_resource_description(pod(labels={"app.kubernetes.io/name": "nginx"}), r) == []
+        )
+
+    def test_annotations(self):
+        r = rule(
+            match={"resources": {"kinds": ["Pod"], "annotations": {"owner/*": "core"}}}
+        )
+        assert matches_resource_description(pod(annotations={"owner/team": "core"}), r) == []
+        assert matches_resource_description(pod(annotations={"owner/team": "infra"}), r) != []
+
+    def test_any(self):
+        r = rule(
+            match={
+                "any": [
+                    {"resources": {"kinds": ["Deployment"]}},
+                    {"resources": {"kinds": ["Pod"]}},
+                ]
+            }
+        )
+        assert matches_resource_description(pod(), r) == []
+
+    def test_all(self):
+        r = rule(
+            match={
+                "all": [
+                    {"resources": {"kinds": ["Pod"]}},
+                    {"resources": {"namespaces": ["default"]}},
+                ]
+            }
+        )
+        assert matches_resource_description(pod(), r) == []
+        assert matches_resource_description(pod(ns="dev"), r) != []
+
+    def test_operations(self):
+        r = rule(match={"resources": {"kinds": ["Pod"], "operations": ["CREATE"]}})
+        assert matches_resource_description(pod(), r, operation="CREATE") == []
+        assert matches_resource_description(pod(), r, operation="DELETE") != []
+
+    def test_empty_match_rejected(self):
+        r = rule(match={})
+        assert matches_resource_description(pod(), r) != []
+
+
+class TestExclude:
+    def test_exclude_flat(self):
+        r = rule(
+            match={"resources": {"kinds": ["Pod"]}},
+            exclude={"resources": {"namespaces": ["kube-system"]}},
+        )
+        assert matches_resource_description(pod(), r) == []
+        assert matches_resource_description(pod(ns="kube-system"), r) != []
+
+    def test_exclude_any(self):
+        r = rule(
+            match={"resources": {"kinds": ["Pod"]}},
+            exclude={
+                "any": [
+                    {"resources": {"namespaces": ["kube-system"]}},
+                    {"resources": {"names": ["allowed"]}},
+                ]
+            },
+        )
+        assert matches_resource_description(pod("allowed"), r) != []
+        assert matches_resource_description(pod(ns="kube-system"), r) != []
+        assert matches_resource_description(pod(), r) == []
+
+    def test_exclude_all(self):
+        r = rule(
+            match={"resources": {"kinds": ["Pod"]}},
+            exclude={
+                "all": [
+                    {"resources": {"namespaces": ["kube-system"]}},
+                    {"resources": {"names": ["dns*"]}},
+                ]
+            },
+        )
+        # excluded only when BOTH criteria hit
+        assert matches_resource_description(pod("dns-1", ns="kube-system"), r) != []
+        assert matches_resource_description(pod("web", ns="kube-system"), r) == []
+        assert matches_resource_description(pod("dns-1", ns="default"), r) == []
+
+
+class TestUserInfo:
+    def test_subjects(self):
+        r = rule(
+            match={
+                "all": [
+                    {
+                        "resources": {"kinds": ["Pod"]},
+                        "subjects": [{"kind": "User", "name": "alice"}],
+                    }
+                ]
+            }
+        )
+        info = RequestInfo(username="alice")
+        assert matches_resource_description(pod(), r, admission_info=info) == []
+        info = RequestInfo(username="bob")
+        assert matches_resource_description(pod(), r, admission_info=info) != []
+
+    def test_service_account_subject(self):
+        r = rule(
+            match={
+                "all": [
+                    {
+                        "resources": {"kinds": ["Pod"]},
+                        "subjects": [
+                            {"kind": "ServiceAccount", "namespace": "kyverno", "name": "bg"}
+                        ],
+                    }
+                ]
+            }
+        )
+        info = RequestInfo(username="system:serviceaccount:kyverno:bg")
+        assert matches_resource_description(pod(), r, admission_info=info) == []
+
+    def test_cluster_roles(self):
+        r = rule(
+            match={
+                "all": [
+                    {"resources": {"kinds": ["Pod"]}, "clusterRoles": ["cluster-admin"]}
+                ]
+            }
+        )
+        info = RequestInfo(cluster_roles=["cluster-admin", "view"], username="x")
+        assert matches_resource_description(pod(), r, admission_info=info) == []
+        info = RequestInfo(cluster_roles=["view"], username="x")
+        assert matches_resource_description(pod(), r, admission_info=info) != []
+
+    def test_empty_admission_info_drops_userinfo(self):
+        # match.go:263: background scans have empty RequestInfo; user-info
+        # filters are dropped so the resource part alone decides
+        r = rule(
+            match={
+                "all": [
+                    {"resources": {"kinds": ["Pod"]}, "clusterRoles": ["cluster-admin"]}
+                ]
+            }
+        )
+        assert matches_resource_description(pod(), r, admission_info=RequestInfo()) == []
+
+    def test_policy_namespace_gate(self):
+        r = rule(match={"resources": {"kinds": ["Pod"]}})
+        assert matches_resource_description(pod(ns="a"), r, policy_namespace="a") == []
+        assert matches_resource_description(pod(ns="b"), r, policy_namespace="a") != []

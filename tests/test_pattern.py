@@ -1,0 +1,267 @@
+"""Scalar pattern language golden tests.
+
+Cases ported from the reference's pkg/engine/pattern/pattern_test.go
+(ranges, durations, quantities, operators) plus coverage of the type
+coercion table in pattern.go:61-150.
+"""
+
+import pytest
+
+from kyverno_tpu.engine import pattern
+from kyverno_tpu.engine.operator import Operator, get_operator_from_string_pattern
+from kyverno_tpu.engine.pattern import (
+    _validate_string,
+    _validate_string_pattern,
+    validate,
+)
+
+
+class TestOperatorParse:
+    def test_basic(self):
+        assert get_operator_from_string_pattern(">=10") is Operator.MORE_EQUAL
+        assert get_operator_from_string_pattern("<=10") is Operator.LESS_EQUAL
+        assert get_operator_from_string_pattern(">10") is Operator.MORE
+        assert get_operator_from_string_pattern("<10") is Operator.LESS
+        assert get_operator_from_string_pattern("!10") is Operator.NOT_EQUAL
+        assert get_operator_from_string_pattern("10-20") is Operator.IN_RANGE
+        assert get_operator_from_string_pattern("10!-20") is Operator.NOT_IN_RANGE
+        assert get_operator_from_string_pattern("10") is Operator.EQUAL
+
+    def test_one_char_and_empty(self):
+        # pattern_test.go:164-170
+        assert get_operator_from_string_pattern("f") is Operator.EQUAL
+        assert get_operator_from_string_pattern("") is Operator.EQUAL
+
+    def test_not_before_range(self):
+        # '!' prefix wins over range regex
+        assert get_operator_from_string_pattern("!10-20") is Operator.NOT_EQUAL
+
+    def test_range_with_units(self):
+        assert get_operator_from_string_pattern("128Mi-512Mi") is Operator.IN_RANGE
+        assert get_operator_from_string_pattern("128Mi!-512Mi") is Operator.NOT_IN_RANGE
+
+
+class TestFloatPattern:
+    # pattern_test.go:14-40
+    def test_cases(self):
+        assert validate(7.9914, 7.9914)
+        assert not validate(7.9914, 7.99141)
+        assert validate(7, 7.000000)
+        assert validate(7.000000, 7.000000)
+        assert validate(7.000000, 7)
+        assert not validate(7.000001, 7)
+        assert not validate(8, 7.0)
+
+
+class TestRanges:
+    # pattern_test.go:46-104
+    def test_int_ranges(self):
+        assert _validate_string_pattern(0, "0-2")
+        assert _validate_string_pattern(1, "0-2")
+        assert _validate_string_pattern(2, "0-2")
+        assert not _validate_string_pattern(3, "0-2")
+
+        assert _validate_string_pattern(0, "10!-20")
+        assert not _validate_string_pattern(15, "10!-20")
+        assert _validate_string_pattern(25, "10!-20")
+
+    def test_float_ranges(self):
+        assert not _validate_string_pattern(0, "0.00001-2.00001")
+        assert _validate_string_pattern(1, "0.00001-2.00001")
+        assert _validate_string_pattern(2, "0.00001-2.00001")
+        assert not _validate_string_pattern(2.0001, "0.00001-2.00001")
+
+        assert _validate_string_pattern(0, "0.00001!-2.00001")
+        assert not _validate_string_pattern(1, "0.00001!-2.00001")
+        assert not _validate_string_pattern(2, "0.00001!-2.00001")
+        assert _validate_string_pattern(2.0001, "0.00001!-2.00001")
+
+        assert _validate_string_pattern(2, "2-2")
+        assert not _validate_string_pattern(2, "2!-2")
+
+        assert _validate_string_pattern(2.99999, "2.99998-3")
+        assert _validate_string_pattern(2.99997, "2.99998!-3")
+        assert _validate_string_pattern(3.00001, "2.99998!-3")
+
+    def test_quantity_ranges(self):
+        assert _validate_string_pattern("256Mi", "128Mi-512Mi")
+        assert not _validate_string_pattern("1024Mi", "128Mi-512Mi")
+        assert not _validate_string_pattern("64Mi", "128Mi-512Mi")
+
+        assert not _validate_string_pattern("256Mi", "128Mi!-512Mi")
+        assert _validate_string_pattern("1024Mi", "128Mi!-512Mi")
+        assert _validate_string_pattern("64Mi", "128Mi!-512Mi")
+
+    def test_negative_ranges(self):
+        assert _validate_string_pattern(-9, "-10-8")
+        assert not _validate_string_pattern(9, "-10--8")
+        assert _validate_string_pattern(9, "-10!--8")
+        assert _validate_string_pattern("9Mi", "-10Mi!--8Mi")
+        assert not _validate_string_pattern(-9, "-10!--8")
+        assert _validate_string_pattern("-9Mi", "-10Mi-8Mi")
+        assert _validate_string_pattern("9Mi", "-10Mi!-8Mi")
+        assert _validate_string_pattern(0, "-10-+8")
+        assert _validate_string_pattern("7Mi", "-10Mi-+8Mi")
+        assert _validate_string_pattern(10, "-10!-+8")
+        assert _validate_string_pattern("10Mi", "-10Mi!-+8Mi")
+        assert _validate_string_pattern(0, "+0-+1")
+        assert _validate_string_pattern("10Mi", "+0Mi-+1024Mi")
+        assert _validate_string_pattern(10, "+0!-+1")
+        assert _validate_string_pattern("1025Mi", "+0Mi!-+1024Mi")
+
+    def test_with_space(self):
+        assert _validate_string_pattern(4, ">= 3")
+
+
+class TestDuration:
+    # pattern_test.go:119-132
+    def test_cases(self):
+        assert _validate_string("12s", "12s", Operator.EQUAL)
+        assert _validate_string("12s", "15s", Operator.NOT_EQUAL)
+        assert _validate_string("12s", "15s", Operator.LESS)
+        assert _validate_string("12s", "15s", Operator.LESS_EQUAL)
+        assert _validate_string("12s", "12s", Operator.LESS_EQUAL)
+        assert not _validate_string("15s", "12s", Operator.LESS)
+        assert not _validate_string("15s", "12s", Operator.LESS_EQUAL)
+        assert _validate_string("15s", "12s", Operator.MORE)
+        assert _validate_string("15s", "12s", Operator.MORE_EQUAL)
+        assert _validate_string("12s", "12s", Operator.MORE_EQUAL)
+        assert not _validate_string("12s", "15s", Operator.MORE)
+        assert not _validate_string("12s", "15s", Operator.MORE_EQUAL)
+
+    def test_mixed_units(self):
+        assert _validate_string("90m", "1.5h", Operator.EQUAL)
+        assert _validate_string("2h45m", "165m", Operator.EQUAL)
+
+
+class TestQuantity:
+    # pattern_test.go:114-162
+    def test_invalid(self):
+        assert not _validate_string("1024Gi", "", Operator.EQUAL)
+        assert not _validate_string("gii", "1024Gi", Operator.EQUAL)
+
+    def test_equal(self):
+        assert _validate_string("1024Gi", "1024Gi", Operator.EQUAL)
+        assert _validate_string("1024Mi", "1Gi", Operator.EQUAL)
+        assert _validate_string("0.2", "200m", Operator.EQUAL)
+        assert _validate_string("500", "500", Operator.EQUAL)
+        assert not _validate_string("2048", "1024", Operator.EQUAL)
+        assert _validate_string(1024, "1024", Operator.EQUAL)
+
+    def test_operations(self):
+        assert _validate_string("1Gi", "1000Mi", Operator.MORE)
+        assert _validate_string("1G", "1Gi", Operator.LESS)
+        assert _validate_string("500m", "0.5", Operator.MORE_EQUAL)
+        assert _validate_string("1", "500m", Operator.MORE_EQUAL)
+        assert _validate_string("0.5", ".5", Operator.LESS_EQUAL)
+        assert _validate_string("0.2", ".5", Operator.LESS_EQUAL)
+        assert _validate_string("0.2", ".5", Operator.NOT_EQUAL)
+        assert not _validate_string("500m", "0.6", Operator.MORE_EQUAL)
+
+    def test_numeric_string_compare(self):
+        # pattern_test.go:106-112
+        assert _validate_string(7.00001, "7.000001", Operator.MORE)
+        assert _validate_string(7.00001, "7", Operator.NOT_EQUAL)
+        assert _validate_string(7.0000, "7", Operator.EQUAL)
+        assert not _validate_string(6.000000001, "6", Operator.LESS)
+
+
+class TestTypeDispatch:
+    def test_bool(self):
+        assert validate(True, True)
+        assert not validate(True, False)
+        assert not validate(False, True)
+        assert not validate("true", True)
+        assert not validate(1, True)
+
+    def test_int(self):
+        assert validate(7, 7)
+        assert not validate(8, 7)
+        assert validate(7.0, 7)
+        assert not validate(7.5, 7)
+        assert validate("7", 7)
+        assert not validate("7.0", 7)
+        assert not validate(True, 7)
+
+    def test_nil(self):
+        assert validate(None, None)
+        assert validate(0, None)
+        assert validate(0.0, None)
+        assert validate("", None)
+        assert validate(False, None)
+        assert not validate(1, None)
+        assert not validate("x", None)
+        assert not validate({}, None)
+        assert not validate([], None)
+
+    def test_map_pattern_existence_only(self):
+        assert validate({"a": 1}, {"x": "y"})
+        assert validate({}, {"x": "y"})
+        assert not validate("str", {"x": "y"})
+        assert not validate([1], {"x": "y"})
+
+    def test_array_pattern_unsupported(self):
+        assert not validate([1, 2], [1, 2])
+        assert not validate(1, [1])
+
+    def test_string_or_and(self):
+        assert validate("a", "a|b")
+        assert validate("b", "a|b")
+        assert not validate("c", "a|b")
+        assert validate(5, ">3 & <10")
+        assert not validate(11, ">3 & <10")
+        assert validate(2, "<1 | >1")
+        assert not validate(1, "<1 | >1")
+
+    def test_string_wildcard(self):
+        assert validate("nginx:1.2", "nginx:*")
+        assert not validate("nginx:1.2", "!nginx:*")
+        assert validate("httpd:2", "!nginx:*")
+        assert validate("anything", "*")
+        # literal equality short-circuit even when pattern contains '|'
+        assert validate("a|b", "a|b")
+
+    def test_bool_value_string_pattern(self):
+        assert validate(True, "true")
+        assert validate(False, "false")
+        assert not validate(True, "false")
+
+
+class TestWildcard:
+    def test_reference_cases(self):
+        # ext/wildcard/match_test.go
+        from kyverno_tpu.utils.wildcard import match
+
+        assert match("*", "s3:GetObject")
+        assert not match("", "s3:GetObject")
+        assert match("", "")
+        assert match("s3:*", "s3:ListMultipartUploadParts")
+        assert not match("s3:ListBucketMultipartUploads", "s3:ListBucket")
+        assert match("s3:ListBucket", "s3:ListBucket")
+        assert match("my-bucket/oo*", "my-bucket/oo")
+        assert not match("my-bucket?/abc*", "mybucket/abc")
+        assert match("my-bucket?/abc*", "my-bucket1/abc")
+        assert not match("my-?-bucket/abc*", "my--bucket/abc")
+        assert match("my-?-bucket/abc*", "my-1-bucket/abc")
+        assert match("my-?-bucket/abc*", "my-k-bucket/abc")
+        assert not match("my??bucket/abc*", "mybucket/abc")
+        assert match("my??bucket/abc*", "my4abucket/abc")
+        assert match("my-bucket?abc*", "my-bucket/abc")
+        assert match("my-bucket/abc?efg", "my-bucket/abcdefg")
+        assert match("my-bucket/abc?efg", "my-bucket/abc/efg")
+        assert not match("my-bucket/abc????", "my-bucket/abc")
+        assert not match("my-bucket/abc????", "my-bucket/abcde")
+        assert match("my-bucket/abc????", "my-bucket/abcdefg")
+        assert not match("my-bucket/abc?", "my-bucket/abc")
+        assert match("my-bucket/abc?", "my-bucket/abcd")
+        assert not match("my-bucket/abc?", "my-bucket/abcde")
+        assert not match("my-bucket/mnop*?", "my-bucket/mnop")
+        assert match("my-bucket/mnop*?", "my-bucket/mnopqrst/mnopqr")
+
+
+class TestGoFloatFormat:
+    def test_format_e(self):
+        assert pattern.go_format_float_e(2.0) == "2E+00"
+        assert pattern.go_format_float_e(1.5) == "1.5E+00"
+        assert pattern.go_format_float_e(0.001) == "1E-03"
+        assert pattern.go_format_float_e(123.456) == "1.23456E+02"

@@ -1,0 +1,97 @@
+"""MatchPattern tree-walk golden tests.
+
+Corpora extracted from the reference's
+pkg/engine/validate/validate_test.go into tests/golden/:
+
+- match_pattern_cases.json — 46 MatchPattern cases with expected
+  pass/skip/fail status (conditional + global anchor semantics).
+- validate_cases.json — validateMap-level fixtures; cases flagged
+  ``substitute`` require $(path) reference pre-substitution and are
+  enabled once the variables module provides it.
+"""
+
+import json
+import os
+
+import pytest
+
+from kyverno_tpu.engine.validate import match_pattern
+
+GOLDEN = os.path.join(os.path.dirname(__file__), "golden")
+
+
+def load(name):
+    with open(os.path.join(GOLDEN, name)) as f:
+        return json.load(f)
+
+
+MATCH_CASES = load("match_pattern_cases.json")
+VALIDATE_CASES = load("validate_cases.json")
+
+
+@pytest.mark.parametrize("case", MATCH_CASES, ids=[c["name"] for c in MATCH_CASES])
+def test_match_pattern_status(case):
+    err = match_pattern(case["resource"], case["pattern"])
+    if case["status"] == "pass":
+        assert err is None, f"expected pass, got {err!r}"
+    elif case["status"] == "skip":
+        assert err is not None and err.skip, f"expected skip, got {err!r}"
+    else:
+        # "fail" cases: the reference's testMatchPattern helper has no
+        # assertion branch for RuleStatusFail (validate_test.go:1665-1688),
+        # and several of them (e.g. test-23) actually yield a skip-
+        # classified global-anchor error in the Go engine. Assert only
+        # "did not pass", mirroring what the reference guarantees.
+        assert err is not None, f"expected non-pass, got {err!r}"
+
+
+@pytest.mark.parametrize("case", VALIDATE_CASES, ids=[c["name"] for c in VALIDATE_CASES])
+def test_validate_map_fixtures(case):
+    pattern = case["pattern"]
+    if case["substitute"]:
+        pytest.importorskip("kyverno_tpu.engine.variables")
+        from kyverno_tpu.engine.variables import substitute_all
+
+        pattern = substitute_all(None, pattern)
+    err = match_pattern(case["resource"], pattern)
+    if case["expect"] == "ok":
+        assert err is None, f"expected ok, got {err!r}"
+    else:
+        assert err is not None, "expected failure, got ok"
+
+
+def test_anchor_parse():
+    from kyverno_tpu.engine import anchor
+
+    a = anchor.parse("(image)")
+    assert a is not None and a.modifier == anchor.CONDITION and a.key == "image"
+    a = anchor.parse("<(image)")
+    assert anchor.is_global(a)
+    a = anchor.parse("X(host)")
+    assert anchor.is_negation(a)
+    a = anchor.parse("+(labels)")
+    assert anchor.is_add_if_not_present(a)
+    a = anchor.parse("=(sc)")
+    assert anchor.is_equality(a)
+    a = anchor.parse("^(containers)")
+    assert anchor.is_existence(a)
+    assert anchor.parse("plain") is None
+    assert anchor.parse("()") is None  # empty key is not an anchor
+
+
+def test_negation_anchor():
+    # X(key) fails when the key is present
+    pattern = {"spec": {"X(hostNetwork)": "true"}}
+    assert match_pattern({"spec": {}}, pattern) is None
+    err = match_pattern({"spec": {"hostNetwork": "true"}}, pattern)
+    assert err is not None and not err.skip
+
+
+def test_existence_anchor():
+    # ^(containers): at least one element must match
+    pattern = {"spec": {"^(containers)": [{"name": "busybox"}]}}
+    ok = {"spec": {"containers": [{"name": "nginx"}, {"name": "busybox"}]}}
+    bad = {"spec": {"containers": [{"name": "nginx"}]}}
+    assert match_pattern(ok, pattern) is None
+    err = match_pattern(bad, pattern)
+    assert err is not None and not err.skip
