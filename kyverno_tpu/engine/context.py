@@ -1,0 +1,182 @@
+"""Per-request JSON context with checkpoint/restore.
+
+Re-implementation of pkg/engine/context/context.go: a JSON document
+holding ``request`` (object/oldObject/userInfo/operation...),
+``element``/``elementIndex`` (foreach scope), ``images``, and named
+context entries, queried via JMESPath. Checkpoint/Restore snapshots
+give per-rule isolation (engine.go:258-266).
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+from typing import Any, Dict, List, Optional
+
+from . import jmespath as jp
+from .jmespath.errors import JMESPathError
+
+
+class InvalidVariableError(Exception):
+    pass
+
+
+class ContextEntryError(Exception):
+    """A registered context-entry loader failed. Deliberately NOT an
+    InvalidVariableError: the preconditions resolver maps unresolved
+    variables to null, but a failed context load must surface as a rule
+    error (engine.go:269-276), not evaluate as null."""
+
+
+class Context:
+    """JSON context (context.go:46 Interface)."""
+
+    def __init__(self):
+        self._root: Dict[str, Any] = {"request": {}}
+        self._checkpoints: List[Dict[str, Any]] = []
+        self._deferred = []  # (name, loader) pairs, see deferred loading
+
+    # -- builders
+
+    def add_request(self, request: Dict[str, Any]) -> None:
+        self._root["request"] = request
+
+    def add_resource(self, resource: Dict[str, Any]) -> None:
+        self._root.setdefault("request", {})["object"] = resource
+
+    def add_old_resource(self, resource: Dict[str, Any]) -> None:
+        self._root.setdefault("request", {})["oldObject"] = resource
+
+    def add_target_resource(self, resource: Dict[str, Any]) -> None:
+        self._root["target"] = resource
+
+    def add_operation(self, operation: str) -> None:
+        self._root.setdefault("request", {})["operation"] = operation
+
+    def add_user_info(self, user_info: Dict[str, Any]) -> None:
+        self._root.setdefault("request", {})["userInfo"] = user_info
+
+    def add_service_account(self, username: str) -> None:
+        """context.go AddServiceAccount: derive serviceAccountName /
+        serviceAccountNamespace from a system:serviceaccount username."""
+        sa_name, sa_ns = "", ""
+        prefix = "system:serviceaccount:"
+        if username.startswith(prefix):
+            rest = username[len(prefix):]
+            if rest.count(":") == 1:
+                sa_ns, sa_name = rest.split(":")
+        self._root["serviceAccountName"] = sa_name
+        self._root["serviceAccountNamespace"] = sa_ns
+
+    def add_namespace(self, namespace: str) -> None:
+        self._root.setdefault("request", {})["namespace"] = namespace
+
+    def add_element(self, element: Any, index: int, nesting: int = 0) -> None:
+        # element / elementIndex, plus elementIndexN for nested foreach
+        self._root["element"] = element
+        self._root["elementIndex"] = index
+        self._root[f"elementIndex{nesting}"] = index
+
+    def add_image_infos(self, images: Dict[str, Any]) -> None:
+        self._root["images"] = images
+
+    def add_variable(self, name: str, value: Any) -> None:
+        """Set a dotted-name variable (context entries, CLI values)."""
+        parts = name.split(".")
+        node = self._root
+        for part in parts[:-1]:
+            nxt = node.get(part)
+            if not isinstance(nxt, dict):
+                nxt = {}
+                node[part] = nxt
+            node = nxt
+        node[parts[-1]] = value
+
+    def add_context_entry(self, name: str, value: Any) -> None:
+        self.add_variable(name, value)
+
+    def add_json(self, data: Dict[str, Any]) -> None:
+        """Merge raw JSON into the root (context.go addJSON)."""
+        _merge(self._root, data)
+
+    # -- queries
+
+    def query(self, query: str) -> Any:
+        query = query.strip()
+        if not query:
+            raise InvalidVariableError("invalid query (nil)")
+        self._load_deferred(query)
+        try:
+            return jp.search(query, self._root)
+        except JMESPathError as e:
+            raise InvalidVariableError(f"failed to query {query!r}: {e}") from e
+
+    def query_operation(self) -> str:
+        req = self._root.get("request") or {}
+        return req.get("operation") or ""
+
+    def has_changed(self, jmespath_expr: str) -> bool:
+        """context.go HasChanged: object vs oldObject at a path."""
+        new = jp.search("request.object." + jmespath_expr, self._root)
+        old = jp.search("request.oldObject." + jmespath_expr, self._root)
+        return new != old
+
+    # -- deferred loaders (deferred.go)
+
+    def add_deferred_loader(self, name: str, loader) -> None:
+        self._deferred.append((name, loader))
+
+    def _load_deferred(self, query: str) -> None:
+        if not self._deferred:
+            return
+        remaining = []
+        for name, loader in self._deferred:
+            if _query_references(query, name):
+                try:
+                    value = loader()
+                except Exception as e:  # loader errors surface on query
+                    raise ContextEntryError(f"failed to load context entry {name!r}: {e}")
+                self.add_context_entry(name, value)
+            else:
+                remaining.append((name, loader))
+        self._deferred = remaining
+
+    # -- checkpointing (context.go Checkpoint/Restore/Reset)
+
+    def checkpoint(self) -> None:
+        self._checkpoints.append((copy.deepcopy(self._root), list(self._deferred)))
+
+    def restore(self) -> None:
+        if self._checkpoints:
+            self._root, self._deferred = self._checkpoints.pop()
+
+    def reset(self) -> None:
+        """Revert to the last checkpoint without popping it."""
+        if self._checkpoints:
+            root, deferred = self._checkpoints[-1]
+            self._root = copy.deepcopy(root)
+            self._deferred = list(deferred)
+
+    # -- introspection
+
+    def root(self) -> Dict[str, Any]:
+        return self._root
+
+    def json(self) -> str:
+        return json.dumps(self._root)
+
+
+def _merge(dst: Dict[str, Any], src: Dict[str, Any]) -> None:
+    for k, v in src.items():
+        if isinstance(v, dict) and isinstance(dst.get(k), dict):
+            _merge(dst[k], v)
+        else:
+            dst[k] = v
+
+
+def _query_references(query: str, name: str) -> bool:
+    """Rough equivalent of deferred.go matching: the query mentions the
+    entry name as an identifier."""
+    import re
+
+    return re.search(r"(^|[^\w.])" + re.escape(name) + r"($|[^\w])", query) is not None

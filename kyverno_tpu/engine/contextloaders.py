@@ -1,0 +1,156 @@
+"""Context-entry loaders.
+
+Re-implementation of pkg/engine/context/loaders/*: each rule may
+declare ``context:`` entries sourced from inline variables, ConfigMaps,
+API calls, image registries, or GlobalContext entries. Loading is
+deferred — the entry materializes only when a query references it
+(deferred.go, toggle enableDeferredLoading).
+
+The data sources are pluggable: the admission/background services
+install informer-backed sources; the CLI installs file/value-backed
+stubs (matching the reference CLI's store-backed loader,
+cmd/cli/kubectl-kyverno/processor/policy_processor.go:75-85).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+from .context import Context, InvalidVariableError
+from .jmespath import search as jp_search
+from .jmespath.errors import JMESPathError
+from .variables import substitute_all
+
+
+class ContextLoaderError(Exception):
+    pass
+
+
+class DataSources:
+    """Pluggable backends for context entries."""
+
+    def __init__(
+        self,
+        configmaps: Optional[Dict[str, Dict[str, Any]]] = None,
+        api_call: Optional[Callable[[Dict[str, Any]], Any]] = None,
+        image_data: Optional[Callable[[str], Dict[str, Any]]] = None,
+        global_context: Optional[Dict[str, Any]] = None,
+    ):
+        # configmaps: "namespace/name" -> configmap object dict
+        self.configmaps = configmaps or {}
+        self.api_call = api_call
+        self.image_data = image_data
+        self.global_context = global_context or {}
+
+
+def load_context_entries(
+    ctx: Context,
+    entries: List[Dict[str, Any]],
+    sources: Optional[DataSources] = None,
+    deferred: bool = True,
+) -> None:
+    """Register (or eagerly load) each context entry into ``ctx``."""
+    sources = sources or DataSources()
+    for entry in entries:
+        name = entry.get("name")
+        if not name:
+            raise ContextLoaderError("context entry without name")
+        loader = _make_loader(ctx, entry, sources)
+        if deferred:
+            ctx.add_deferred_loader(name, loader)
+        else:
+            ctx.add_context_entry(name, loader())
+
+
+def _make_loader(ctx: Context, entry: Dict[str, Any], sources: DataSources):
+    name = entry["name"]
+    if "variable" in entry:
+        return lambda: _load_variable(ctx, entry["variable"])
+    if "configMap" in entry:
+        return lambda: _load_configmap(ctx, entry["configMap"], sources)
+    if "apiCall" in entry:
+        return lambda: _load_apicall(ctx, entry["apiCall"], sources)
+    if "imageRegistry" in entry:
+        return lambda: _load_image_registry(ctx, entry["imageRegistry"], sources)
+    if "globalReference" in entry:
+        return lambda: _load_global(ctx, entry["globalReference"], sources)
+    raise ContextLoaderError(f"context entry {name!r} has no recognized source")
+
+
+def _load_variable(ctx: Context, spec: Dict[str, Any]) -> Any:
+    # loaders/variable.go: value / jmesPath / default
+    value = spec.get("value")
+    jmes = spec.get("jmesPath")
+    default = spec.get("default")
+    result = None
+    if value is not None:
+        result = substitute_all(ctx, value)
+        if jmes:
+            try:
+                result = jp_search(substitute_all(ctx, jmes), result)
+            except JMESPathError as e:
+                raise ContextLoaderError(f"variable jmesPath failed: {e}")
+    elif jmes:
+        expr = substitute_all(ctx, jmes)
+        try:
+            result = ctx.query(expr)
+        except InvalidVariableError as e:
+            if default is None:
+                raise ContextLoaderError(str(e))
+            result = None
+    if result is None and default is not None:
+        result = default
+    return result
+
+
+def _load_configmap(ctx: Context, spec: Dict[str, Any], sources: DataSources) -> Any:
+    # loaders/configmap.go: exposes the configmap object under the
+    # entry name, with .data values as strings
+    name = substitute_all(ctx, spec.get("name", ""))
+    namespace = substitute_all(ctx, spec.get("namespace", "") or "default")
+    cm = sources.configmaps.get(f"{namespace}/{name}")
+    if cm is None:
+        raise ContextLoaderError(f"configmap {namespace}/{name} not found")
+    return cm
+
+
+def _load_apicall(ctx: Context, spec: Dict[str, Any], sources: DataSources) -> Any:
+    if sources.api_call is None:
+        raise ContextLoaderError("no API-call backend configured")
+    substituted = substitute_all(ctx, dict(spec))
+    data = sources.api_call(substituted)
+    jmes = substituted.get("jmesPath")
+    if jmes:
+        try:
+            data = jp_search(jmes, data)
+        except JMESPathError as e:
+            raise ContextLoaderError(f"apiCall jmesPath failed: {e}")
+    return data
+
+
+def _load_image_registry(ctx: Context, spec: Dict[str, Any], sources: DataSources) -> Any:
+    if sources.image_data is None:
+        raise ContextLoaderError("no image-registry backend configured")
+    reference = substitute_all(ctx, spec.get("reference", ""))
+    data = sources.image_data(reference)
+    jmes = spec.get("jmesPath")
+    if jmes:
+        try:
+            data = jp_search(substitute_all(ctx, jmes), data)
+        except JMESPathError as e:
+            raise ContextLoaderError(f"imageRegistry jmesPath failed: {e}")
+    return data
+
+
+def _load_global(ctx: Context, spec: Dict[str, Any], sources: DataSources) -> Any:
+    name = spec.get("name", "")
+    if name not in sources.global_context:
+        raise ContextLoaderError(f"global context entry {name!r} not found")
+    data = sources.global_context[name]
+    jmes = spec.get("jmesPath")
+    if jmes:
+        try:
+            data = jp_search(substitute_all(ctx, jmes), data)
+        except JMESPathError as e:
+            raise ContextLoaderError(f"globalReference jmesPath failed: {e}")
+    return data

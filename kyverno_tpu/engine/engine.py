@@ -1,0 +1,405 @@
+"""The engine facade — per-policy orchestration.
+
+Re-implementation of pkg/engine/engine.go + the validation and
+mutation handlers (pkg/engine/handlers/validation/validate_resource.go,
+pkg/engine/handlers/mutation/*, pkg/engine/mutation.go):
+
+per rule: match/exclude gate → context-entry loading (deferred) →
+preconditions → handler, with JSON-context checkpoint/restore around
+each rule (engine.go:258-266) so rule-scoped variables don't leak.
+
+This scalar engine is the oracle; ``kyverno_tpu.tpu`` compiles the
+same policies into batched device programs and is parity-tested
+against it.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, List, Optional
+
+from ..api.policy import ClusterPolicy, Rule
+from . import mutate as mutatepkg
+from . import validate as validatepkg
+from .conditions import evaluate_conditions
+from .context import Context, ContextEntryError, InvalidVariableError
+from .contextloaders import ContextLoaderError, DataSources, load_context_entries
+from .match import matches_resource_description
+from .policycontext import PolicyContext
+from .response import (
+    RULE_TYPE_MUTATION,
+    RULE_TYPE_VALIDATION,
+    EngineResponse,
+    PolicyResponse,
+    RuleResponse,
+)
+from .variables import (
+    SubstitutionError,
+    precondition_resolver,
+    substitute_all,
+    substitute_all_in_preconditions,
+)
+
+
+class Engine:
+    """engineapi.Engine equivalent (pkg/engine/api/engine.go:17)."""
+
+    def __init__(self, data_sources: Optional[DataSources] = None, exceptions: Optional[list] = None):
+        self.data_sources = data_sources or DataSources()
+        self.exceptions = exceptions or []
+
+    # -- public API
+
+    def validate(self, pctx: PolicyContext) -> EngineResponse:
+        response = EngineResponse(
+            policy=pctx.policy,
+            resource=pctx.new_resource,
+            namespace_labels=pctx.namespace_labels,
+        )
+        for rule in pctx.policy.get_rules():
+            if not rule.has_validate():
+                continue
+            rr = self._invoke_rule(pctx, rule, self._validate_rule)
+            if rr is not None:
+                response.policy_response.add(*rr)
+        return response
+
+    def mutate(self, pctx: PolicyContext) -> EngineResponse:
+        patched = copy.deepcopy(pctx.new_resource)
+        response = EngineResponse(
+            policy=pctx.policy,
+            resource=pctx.new_resource,
+            namespace_labels=pctx.namespace_labels,
+        )
+        for rule in pctx.policy.get_rules():
+            if not rule.has_mutate():
+                continue
+            pctx.new_resource = patched
+            pctx.json_context.add_resource(patched)
+            rr = self._invoke_rule(pctx, rule, self._mutate_rule)
+            if rr is not None:
+                response.policy_response.add(*rr)
+                for r in rr:
+                    if r.patched_target is not None:
+                        patched = r.patched_target
+        response.patched_resource = patched
+        return response
+
+    def apply_background_checks(self, pctx: PolicyContext) -> EngineResponse:
+        """Background scans evaluate validate rules with empty
+        admission info (engine.go ApplyBackgroundChecks)."""
+        return self.validate(pctx)
+
+    # -- rule plumbing
+
+    def _invoke_rule(self, pctx: PolicyContext, rule: Rule, handler) -> Optional[List[RuleResponse]]:
+        # match/exclude gate (engine.go:190)
+        reasons = matches_resource_description(
+            pctx.resource_for_match(),
+            rule,
+            pctx.admission_info,
+            pctx.namespace_labels,
+            pctx.policy.namespace,
+            subresource=pctx.subresource,
+            operation=pctx.operation,
+        )
+        if reasons:
+            return None
+        # exception gate (engine.go:287, exceptions.go)
+        matched_exceptions = self._matching_exceptions(pctx, rule)
+        if matched_exceptions:
+            names = ", ".join(matched_exceptions)
+            rtype = RULE_TYPE_VALIDATION if rule.has_validate() else RULE_TYPE_MUTATION
+            return [
+                RuleResponse.rule_skip(
+                    rule.name, rtype, f"rule is skipped due to policy exception {names}",
+                    exceptions=matched_exceptions,
+                )
+            ]
+        # checkpoint/restore isolation (engine.go:258-266)
+        ctx = pctx.json_context
+        ctx.checkpoint()
+        try:
+            rtype = RULE_TYPE_VALIDATION if rule.has_validate() else RULE_TYPE_MUTATION
+            try:
+                load_context_entries(ctx, rule.context, self.data_sources)
+            except ContextLoaderError as e:
+                return [RuleResponse.rule_error(rule.name, rtype, f"failed to load context: {e}")]
+            # preconditions (engine.go:278)
+            try:
+                if not evaluate_conditions(ctx, rule.preconditions):
+                    return [RuleResponse.rule_skip(rule.name, rtype, "preconditions not met")]
+            except (SubstitutionError, InvalidVariableError) as e:
+                return [RuleResponse.rule_error(rule.name, rtype, f"preconditions error: {e}")]
+            return handler(pctx, rule)
+        except ContextEntryError as e:
+            rtype = RULE_TYPE_VALIDATION if rule.has_validate() else RULE_TYPE_MUTATION
+            return [RuleResponse.rule_error(rule.name, rtype, str(e))]
+        finally:
+            ctx.restore()
+
+    def _matching_exceptions(self, pctx: PolicyContext, rule: Rule) -> List[str]:
+        out = []
+        for exc in self.exceptions:
+            spec = exc.get("spec", {})
+            for entry in spec.get("exceptions", []):
+                if entry.get("policyName") != pctx.policy.name:
+                    continue
+                if rule.name not in (entry.get("ruleNames") or []):
+                    continue
+                match_block = spec.get("match")
+                if match_block:
+                    pseudo = Rule.from_dict({"name": "exception", "match": match_block})
+                    if matches_resource_description(
+                        pctx.resource_for_match(),
+                        pseudo,
+                        pctx.admission_info,
+                        pctx.namespace_labels,
+                        operation=pctx.operation,
+                    ):
+                        continue
+                out.append((exc.get("metadata") or {}).get("name", "exception"))
+        return out
+
+    # -- validation handler (validate_resource.go)
+
+    def _validate_rule(self, pctx: PolicyContext, rule: Rule) -> List[RuleResponse]:
+        v = rule.validation
+        ctx = pctx.json_context
+        name = rule.name
+
+        if v.deny is not None:
+            return [self._validate_deny(ctx, name, rule)]
+        if v.pattern is not None or v.any_pattern is not None:
+            return [self._validate_patterns(ctx, name, rule, pctx.new_resource)]
+        if v.foreach is not None:
+            return [self._validate_foreach(pctx, name, rule)]
+        if v.pod_security is not None:
+            from ..pss import validate_pod_security
+
+            return [validate_pod_security(name, v, pctx.new_resource)]
+        if v.cel is not None:
+            return [
+                RuleResponse.rule_error(
+                    name, RULE_TYPE_VALIDATION, "CEL validation requires the VAP subsystem"
+                )
+            ]
+        return [RuleResponse.rule_error(name, RULE_TYPE_VALIDATION, "invalid validation rule")]
+
+    def _message(self, ctx: Context, rule: Rule, default: str = "") -> str:
+        msg = rule.validation.message if rule.validation else ""
+        if not msg:
+            return default
+        try:
+            return str(substitute_all(ctx, msg, precondition_resolver))
+        except SubstitutionError:
+            return msg
+
+    def _validate_deny(self, ctx: Context, name: str, rule: Rule) -> RuleResponse:
+        deny = rule.validation.deny or {}
+        try:
+            denied = evaluate_conditions(ctx, deny.get("conditions"))
+        except (SubstitutionError, InvalidVariableError) as e:
+            return RuleResponse.rule_error(name, RULE_TYPE_VALIDATION, f"deny conditions error: {e}")
+        if denied:
+            return RuleResponse.rule_fail(
+                name, RULE_TYPE_VALIDATION, self._message(ctx, rule, "access denied")
+            )
+        return RuleResponse.rule_pass(name, RULE_TYPE_VALIDATION, "")
+
+    def _validate_patterns(
+        self, ctx: Context, name: str, rule: Rule, resource: Dict[str, Any]
+    ) -> RuleResponse:
+        v = rule.validation
+        if v.pattern is not None:
+            try:
+                pattern = substitute_all(ctx, v.pattern)
+            except SubstitutionError as e:
+                return RuleResponse.rule_error(name, RULE_TYPE_VALIDATION, str(e))
+            err = validatepkg.match_pattern(resource, pattern)
+            if err is None:
+                return RuleResponse.rule_pass(name, RULE_TYPE_VALIDATION, "")
+            if err.skip:
+                return RuleResponse.rule_skip(name, RULE_TYPE_VALIDATION, "rule not applicable")
+            msg = self._message(ctx, rule, "validation failed")
+            if err.path:
+                msg = f"{msg} at path {err.path}" if msg else f"validation error at path {err.path}"
+            return RuleResponse.rule_fail(name, RULE_TYPE_VALIDATION, msg)
+        # anyPattern (validate_resource.go:382-440)
+        skips = 0
+        fails = []
+        for i, pat in enumerate(v.any_pattern or []):
+            try:
+                pattern = substitute_all(ctx, pat)
+            except SubstitutionError as e:
+                return RuleResponse.rule_error(name, RULE_TYPE_VALIDATION, str(e))
+            err = validatepkg.match_pattern(resource, pattern)
+            if err is None:
+                return RuleResponse.rule_pass(name, RULE_TYPE_VALIDATION, "")
+            if err.skip:
+                skips += 1
+            else:
+                fails.append(f"pattern {i}: {err.path or err.message}")
+        if skips and not fails:
+            return RuleResponse.rule_skip(name, RULE_TYPE_VALIDATION, "rule not applicable")
+        msg = self._message(ctx, rule, "no pattern matched")
+        return RuleResponse.rule_fail(name, RULE_TYPE_VALIDATION, f"{msg} ({'; '.join(fails)})")
+
+    def _validate_foreach(self, pctx: PolicyContext, name: str, rule: Rule) -> RuleResponse:
+        # validate_resource.go:187-202: per-element apply counts sum
+        # across foreach entries; zero applied elements => skip
+        applied = 0
+        for fe in rule.validation.foreach or []:
+            result, count = self._run_foreach(pctx, name, rule, fe, nesting=0)
+            if result is not None:
+                return result
+            applied += count
+        if applied == 0:
+            return RuleResponse.rule_skip(name, RULE_TYPE_VALIDATION, "foreach not applied")
+        return RuleResponse.rule_pass(name, RULE_TYPE_VALIDATION, "")
+
+    def _run_foreach(
+        self, pctx: PolicyContext, name: str, rule: Rule, fe: Dict[str, Any], nesting: int
+    ):
+        """Returns (fail/error response or None, applied element count)."""
+        ctx = pctx.json_context
+        list_expr = fe.get("list", "")
+        try:
+            elements = ctx.query(substitute_all(ctx, list_expr, precondition_resolver))
+        except (InvalidVariableError, SubstitutionError) as e:
+            return (
+                RuleResponse.rule_error(name, RULE_TYPE_VALIDATION, f"foreach list error: {e}"),
+                0,
+            )
+        if elements is None:
+            return None, 0  # nothing to iterate
+        if isinstance(elements, dict):
+            elements = [{"key": k, "value": v} for k, v in elements.items()]
+        if not isinstance(elements, list):
+            return (
+                RuleResponse.rule_error(
+                    name, RULE_TYPE_VALIDATION, f"foreach list is not a list: {list_expr}"
+                ),
+                0,
+            )
+        applied = 0
+        element_scope = fe.get("elementScope", True)
+        for i, element in enumerate(elements):
+            ctx.checkpoint()
+            try:
+                try:
+                    load_context_entries(ctx, fe.get("context") or [], self.data_sources)
+                except ContextLoaderError as e:
+                    return RuleResponse.rule_error(name, RULE_TYPE_VALIDATION, str(e)), applied
+                ctx.add_element(element, i, nesting)
+                try:
+                    if not evaluate_conditions(ctx, fe.get("preconditions")):
+                        continue
+                except (SubstitutionError, InvalidVariableError) as e:
+                    return RuleResponse.rule_error(name, RULE_TYPE_VALIDATION, str(e)), applied
+                target = element if element_scope and isinstance(element, dict) else pctx.new_resource
+                if fe.get("deny") is not None:
+                    try:
+                        denied = evaluate_conditions(ctx, fe["deny"].get("conditions"))
+                    except (SubstitutionError, InvalidVariableError) as e:
+                        return RuleResponse.rule_error(name, RULE_TYPE_VALIDATION, str(e)), applied
+                    if denied:
+                        return (
+                            RuleResponse.rule_fail(
+                                name, RULE_TYPE_VALIDATION,
+                                self._message(ctx, rule, f"denied at element {i}"),
+                            ),
+                            applied,
+                        )
+                    applied += 1
+                elif fe.get("pattern") is not None or fe.get("anyPattern") is not None:
+                    pseudo = Rule.from_dict(
+                        {
+                            "name": name,
+                            "validate": {
+                                "message": rule.validation.message,
+                                "pattern": fe.get("pattern"),
+                                "anyPattern": fe.get("anyPattern"),
+                            },
+                        }
+                    )
+                    rr = self._validate_patterns(ctx, name, pseudo, target)
+                    if rr.is_fail() or rr.status == "error":
+                        rr.message = f"{rr.message} (element {i})"
+                        return rr, applied
+                    if rr.status != "skip":
+                        applied += 1
+                elif fe.get("foreach") is not None:
+                    for nested in fe["foreach"]:
+                        result, count = self._run_foreach(pctx, name, rule, nested, nesting + 1)
+                        applied += count
+                        if result is not None:
+                            return result, applied
+            finally:
+                ctx.restore()
+        return None, applied
+
+    # -- mutation handler (mutate_resource.go, mutation.go)
+
+    def _mutate_rule(self, pctx: PolicyContext, rule: Rule) -> List[RuleResponse]:
+        m = rule.mutation or {}
+        ctx = pctx.json_context
+        name = rule.name
+        patched = copy.deepcopy(pctx.new_resource)
+        try:
+            if m.get("patchStrategicMerge") is not None:
+                overlay = substitute_all(ctx, m["patchStrategicMerge"])
+                patched = mutatepkg.strategic_merge(patched, overlay)
+            elif m.get("patchesJson6902") is not None:
+                patches = mutatepkg.load_json6902(m["patchesJson6902"])
+                patches = substitute_all(ctx, patches)
+                patched = mutatepkg.apply_json6902(patched, patches)
+            elif m.get("foreach") is not None:
+                for fe in m["foreach"]:
+                    patched = self._mutate_foreach(pctx, rule, fe, patched)
+                    if patched is None:
+                        return [
+                            RuleResponse.rule_error(name, RULE_TYPE_MUTATION, "foreach mutate failed")
+                        ]
+            else:
+                return [RuleResponse.rule_skip(name, RULE_TYPE_MUTATION, "no patch specified")]
+        except (SubstitutionError, mutatepkg.PatchError) as e:
+            return [RuleResponse.rule_error(name, RULE_TYPE_MUTATION, str(e))]
+        if patched == pctx.new_resource:
+            return [RuleResponse.rule_skip(name, RULE_TYPE_MUTATION, "no changes")]
+        return [
+            RuleResponse.rule_pass(name, RULE_TYPE_MUTATION, "mutated", patched_target=patched)
+        ]
+
+    def _mutate_foreach(
+        self, pctx: PolicyContext, rule: Rule, fe: Dict[str, Any], patched: Dict[str, Any]
+    ) -> Optional[Dict[str, Any]]:
+        ctx = pctx.json_context
+        try:
+            elements = ctx.query(substitute_all(ctx, fe.get("list", ""), precondition_resolver))
+        except (InvalidVariableError, SubstitutionError):
+            return None
+        if not isinstance(elements, list):
+            return patched
+        for i, element in enumerate(elements):
+            ctx.checkpoint()
+            try:
+                ctx.add_element(element, i)
+                try:
+                    if not evaluate_conditions(ctx, fe.get("preconditions")):
+                        continue
+                except (SubstitutionError, InvalidVariableError):
+                    return None
+                ctx.add_resource(patched)
+                if fe.get("patchStrategicMerge") is not None:
+                    overlay = substitute_all(ctx, fe["patchStrategicMerge"])
+                    patched = mutatepkg.strategic_merge(patched, overlay)
+                elif fe.get("patchesJson6902") is not None:
+                    patches = mutatepkg.load_json6902(fe["patchesJson6902"])
+                    patches = substitute_all(ctx, patches)
+                    patched = mutatepkg.apply_json6902(patched, patches)
+            except (SubstitutionError, mutatepkg.PatchError):
+                return None
+            finally:
+                ctx.restore()
+        return patched

@@ -1,0 +1,291 @@
+"""Pod Security Standards evaluation for ``validate.podSecurity`` rules.
+
+Native implementation of the PSS controls the reference gets from
+k8s.io/pod-security-admission (wrapped in pkg/pss/evaluate.go):
+``level: baseline|restricted`` (+ ``version``), with Kyverno
+``exclude`` entries (controlName + optional images globs) suppressing
+individual control failures.
+
+Controls implemented mirror the upstream check registry; each returns
+the list of violating (control, detail) pairs for a pod spec.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..engine.response import RULE_TYPE_VALIDATION, RuleResponse
+from ..utils import wildcard
+
+Violation = Tuple[str, str, str]  # (control, detail, violating image; "" = pod-level)
+
+
+def _pod_spec(resource: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    kind = resource.get("kind")
+    if kind == "Pod":
+        return resource.get("spec") or {}
+    # controller kinds carry a pod template
+    spec = resource.get("spec") or {}
+    template = spec.get("template") or {}
+    if kind == "CronJob":
+        template = ((spec.get("jobTemplate") or {}).get("spec") or {}).get("template") or {}
+    return template.get("spec") if template else None
+
+
+def _all_containers(spec: Dict[str, Any]) -> List[Dict[str, Any]]:
+    out = []
+    for key in ("initContainers", "containers", "ephemeralContainers"):
+        out.extend(spec.get(key) or [])
+    return out
+
+
+# --------------------------------------------------------------------------
+# baseline controls
+
+_BASELINE_DISALLOWED_CAPS = {
+    "AUDIT_CONTROL", "AUDIT_READ", "AUDIT_WRITE", "BLOCK_SUSPEND", "BPF",
+    "CHECKPOINT_RESTORE", "DAC_READ_SEARCH", "IPC_LOCK", "IPC_OWNER",
+    "LEASE", "LINUX_IMMUTABLE", "MAC_ADMIN", "MAC_OVERRIDE", "MKNOD",
+    "NET_ADMIN", "NET_BROADCAST", "NET_RAW", "PERFMON", "SYS_ADMIN",
+    "SYS_BOOT", "SYS_MODULE", "SYS_NICE", "SYS_PACCT", "SYS_PTRACE",
+    "SYS_RAWIO", "SYS_RESOURCE", "SYS_TIME", "SYS_TTY_CONFIG", "SYSLOG",
+    "WAKE_ALARM",
+}
+
+_ALLOWED_VOLUME_TYPES_RESTRICTED = {
+    "configMap", "csi", "downwardAPI", "emptyDir", "ephemeral",
+    "persistentVolumeClaim", "projected", "secret",
+}
+
+
+def _check_host_namespaces(spec, containers) -> List[Violation]:
+    out = []
+    for fieldname in ("hostNetwork", "hostPID", "hostIPC"):
+        if spec.get(fieldname):
+            out.append(("Host Namespaces", f"{fieldname} is not allowed", ""))
+    return out
+
+
+def _check_privileged(spec, containers) -> List[Violation]:
+    return [
+        ("Privileged Containers", f"container {c.get('name')!r} is privileged", c.get("image", ""))
+        for c in containers
+        if (c.get("securityContext") or {}).get("privileged")
+    ]
+
+
+def _check_capabilities_baseline(spec, containers) -> List[Violation]:
+    out = []
+    for c in containers:
+        caps = ((c.get("securityContext") or {}).get("capabilities") or {}).get("add") or []
+        bad = [cap for cap in caps if cap in _BASELINE_DISALLOWED_CAPS or cap == "ALL"]
+        if bad:
+            out.append(("Capabilities", f"container {c.get('name')!r} adds {sorted(bad)}", c.get("image", "")))
+    return out
+
+
+def _check_host_path(spec, containers) -> List[Violation]:
+    return [
+        ("HostPath Volumes", f"volume {v.get('name')!r} uses hostPath", "")
+        for v in spec.get("volumes") or []
+        if "hostPath" in v
+    ]
+
+
+def _check_host_ports(spec, containers) -> List[Violation]:
+    out = []
+    for c in containers:
+        for p in c.get("ports") or []:
+            if p.get("hostPort"):
+                out.append(("Host Ports", f"container {c.get('name')!r} uses hostPort {p['hostPort']}", c.get("image", "")))
+    return out
+
+
+def _check_selinux(spec, containers) -> List[Violation]:
+    allowed = {"", "container_t", "container_init_t", "container_kvm_t", "container_engine_t"}
+    out = []
+    for scope in [spec] + containers:
+        img = scope.get("image", "") if scope is not spec else ""
+        opts = (scope.get("securityContext") or {}).get("seLinuxOptions") or {}
+        if opts.get("type") and opts["type"] not in allowed:
+            out.append(("SELinux", f"seLinuxOptions.type {opts['type']!r} is not allowed", img))
+        if opts.get("user") or opts.get("role"):
+            out.append(("SELinux", "seLinuxOptions user/role may not be set", img))
+    return out
+
+
+def _check_proc_mount(spec, containers) -> List[Violation]:
+    return [
+        ("/proc Mount Type", f"container {c.get('name')!r} uses procMount={sc['procMount']}", c.get("image", ""))
+        for c in containers
+        for sc in [c.get("securityContext") or {}]
+        if sc.get("procMount") not in (None, "Default")
+    ]
+
+
+def _check_seccomp_baseline(spec, containers) -> List[Violation]:
+    out = []
+    for scope, label in [(spec, "pod")] + [(c, c.get("name")) for c in containers]:
+        img = scope.get("image", "") if scope is not spec else ""
+        prof = ((scope.get("securityContext") or {}).get("seccompProfile") or {}).get("type")
+        if prof == "Unconfined":
+            out.append(("Seccomp", f"{label}: seccompProfile.type Unconfined is not allowed", img))
+    return out
+
+
+def _check_sysctls(spec, containers) -> List[Violation]:
+    safe = {
+        "kernel.shm_rmid_forced", "net.ipv4.ip_local_port_range",
+        "net.ipv4.ip_unprivileged_port_start", "net.ipv4.tcp_syncookies",
+        "net.ipv4.ping_group_range", "net.ipv4.tcp_keepalive_time",
+        "net.ipv4.tcp_fin_timeout", "net.ipv4.tcp_keepalive_intvl",
+        "net.ipv4.tcp_keepalive_probes",
+    }
+    out = []
+    for s in (spec.get("securityContext") or {}).get("sysctls") or []:
+        if s.get("name") not in safe:
+            out.append(("Sysctls", f"sysctl {s.get('name')!r} is not allowed", ""))
+    return out
+
+
+def _check_windows_host_process(spec, containers) -> List[Violation]:
+    out = []
+    for scope, label in [(spec, "pod")] + [(c, c.get("name")) for c in containers]:
+        img = scope.get("image", "") if scope is not spec else ""
+        opts = ((scope.get("securityContext") or {}).get("windowsOptions") or {})
+        if opts.get("hostProcess"):
+            out.append(("HostProcess", f"{label}: hostProcess is not allowed", img))
+    return out
+
+
+# --------------------------------------------------------------------------
+# restricted controls
+
+
+def _check_volume_types(spec, containers) -> List[Violation]:
+    out = []
+    for v in spec.get("volumes") or []:
+        kinds = set(v.keys()) - {"name"}
+        bad = kinds - _ALLOWED_VOLUME_TYPES_RESTRICTED
+        if bad:
+            out.append(("Volume Types", f"volume {v.get('name')!r} uses {sorted(bad)}", ""))
+    return out
+
+
+def _check_privilege_escalation(spec, containers) -> List[Violation]:
+    return [
+        ("Privilege Escalation", f"container {c.get('name')!r} must set allowPrivilegeEscalation=false", c.get("image", ""))
+        for c in containers
+        if (c.get("securityContext") or {}).get("allowPrivilegeEscalation") is not False
+    ]
+
+
+def _check_run_as_non_root(spec, containers) -> List[Violation]:
+    pod_level = (spec.get("securityContext") or {}).get("runAsNonRoot")
+    out = []
+    for c in containers:
+        c_level = (c.get("securityContext") or {}).get("runAsNonRoot")
+        effective = c_level if c_level is not None else pod_level
+        if effective is not True:
+            out.append(("Running as Non-root", f"container {c.get('name')!r} must set runAsNonRoot=true", c.get("image", "")))
+    return out
+
+
+def _check_run_as_user(spec, containers) -> List[Violation]:
+    out = []
+    if (spec.get("securityContext") or {}).get("runAsUser") == 0:
+        out.append(("Running as Non-root user", "pod runAsUser=0 is not allowed", ""))
+    for c in containers:
+        if (c.get("securityContext") or {}).get("runAsUser") == 0:
+            out.append(("Running as Non-root user", f"container {c.get('name')!r} runAsUser=0", c.get("image", "")))
+    return out
+
+
+def _check_seccomp_restricted(spec, containers) -> List[Violation]:
+    pod_prof = ((spec.get("securityContext") or {}).get("seccompProfile") or {}).get("type")
+    out = []
+    for c in containers:
+        prof = ((c.get("securityContext") or {}).get("seccompProfile") or {}).get("type")
+        effective = prof if prof is not None else pod_prof
+        if effective not in ("RuntimeDefault", "Localhost"):
+            out.append(("Seccomp", f"container {c.get('name')!r} must set seccompProfile", c.get("image", "")))
+    return out
+
+
+def _check_capabilities_restricted(spec, containers) -> List[Violation]:
+    out = []
+    for c in containers:
+        caps = (c.get("securityContext") or {}).get("capabilities") or {}
+        drops = caps.get("drop") or []
+        if "ALL" not in drops:
+            out.append(("Capabilities", f"container {c.get('name')!r} must drop ALL", c.get("image", "")))
+        adds = set(caps.get("add") or []) - {"NET_BIND_SERVICE"}
+        if adds:
+            out.append(("Capabilities", f"container {c.get('name')!r} adds {sorted(adds)}", c.get("image", "")))
+    return out
+
+
+_BASELINE_CHECKS: List[Tuple[str, Callable]] = [
+    ("Host Namespaces", _check_host_namespaces),
+    ("Privileged Containers", _check_privileged),
+    ("Capabilities", _check_capabilities_baseline),
+    ("HostPath Volumes", _check_host_path),
+    ("Host Ports", _check_host_ports),
+    ("SELinux", _check_selinux),
+    ("/proc Mount Type", _check_proc_mount),
+    ("Seccomp", _check_seccomp_baseline),
+    ("Sysctls", _check_sysctls),
+    ("HostProcess", _check_windows_host_process),
+]
+
+_RESTRICTED_CHECKS: List[Tuple[str, Callable]] = _BASELINE_CHECKS + [
+    ("Volume Types", _check_volume_types),
+    ("Privilege Escalation", _check_privilege_escalation),
+    ("Running as Non-root", _check_run_as_non_root),
+    ("Running as Non-root user", _check_run_as_user),
+    ("Seccomp", _check_seccomp_restricted),
+    ("Capabilities", _check_capabilities_restricted),
+]
+
+
+def evaluate_pss(level: str, resource: Dict[str, Any]) -> List[Violation]:
+    """Run the control set for ``level`` over a pod-bearing resource."""
+    spec = _pod_spec(resource)
+    if spec is None:
+        return []
+    containers = _all_containers(spec)
+    checks = _RESTRICTED_CHECKS if level == "restricted" else _BASELINE_CHECKS
+    out: List[Violation] = []
+    for _, check in checks:
+        out.extend(check(spec, containers))
+    return out
+
+
+def _excluded(violation: Violation, resource: Dict[str, Any], excludes: List[Dict[str, Any]]) -> bool:
+    """pkg/pss exemptExclusions: an exclusion with image globs exempts
+    only violations from containers whose image matches; pod-level
+    violations need an exclusion without image globs."""
+    control, _, image = violation
+    for ex in excludes:
+        if ex.get("controlName") != control:
+            continue
+        globs = ex.get("images") or []
+        if not globs:
+            return True
+        if image and any(wildcard.match(g, image) for g in globs):
+            return True
+    return False
+
+
+def validate_pod_security(rule_name: str, validation, resource: Dict[str, Any]) -> RuleResponse:
+    """Entry point used by the engine for validate.podSecurity rules."""
+    ps = validation.pod_security or {}
+    level = ps.get("level", "baseline")
+    excludes = ps.get("exclude") or []
+    violations = [v for v in evaluate_pss(level, resource) if not _excluded(v, resource, excludes)]
+    if not violations:
+        return RuleResponse.rule_pass(rule_name, RULE_TYPE_VALIDATION, "")
+    detail = "; ".join(f"{c}: {d}" for c, d, _ in violations)
+    return RuleResponse.rule_fail(
+        rule_name, RULE_TYPE_VALIDATION, f"pod security {level!r} checks failed: {detail}"
+    )

@@ -61,3 +61,44 @@ def parse_quantity(s: object) -> Optional[Fraction]:
     # decimal exponent: e.g. "12e6"
     exp = int(suffix[1:])
     return base * (Fraction(10) ** exp if exp >= 0 else Fraction(1, 10 ** (-exp)))
+
+
+def quantity_format(s: str) -> str:
+    """Classify a quantity string's format like k8s does: "BinarySI",
+    "DecimalExponent" or "DecimalSI"."""
+    if any(s.endswith(x) for x in _BINARY):
+        return "BinarySI"
+    if "e" in s or "E" in s:
+        return "DecimalExponent"
+    return "DecimalSI"
+
+
+_BIN_ORDER = [("Ei", 2**60), ("Pi", 2**50), ("Ti", 2**40), ("Gi", 2**30), ("Mi", 2**20), ("Ki", 2**10)]
+_DEC_ORDER = [("E", 10**18), ("P", 10**15), ("T", 10**12), ("G", 10**9), ("M", 10**6), ("k", 10**3)]
+_DEC_SUB = [("m", Fraction(1, 10**3)), ("u", Fraction(1, 10**6)), ("n", Fraction(1, 10**9))]
+
+
+def format_quantity(value: Fraction, fmt: str = "DecimalSI") -> str:
+    """Render a Fraction back to a canonical quantity string, like
+    k8s Quantity.String(): largest suffix that keeps an integral
+    mantissa (BinarySI falls back to decimal when not a 1024-multiple)."""
+    if value == 0:
+        return "0"
+    sign = "-" if value < 0 else ""
+    v = -value if value < 0 else value
+    order = _BIN_ORDER if fmt == "BinarySI" else _DEC_ORDER
+    for suffix, mult in order:
+        q = v / mult
+        if q.denominator == 1 and q.numerator >= 1:
+            return f"{sign}{q.numerator}{suffix}"
+    if v.denominator == 1:
+        return f"{sign}{v.numerator}"
+    for suffix, mult in _DEC_SUB:
+        q = v / mult
+        if q.denominator == 1:
+            return f"{sign}{q.numerator}{suffix}"
+    # non-integral in all suffixes: decimal with up to 9 fractional digits
+    scaled = v * 10**9
+    n = scaled.numerator // scaled.denominator
+    s = f"{n / 10**9:.9f}".rstrip("0").rstrip(".")
+    return sign + s

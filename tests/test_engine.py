@@ -1,0 +1,429 @@
+"""Engine facade tests: the full per-rule pipeline (match -> context ->
+preconditions -> handler) for validate and mutate rules."""
+
+import pytest
+
+from kyverno_tpu.api.policy import ClusterPolicy
+from kyverno_tpu.engine.contextloaders import DataSources
+from kyverno_tpu.engine.engine import Engine
+from kyverno_tpu.engine.policycontext import PolicyContext
+
+
+def make_policy(rules, name="test-policy", action="Enforce"):
+    return ClusterPolicy.from_dict(
+        {
+            "apiVersion": "kyverno.io/v1",
+            "kind": "ClusterPolicy",
+            "metadata": {"name": name},
+            "spec": {"validationFailureAction": action, "rules": rules},
+        }
+    )
+
+
+def pod(name="nginx", ns="default", image="nginx:1.25", labels=None, **spec_extra):
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {"name": name, "namespace": ns, "labels": labels or {}},
+        "spec": {"containers": [{"name": "main", "image": image}], **spec_extra},
+    }
+
+
+def run_validate(policy, resource, **kw):
+    engine = kw.pop("engine", Engine())
+    pctx = PolicyContext.build(policy, resource, **kw)
+    return engine.validate(pctx)
+
+
+class TestValidatePattern:
+    POLICY = make_policy(
+        [
+            {
+                "name": "require-label",
+                "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+                "validate": {
+                    "message": "label app required",
+                    "pattern": {"metadata": {"labels": {"app": "?*"}}},
+                },
+            }
+        ]
+    )
+
+    def test_pass(self):
+        resp = run_validate(self.POLICY, pod(labels={"app": "web"}))
+        assert resp.is_successful()
+        assert resp.policy_response.rules[0].status == "pass"
+
+    def test_fail(self):
+        resp = run_validate(self.POLICY, pod())
+        rr = resp.policy_response.rules[0]
+        assert rr.status == "fail"
+        assert "label app required" in rr.message
+
+    def test_not_matched_no_response(self):
+        cm = {"apiVersion": "v1", "kind": "ConfigMap", "metadata": {"name": "x"}}
+        resp = run_validate(self.POLICY, cm)
+        assert resp.policy_response.rules == []
+
+
+class TestPreconditions:
+    POLICY = make_policy(
+        [
+            {
+                "name": "only-create",
+                "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+                "preconditions": {
+                    "all": [
+                        {"key": "{{request.operation}}", "operator": "Equals", "value": "CREATE"}
+                    ]
+                },
+                "validate": {"message": "m", "pattern": {"metadata": {"labels": {"app": "?*"}}}},
+            }
+        ]
+    )
+
+    def test_precondition_met(self):
+        resp = run_validate(self.POLICY, pod(), operation="CREATE")
+        assert resp.policy_response.rules[0].status == "fail"
+
+    def test_precondition_not_met_skips(self):
+        resp = run_validate(self.POLICY, pod(), operation="UPDATE")
+        assert resp.policy_response.rules[0].status == "skip"
+
+
+class TestDeny:
+    POLICY = make_policy(
+        [
+            {
+                "name": "deny-delete",
+                "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+                "validate": {
+                    "message": "deletes are not allowed",
+                    "deny": {
+                        "conditions": {
+                            "any": [
+                                {
+                                    "key": "{{request.operation}}",
+                                    "operator": "Equals",
+                                    "value": "DELETE",
+                                }
+                            ]
+                        }
+                    },
+                },
+            }
+        ]
+    )
+
+    def test_denied(self):
+        resp = run_validate(self.POLICY, pod(), operation="DELETE")
+        rr = resp.policy_response.rules[0]
+        assert rr.status == "fail" and "deletes are not allowed" in rr.message
+
+    def test_allowed(self):
+        resp = run_validate(self.POLICY, pod(), operation="CREATE")
+        assert resp.policy_response.rules[0].status == "pass"
+
+
+class TestAnyPattern:
+    POLICY = make_policy(
+        [
+            {
+                "name": "either-label",
+                "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+                "validate": {
+                    "message": "need app or tier",
+                    "anyPattern": [
+                        {"metadata": {"labels": {"app": "?*"}}},
+                        {"metadata": {"labels": {"tier": "?*"}}},
+                    ],
+                },
+            }
+        ]
+    )
+
+    def test_first_matches(self):
+        assert run_validate(self.POLICY, pod(labels={"app": "x"})).is_successful()
+
+    def test_second_matches(self):
+        assert run_validate(self.POLICY, pod(labels={"tier": "db"})).is_successful()
+
+    def test_none_match(self):
+        resp = run_validate(self.POLICY, pod())
+        assert resp.policy_response.rules[0].status == "fail"
+
+
+class TestForeach:
+    POLICY = make_policy(
+        [
+            {
+                "name": "no-latest",
+                "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+                "validate": {
+                    "message": "latest tag not allowed",
+                    "foreach": [
+                        {
+                            "list": "request.object.spec.containers",
+                            "pattern": {"image": "!*:latest"},
+                        }
+                    ],
+                },
+            }
+        ]
+    )
+
+    def test_pass(self):
+        assert run_validate(self.POLICY, pod(image="nginx:1.25")).is_successful()
+
+    def test_fail(self):
+        resp = run_validate(self.POLICY, pod(image="nginx:latest"))
+        rr = resp.policy_response.rules[0]
+        assert rr.status == "fail" and "latest" in rr.message
+
+    def test_foreach_with_element_var(self):
+        policy = make_policy(
+            [
+                {
+                    "name": "image-registry",
+                    "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+                    "validate": {
+                        "message": "bad registry",
+                        "foreach": [
+                            {
+                                "list": "request.object.spec.containers",
+                                "deny": {
+                                    "conditions": {
+                                        "all": [
+                                            {
+                                                "key": "{{element.image}}",
+                                                "operator": "AnyIn",
+                                                "value": ["badreg.io/*"],
+                                            }
+                                        ]
+                                    }
+                                },
+                            }
+                        ],
+                    },
+                }
+            ]
+        )
+        assert run_validate(policy, pod(image="good.io/app:1")).is_successful()
+        resp = run_validate(policy, pod(image="badreg.io/app:1"))
+        assert resp.policy_response.rules[0].status == "fail"
+
+
+class TestContextEntries:
+    def test_variable_entry(self):
+        policy = make_policy(
+            [
+                {
+                    "name": "use-var",
+                    "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+                    "context": [
+                        {
+                            "name": "podName",
+                            "variable": {"jmesPath": "request.object.metadata.name"},
+                        }
+                    ],
+                    "validate": {
+                        "message": "m",
+                        "deny": {
+                            "conditions": {
+                                "all": [
+                                    {"key": "{{podName}}", "operator": "Equals", "value": "forbidden"}
+                                ]
+                            }
+                        },
+                    },
+                }
+            ]
+        )
+        assert run_validate(policy, pod("ok")).is_successful()
+        assert not run_validate(policy, pod("forbidden")).is_successful()
+
+    def test_configmap_entry(self):
+        policy = make_policy(
+            [
+                {
+                    "name": "cm-allowlist",
+                    "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+                    "context": [
+                        {
+                            "name": "allowed",
+                            "configMap": {"name": "registries", "namespace": "kyverno"},
+                        }
+                    ],
+                    "validate": {
+                        "message": "registry not allowed",
+                        "deny": {
+                            "conditions": {
+                                "all": [
+                                    {
+                                        "key": "{{request.object.metadata.namespace}}",
+                                        "operator": "AnyNotIn",
+                                        "value": "{{allowed.data.namespaces}}",
+                                    }
+                                ]
+                            }
+                        },
+                    },
+                }
+            ]
+        )
+        sources = DataSources(
+            configmaps={
+                "kyverno/registries": {"data": {"namespaces": '["default", "prod"]'}}
+            }
+        )
+        engine = Engine(data_sources=sources)
+        assert run_validate(policy, pod(ns="default"), engine=engine).is_successful()
+        resp = run_validate(policy, pod(ns="dev"), engine=engine)
+        assert resp.policy_response.rules[0].status == "fail"
+
+
+class TestExceptions:
+    def test_exception_skips_rule(self):
+        policy = TestValidatePattern.POLICY
+        exc = {
+            "apiVersion": "kyverno.io/v2beta1",
+            "kind": "PolicyException",
+            "metadata": {"name": "allow-nginx"},
+            "spec": {
+                "exceptions": [{"policyName": "test-policy", "ruleNames": ["require-label"]}],
+                "match": {"any": [{"resources": {"kinds": ["Pod"], "names": ["nginx"]}}]},
+            },
+        }
+        engine = Engine(exceptions=[exc])
+        resp = run_validate(policy, pod("nginx"), engine=engine)
+        rr = resp.policy_response.rules[0]
+        assert rr.status == "skip" and "allow-nginx" in rr.message
+        # other pods still enforced
+        resp = run_validate(policy, pod("other"), engine=engine)
+        assert resp.policy_response.rules[0].status == "fail"
+
+
+class TestMutate:
+    def test_strategic_merge_add_label(self):
+        policy = make_policy(
+            [
+                {
+                    "name": "add-label",
+                    "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+                    "mutate": {
+                        "patchStrategicMerge": {
+                            "metadata": {"labels": {"+(managed-by)": "kyverno-tpu"}}
+                        }
+                    },
+                }
+            ]
+        )
+        engine = Engine()
+        pctx = PolicyContext.build(policy, pod())
+        resp = engine.mutate(pctx)
+        assert resp.patched_resource["metadata"]["labels"]["managed-by"] == "kyverno-tpu"
+        # existing value is not overwritten
+        pctx = PolicyContext.build(policy, pod(labels={"managed-by": "me"}))
+        resp = engine.mutate(pctx)
+        assert resp.patched_resource["metadata"]["labels"]["managed-by"] == "me"
+
+    def test_strategic_merge_conditional(self):
+        policy = make_policy(
+            [
+                {
+                    "name": "set-pull-policy",
+                    "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+                    "mutate": {
+                        "patchStrategicMerge": {
+                            "spec": {
+                                "containers": [
+                                    {"(image)": "*:latest", "imagePullPolicy": "Always"}
+                                ]
+                            }
+                        }
+                    },
+                }
+            ]
+        )
+        engine = Engine()
+        resp = engine.mutate(PolicyContext.build(policy, pod(image="nginx:latest")))
+        assert resp.patched_resource["spec"]["containers"][0]["imagePullPolicy"] == "Always"
+        resp = engine.mutate(PolicyContext.build(policy, pod(image="nginx:1.25")))
+        assert "imagePullPolicy" not in resp.patched_resource["spec"]["containers"][0]
+
+    def test_json6902(self):
+        policy = make_policy(
+            [
+                {
+                    "name": "patch",
+                    "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+                    "mutate": {
+                        "patchesJson6902": (
+                            "- op: add\n  path: /metadata/labels/patched\n  value: 'yes'\n"
+                        )
+                    },
+                }
+            ]
+        )
+        engine = Engine()
+        resp = engine.mutate(PolicyContext.build(policy, pod()))
+        assert resp.patched_resource["metadata"]["labels"]["patched"] == "yes"
+
+    def test_mutate_with_variable(self):
+        policy = make_policy(
+            [
+                {
+                    "name": "ns-label",
+                    "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+                    "mutate": {
+                        "patchStrategicMerge": {
+                            "metadata": {
+                                "labels": {"ns-copy": "{{request.object.metadata.namespace}}"}
+                            }
+                        }
+                    },
+                }
+            ]
+        )
+        engine = Engine()
+        resp = engine.mutate(PolicyContext.build(policy, pod(ns="prod")))
+        assert resp.patched_resource["metadata"]["labels"]["ns-copy"] == "prod"
+
+
+class TestPodSecurity:
+    def test_restricted(self):
+        policy = make_policy(
+            [
+                {
+                    "name": "pss",
+                    "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+                    "validate": {"podSecurity": {"level": "restricted"}},
+                }
+            ]
+        )
+        good = pod()
+        good["spec"]["containers"][0]["securityContext"] = {
+            "runAsNonRoot": True,
+            "allowPrivilegeEscalation": False,
+            "capabilities": {"drop": ["ALL"]},
+            "seccompProfile": {"type": "RuntimeDefault"},
+        }
+        assert run_validate(policy, good).is_successful()
+        resp = run_validate(policy, pod())
+        assert resp.policy_response.rules[0].status == "fail"
+
+    def test_baseline_host_network(self):
+        policy = make_policy(
+            [
+                {
+                    "name": "pss",
+                    "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+                    "validate": {"podSecurity": {"level": "baseline"}},
+                }
+            ]
+        )
+        assert run_validate(policy, pod()).is_successful()
+        bad = pod(hostNetwork=True)
+        resp = run_validate(policy, bad)
+        rr = resp.policy_response.rules[0]
+        assert rr.status == "fail" and "hostNetwork" in rr.message

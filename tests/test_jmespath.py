@@ -1,0 +1,351 @@
+"""JMESPath engine tests: standard grammar compliance plus the Kyverno
+custom function library (pkg/engine/jmespath/functions.go semantics)."""
+
+import pytest
+
+from kyverno_tpu.engine import jmespath as jp
+from kyverno_tpu.engine.jmespath.errors import (
+    FunctionError,
+    JMESPathError,
+    JMESPathTypeError,
+    UnknownFunctionError,
+)
+
+
+class TestBasics:
+    def test_field(self):
+        assert jp.search("foo", {"foo": 1}) == 1
+        assert jp.search("foo", {"bar": 1}) is None
+        assert jp.search("foo", [1]) is None
+
+    def test_subexpression(self):
+        assert jp.search("foo.bar", {"foo": {"bar": 2}}) == 2
+        assert jp.search("foo.bar.baz", {"foo": {"bar": {"baz": 3}}}) == 3
+        assert jp.search("foo.bar", {"foo": 1}) is None
+
+    def test_quoted_field(self):
+        assert jp.search('"foo.bar"', {"foo.bar": 7}) == 7
+        assert jp.search('foo."with space"', {"foo": {"with space": 8}}) == 8
+
+    def test_index(self):
+        assert jp.search("[1]", [1, 2, 3]) == 2
+        assert jp.search("[-1]", [1, 2, 3]) == 3
+        assert jp.search("[10]", [1]) is None
+        assert jp.search("foo[0]", {"foo": [9]}) == 9
+        assert jp.search("[0]", {"a": 1}) is None
+
+    def test_slice(self):
+        assert jp.search("[0:2]", [0, 1, 2, 3]) == [0, 1]
+        assert jp.search("[::2]", [0, 1, 2, 3]) == [0, 2]
+        assert jp.search("[::-1]", [0, 1, 2]) == [2, 1, 0]
+        assert jp.search("[1:]", [0, 1, 2]) == [1, 2]
+
+    def test_projection(self):
+        data = {"people": [{"name": "a"}, {"name": "b"}, {"age": 3}]}
+        assert jp.search("people[*].name", data) == ["a", "b"]
+        assert jp.search("people[].name", data) == ["a", "b"]
+
+    def test_value_projection(self):
+        data = {"ops": {"a": {"n": 1}, "b": {"n": 2}}}
+        assert sorted(jp.search("ops.*.n", data)) == [1, 2]
+
+    def test_flatten(self):
+        assert jp.search("[]", [[1, 2], [3], 4]) == [1, 2, 3, 4]
+        assert jp.search("a[].b", {"a": [{"b": 1}, {"b": 2}]}) == [1, 2]
+        nested = [[1, [2, 3]], [4]]
+        assert jp.search("[]", nested) == [1, [2, 3], 4]
+
+    def test_filter(self):
+        data = {"machines": [{"name": "a", "state": "up"}, {"name": "b", "state": "down"}]}
+        assert jp.search("machines[?state=='up'].name", data) == ["a"]
+        assert jp.search("machines[?state!='up'].name", data) == ["b"]
+
+    def test_filter_comparators(self):
+        data = [{"n": 1}, {"n": 2}, {"n": 3}]
+        assert jp.search("[?n > `1`].n", data) == [2, 3]
+        assert jp.search("[?n >= `2`].n", data) == [2, 3]
+        assert jp.search("[?n < `2`].n", data) == [1]
+
+    def test_or_and_not(self):
+        assert jp.search("a || b", {"b": 2}) == 2
+        assert jp.search("a || b", {"a": 1, "b": 2}) == 1
+        assert jp.search("a && b", {"a": 1, "b": 2}) == 2
+        assert jp.search("!a", {"a": True}) is False
+        assert jp.search("!a", {}) is True
+
+    def test_pipe(self):
+        data = {"foo": {"bar": [1, 2]}}
+        assert jp.search("foo | bar", data) == [1, 2]
+        assert jp.search("foo.bar | [0]", data) == 1
+
+    def test_multiselect(self):
+        data = {"a": 1, "b": 2, "c": 3}
+        assert jp.search("[a, b]", data) == [1, 2]
+        assert jp.search("{x: a, y: c}", data) == {"x": 1, "y": 3}
+
+    def test_literals(self):
+        assert jp.search("`5`", {}) == 5
+        assert jp.search("'raw'", {}) == "raw"
+        assert jp.search("`[1, 2]`", {}) == [1, 2]
+        assert jp.search("`\"quoted\"`", {}) == "quoted"
+
+    def test_current(self):
+        assert jp.search("@", 42) == 42
+        assert jp.search("[?@ > `1`]", [1, 2, 3]) == [2, 3]
+
+    def test_projection_stops_at_pipe(self):
+        # [*].x | [0] applies [0] to the projected list, not per element
+        data = [{"x": [1]}, {"x": [2]}]
+        assert jp.search("[*].x | [0]", data) == [1]
+        assert jp.search("[*].x[0]", data) == [1, 2]
+
+    def test_truthiness_of_zero(self):
+        # 0 is true in JMESPath
+        assert jp.search("a || b", {"a": 0, "b": 2}) == 0
+
+    def test_parse_errors(self):
+        for expr in ["foo.", "foo..bar", "[", "a =", "foo[", '"unclosed']:
+            with pytest.raises(JMESPathError):
+                jp.search(expr, {})
+
+    def test_nested_admission_shapes(self):
+        # shapes used heavily by kyverno policies
+        request = {
+            "request": {
+                "object": {
+                    "spec": {
+                        "containers": [
+                            {"name": "c1", "image": "nginx:latest"},
+                            {"name": "c2", "image": "redis:7"},
+                        ]
+                    }
+                },
+                "operation": "CREATE",
+            }
+        }
+        assert jp.search("request.object.spec.containers[*].image", request) == [
+            "nginx:latest",
+            "redis:7",
+        ]
+        assert jp.search("request.operation", request) == "CREATE"
+        assert (
+            jp.search("request.object.spec.containers[?name=='c2'].image | [0]", request)
+            == "redis:7"
+        )
+
+
+class TestStandardFunctions:
+    def test_length(self):
+        assert jp.search("length(@)", [1, 2]) == 2
+        assert jp.search("length(@)", "abc") == 3
+        assert jp.search("length(@)", {"a": 1}) == 1
+
+    def test_contains(self):
+        assert jp.search("contains(@, 'a')", ["a", "b"]) is True
+        assert jp.search("contains(@, 'ell')", "hello") is True
+        assert jp.search("contains(@, `1`)", [1, 2]) is True
+
+    def test_sort_and_keys(self):
+        assert jp.search("sort(@)", [3, 1, 2]) == [1, 2, 3]
+        assert sorted(jp.search("keys(@)", {"b": 1, "a": 2})) == ["a", "b"]
+        assert jp.search("sort_by(@, &n)[*].n", [{"n": 3}, {"n": 1}]) == [1, 3]
+
+    def test_min_max_avg(self):
+        assert jp.search("max(@)", [1, 5, 3]) == 5
+        assert jp.search("min(@)", [1, 5, 3]) == 1
+        assert jp.search("avg(@)", [1, 2, 3]) == 2.0
+        assert jp.search("max_by(@, &v).k", [{"k": "a", "v": 1}, {"k": "b", "v": 9}]) == "b"
+
+    def test_to_string_number(self):
+        assert jp.search("to_string(@)", 5) == "5"
+        assert jp.search("to_number(@)", "5") == 5
+        assert jp.search("to_number(@)", "5.5") == 5.5
+        assert jp.search("to_array(@)", 1) == [1]
+
+    def test_merge_join_map(self):
+        assert jp.search("merge(@, `{\"b\": 2}`)", {"a": 1}) == {"a": 1, "b": 2}
+        assert jp.search("join(', ', @)", ["a", "b"]) == "a, b"
+        assert jp.search("map(&n, @)", [{"n": 1}, {}]) == [1, None]
+
+    def test_type(self):
+        assert jp.search("type(@)", "s") == "string"
+        assert jp.search("type(@)", True) == "boolean"
+        assert jp.search("type(@)", None) == "null"
+        assert jp.search("type(@)", 1.5) == "number"
+
+    def test_not_null_reverse(self):
+        assert jp.search("not_null(a, b)", {"b": 3}) == 3
+        assert jp.search("reverse(@)", [1, 2]) == [2, 1]
+        assert jp.search("reverse(@)", "ab") == "ba"
+
+    def test_unknown_function(self):
+        with pytest.raises(UnknownFunctionError):
+            jp.search("nope(@)", {})
+
+    def test_type_errors(self):
+        with pytest.raises(JMESPathTypeError):
+            jp.search("length(@)", 5)
+        with pytest.raises(JMESPathError):
+            jp.search("abs(@)", "x")
+
+
+class TestKyvernoFunctions:
+    def test_strings(self):
+        assert jp.search("to_upper(@)", "abc") == "ABC"
+        assert jp.search("to_lower(@)", "AbC")== "abc"
+        assert jp.search("trim(@, '-')", "--a--") == "a"
+        assert jp.search("trim_prefix(@, 'v')", "v1.2") == "1.2"
+        assert jp.search("split(@, ':')", "a:b:c") == ["a", "b", "c"]
+        assert jp.search("replace_all(@, 'a', 'b')", "banana") == "bbnbnb"
+        assert jp.search("replace(@, 'a', 'x', `1`)", "banana") == "bxnana"
+        assert jp.search("compare(@, 'b')", "a") == -1
+        assert jp.search("equal_fold(@, 'ABC')", "abc") is True
+        assert jp.search("truncate(@, `3`)", "abcdef") == "abc"
+
+    def test_regex(self):
+        assert jp.search("regex_match('^nginx', @)", "nginx:latest") is True
+        assert jp.search("regex_match('^nginx$', @)", "nginx:latest") is False
+        assert jp.search("regex_replace_all('(a)', @, '$1$1')", "abc") == "aabc"
+        assert jp.search("regex_replace_all_literal('a+', @, 'X')", "aaab") == "Xb"
+        # numbers accepted where strings expected
+        assert jp.search("regex_match('^7$', @)", 7) is True
+
+    def test_pattern_and_label_match(self):
+        assert jp.search("pattern_match('nginx*', @)", "nginx:latest") is True
+        assert jp.search("pattern_match('nginx*', @)", "redis") is False
+        data = {"labels": {"app": "web", "tier": "db"}}
+        assert jp.search("label_match(`{\"app\": \"web\"}`, labels)", data) is True
+        assert jp.search("label_match(`{\"app\": \"api\"}`, labels)", data) is False
+
+    def test_to_boolean(self):
+        assert jp.search("to_boolean(@)", "true") is True
+        assert jp.search("to_boolean(@)", "False") is False
+        with pytest.raises(FunctionError):
+            jp.search("to_boolean(@)", "yes")
+
+    def test_arithmetic_scalars(self):
+        assert jp.search("add(`2`, `3`)", {}) == 5
+        assert jp.search("subtract(`5`, `3`)", {}) == 2
+        assert jp.search("multiply(`4`, `3`)", {}) == 12
+        assert jp.search("divide(`10`, `4`)", {}) == 2.5
+        assert jp.search("modulo(`10`, `3`)", {}) == 1
+        assert jp.search("round(`3.14159`, `2`)", {}) == 3.14
+        assert jp.search("sum(@)", [1, 2, 3]) == 6
+
+    def test_arithmetic_quantities(self):
+        assert jp.search("add('1Gi', '1Gi')", {}) == "2Gi"
+        assert jp.search("subtract('2Gi', '1Gi')", {}) == "1Gi"
+        assert jp.search("multiply('2Gi', `2`)", {}) == "4Gi"
+        assert jp.search("divide('4Gi', '2Gi')", {}) == 2.0
+        assert jp.search("sum(@)", ["1Gi", "1Gi"]) == "2Gi"
+
+    def test_arithmetic_durations(self):
+        # note: '30m' parses as a *quantity* (milli) per the reference's
+        # quantity-first operand parsing (arithmetic.go:33-44); use 's'/'h'
+        assert jp.search("add('1h', '30s')", {}) == "1h0m30s"
+        assert jp.search("subtract('1h', '30s')", {}) == "59m30s"
+        assert jp.search("divide('1h', '30s')", {}) == 120.0
+        assert jp.search("add('12s', '13s')", {}) == "25s"
+
+    def test_arithmetic_mixed_rejected(self):
+        with pytest.raises(FunctionError):
+            jp.search("add('1Gi', `3`)", {})
+        with pytest.raises(FunctionError):
+            jp.search("add('1h', '1Gi')", {})
+        # '30m' is a quantity, not a duration => mismatch with '1h'
+        with pytest.raises(FunctionError):
+            jp.search("add('1h', '30m')", {})
+
+    def test_base64(self):
+        assert jp.search("base64_encode(@)", "hi") == "aGk="
+        assert jp.search("base64_decode(@)", "aGk=") == "hi"
+
+    def test_path_canonicalize(self):
+        assert jp.search("path_canonicalize(@)", "/a/b/../c") == "/a/c"
+        assert jp.search("path_canonicalize(@)", "a//b/") == "a/b"
+
+    def test_semver_compare(self):
+        assert jp.search("semver_compare(@, '>=1.0.0')", "1.2.3") is True
+        assert jp.search("semver_compare(@, '<1.0.0')", "1.2.3") is False
+        assert jp.search("semver_compare(@, '>=1.0.0 <2.0.0')", "1.2.3") is True
+        assert jp.search("semver_compare(@, '<1.0.0 || >1.2.0')", "1.2.3") is True
+        assert jp.search("semver_compare(@, '1.2.x')", "1.2.9") is True
+        assert jp.search("semver_compare(@, '1.2.x')", "1.3.0") is False
+        # prerelease ordering
+        assert jp.search("semver_compare(@, '<1.0.0')", "1.0.0-alpha") is True
+
+    def test_parse_json_yaml(self):
+        assert jp.search("parse_json(@)", '{"a": 1}') == {"a": 1}
+        assert jp.search("parse_yaml(@)", "a:\n  b: 2") == {"a": {"b": 2}}
+
+    def test_lookup_items_object_from_lists(self):
+        assert jp.search("lookup(@, 'a')", {"a": 5}) == 5
+        assert jp.search("lookup(@, `1`)", ["x", "y"]) == "y"
+        assert jp.search("items(@, 'k', 'v')", {"b": 2, "a": 1}) == [
+            {"k": "a", "v": 1},
+            {"k": "b", "v": 2},
+        ]
+        assert jp.search("object_from_lists(`[\"a\",\"b\"]`, `[1,2]`)", {}) == {"a": 1, "b": 2}
+
+    def test_sha256(self):
+        assert (
+            jp.search("sha256(@)", "abc")
+            == "ba7816bf8f01cfea414140de5dae2223b00361a396177a9cb410ff61f20015ad"
+        )
+
+    def test_random(self):
+        out = jp.search("random('[a-z]{8}')", {})
+        assert len(out) == 8 and out.islower()
+        out = jp.search("random('pre-[0-9]{4}')", {})
+        assert out.startswith("pre-") and len(out) == 8
+
+    def test_image_normalize(self):
+        assert jp.search("image_normalize(@)", "nginx") == "docker.io/library/nginx:latest"
+        assert jp.search("image_normalize(@)", "nginx:1.2") == "docker.io/library/nginx:1.2"
+        assert (
+            jp.search("image_normalize(@)", "ghcr.io/org/app:v1") == "ghcr.io/org/app:v1"
+        )
+        assert (
+            jp.search("image_normalize(@)", "org/app") == "docker.io/org/app:latest"
+        )
+
+    def test_time_functions(self):
+        assert jp.search("time_diff('2023-01-01T00:00:00Z', '2023-01-01T01:30:00Z')", {}) == "1h30m0s"
+        assert jp.search("time_before('2023-01-01T00:00:00Z', '2024-01-01T00:00:00Z')", {}) is True
+        assert jp.search("time_after('2023-01-01T00:00:00Z', '2024-01-01T00:00:00Z')", {}) is False
+        assert (
+            jp.search(
+                "time_between('2023-06-01T00:00:00Z', '2023-01-01T00:00:00Z', '2024-01-01T00:00:00Z')",
+                {},
+            )
+            is True
+        )
+        assert jp.search("time_add('2023-01-01T00:00:00Z', '90m')", {}) == "2023-01-01T01:30:00Z"
+        assert jp.search("time_utc('2023-01-01T05:00:00+05:00')", {}) == "2023-01-01T00:00:00Z"
+        assert jp.search("time_to_cron('2023-02-02T15:04:00Z')", {}) == "4 15 2 2 4"
+        assert (
+            jp.search("time_parse('2006-01-02', '2023-05-30')", {}) == "2023-05-30T00:00:00Z"
+        )
+        assert (
+            jp.search("time_truncate('2023-01-01T10:35:21Z', '1h')", {})
+            == "2023-01-01T10:00:00Z"
+        )
+        assert (
+            jp.search(
+                "time_since('', '2023-01-01T00:00:00Z', '2023-01-02T00:00:00Z')", {}
+            )
+            == "24h0m0s"
+        )
+
+
+class TestGoDurationFormat:
+    def test_format(self):
+        from kyverno_tpu.engine.jmespath.gotime import format_go_duration
+
+        assert format_go_duration(0) == "0s"
+        assert format_go_duration(1500) == "1.5µs"
+        assert format_go_duration(90 * 60 * 10**9) == "1h30m0s"
+        assert format_go_duration(500_000_000) == "500ms"
+        assert format_go_duration(-(2 * 60 + 30) * 10**9) == "-2m30s"
+        assert format_go_duration(3600 * 10**9) == "1h0m0s"
+        assert format_go_duration(1_500_000_000) == "1.5s"

