@@ -186,6 +186,61 @@ def _set_in(keys: List[str], value: Any, mode: str) -> bool:
     return False
 
 
+def _deprecated_in(key: Any, value: Any, not_in: bool) -> bool:
+    """Deprecated In/NotIn (in.go): stricter than the AnyIn family —
+    no InRange, no lenient singleton fallback for non-JSON strings,
+    exact (non-wildcard) membership for list keys, and invalid types
+    evaluate to false for BOTH In and NotIn."""
+    if isinstance(key, bool) or isinstance(key, (int, float)):
+        key = _go_sprint(key)
+    if isinstance(key, str):
+        # keyExistsInArray (in.go:60)
+        if isinstance(value, list):
+            exists = any(_wild_either(_go_sprint(v), key) for v in value)
+            return (not exists) if not_in else exists
+        if isinstance(value, str):
+            if wildcard.match(value, key):
+                return not not_in
+            try:
+                arr = json.loads(value)
+            except ValueError:
+                return False  # invalidType
+            if not isinstance(arr, list) or not all(isinstance(x, str) for x in arr):
+                return False  # invalidType
+            exists = key in arr
+            return (not exists) if not_in else exists
+        return False  # invalidType
+    if isinstance(key, list):
+        keys = []
+        for k in key:
+            if not isinstance(k, str):
+                return False  # in.go:35-40: non-string key elements
+            keys.append(k)
+        # setExistsInArray (in.go:108): exact membership, no wildcards
+        if isinstance(value, list):
+            vals = []
+            for v in value:
+                if not isinstance(v, str):
+                    return False  # invalidType
+                vals.append(v)
+        elif isinstance(value, str):
+            if len(keys) == 1 and keys[0] == value:
+                return True  # quirk: early keyExists even for NotIn
+            try:
+                arr = json.loads(value)
+            except ValueError:
+                return False
+            if not isinstance(arr, list) or not all(isinstance(x, str) for x in arr):
+                return False
+            vals = arr
+        else:
+            return False
+        if not_in:
+            return any(k not in set(vals) for k in keys)
+        return all(k in set(vals) for k in keys)
+    return False
+
+
 def _membership(key: Any, value: Any, mode: str) -> bool:
     if isinstance(key, bool) or isinstance(key, (int, float)):
         key = _go_sprint(key)
@@ -306,14 +361,13 @@ def evaluate_condition_values(key: Any, operator: str, value: Any) -> bool:
     if op in ("notequal", "notequals"):
         return not _equals(key, value)
     if op == "in":
-        return _membership(key, value, "all_in")
+        return _deprecated_in(key, value, not_in=False)
     if op == "anyin":
         return _membership(key, value, "any_in")
     if op == "allin":
         return _membership(key, value, "all_in")
     if op == "notin":
-        # deprecated NotIn == isNotIn (in.go:164-179): any key missing
-        return _membership(key, value, "any_not_in")
+        return _deprecated_in(key, value, not_in=True)
     if op == "anynotin":
         return _membership(key, value, "any_not_in")
     if op == "allnotin":

@@ -129,17 +129,20 @@ class Context:
     def _load_deferred(self, query: str) -> None:
         if not self._deferred:
             return
-        remaining = []
-        for name, loader in self._deferred:
-            if _query_references(query, name):
-                try:
-                    value = loader()
-                except Exception as e:  # loader errors surface on query
-                    raise ContextEntryError(f"failed to load context entry {name!r}: {e}")
-                self.add_context_entry(name, value)
-            else:
-                remaining.append((name, loader))
-        self._deferred = remaining
+        matched = [e for e in self._deferred if _query_references(query, e[0])]
+        for entry in matched:
+            # unregister BEFORE invoking: a loader that itself queries
+            # another deferred entry (or raises) must never cause an
+            # already-executed loader to be resurrected and re-run
+            if entry not in self._deferred:
+                continue  # a nested query already loaded it
+            self._deferred.remove(entry)
+            name, loader = entry
+            try:
+                value = loader()
+            except Exception as e:  # loader errors surface on query
+                raise ContextEntryError(f"failed to load context entry {name!r}: {e}")
+            self.add_context_entry(name, value)
 
     # -- checkpointing (context.go Checkpoint/Restore/Reset)
 

@@ -285,6 +285,8 @@ class Engine:
         applied = 0
         element_scope = fe.get("elementScope", True)
         for i, element in enumerate(elements):
+            if element is None:
+                continue  # validate_resource.go:212 skips nil elements
             ctx.checkpoint()
             try:
                 try:
@@ -382,6 +384,8 @@ class Engine:
         if not isinstance(elements, list):
             return patched
         for i, element in enumerate(elements):
+            if element is None:
+                continue  # mutation/common.go:83 skips nil elements
             ctx.checkpoint()
             try:
                 ctx.add_element(element, i)

@@ -77,11 +77,56 @@ def _go_regex(pattern: str) -> "re.Pattern":
         raise FunctionError(f"invalid regex {pattern!r}: {e}")
 
 
-def _go_repl(repl: str) -> str:
-    # Go replacement templates use $1 / ${name}; Python uses \1 / \g<name>
-    repl = re.sub(r"\$\{(\w+)\}", r"\\g<\1>", repl)
-    repl = re.sub(r"\$(\d+)", r"\\\1", repl)
-    return repl.replace("$$", "$")
+def _go_repl(repl: str):
+    """Go regexp.Expand template semantics as a replacement callable:
+    ``$$`` is a literal $, ``$name``/``${name}`` reference groups by
+    number or name with the longest \\w+ run, and undefined groups
+    expand to the empty string (never an error)."""
+
+    def group_or_empty(m, name: str) -> str:
+        try:
+            g = m.group(int(name)) if name.isdigit() else m.group(name)
+        except (IndexError, re.error):
+            return ""
+        return g or ""
+
+    def expand(m) -> str:
+        out = []
+        i, n = 0, len(repl)
+        while i < n:
+            c = repl[i]
+            if c != "$":
+                out.append(c)
+                i += 1
+                continue
+            if i + 1 >= n:
+                out.append("$")
+                break
+            nxt = repl[i + 1]
+            if nxt == "$":
+                out.append("$")
+                i += 2
+                continue
+            if nxt == "{":
+                j = repl.find("}", i + 2)
+                name = repl[i + 2 : j] if j != -1 else ""
+                if j == -1 or not re.fullmatch(r"\w+", name):
+                    out.append("$")
+                    i += 1
+                    continue
+                out.append(group_or_empty(m, name))
+                i = j + 1
+                continue
+            mm = re.match(r"\w+", repl[i + 1 :])
+            if not mm:
+                out.append("$")
+                i += 1
+                continue
+            out.append(group_or_empty(m, mm.group(0)))
+            i += 1 + len(mm.group(0))
+        return "".join(out)
+
+    return expand
 
 
 # ---------------------------------------------------------------------------
@@ -503,7 +548,10 @@ def _fn_round(fn, args):
     if length < 0:
         raise FunctionError("round: length must be non-negative")
     shift = 10 ** int(length)
-    return math.floor(op * shift + 0.5) / shift
+    # Go math.Round: half away from zero (functions.go jpRound)
+    scaled = op * shift
+    rounded = math.floor(scaled + 0.5) if scaled >= 0 else math.ceil(scaled - 0.5)
+    return rounded / shift
 
 
 def _fn_base64_decode(fn, args):

@@ -163,8 +163,6 @@ class Parser:
         if ttype == "dot":
             if self._current_type() != "star":
                 right = self._parse_dot_rhs(BINDING_POWER["dot"])
-                if left[0] == "subexpression":
-                    return ("subexpression", left, right)
                 return ("subexpression", left, right)
             # creates a value projection: foo.*
             self._advance()
@@ -212,9 +210,6 @@ class Parser:
         if ttype == "lbracket":
             if self._current_type() in ("number", "colon"):
                 right = self._parse_index_expression()
-                if left[0] == "index_expression":
-                    # chained indexing: a[0][1]
-                    return self._project_if_slice(left, right)
                 return self._project_if_slice(left, right)
             if self._current_type() == "star" and self._lookahead(1) == "rbracket":
                 self._advance()

@@ -145,7 +145,9 @@ def _substitute_vars_in_string(ctx: Optional[Context], value: str, path: str, re
         original_pattern = value
         for _, var_text in matches:
             variable = var_text[2:-2].strip()
-            if "@" in variable:
+            # only the bare {{@}} expands (vars.go:332 `variable == "@"`);
+            # an @ inside an expression (keys(@)) is JMESPath current-node
+            if variable == "@":
                 variable = _expand_at(variable, path, ctx)
             if ctx is not None and ctx.query_operation() == "DELETE":
                 variable = variable.replace("request.object", "request.oldObject")

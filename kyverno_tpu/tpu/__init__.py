@@ -1,0 +1,21 @@
+"""TPU device plane: policy compilation + batched evaluation.
+
+The scalar engine (kyverno_tpu.engine) is the semantic oracle; this
+package compiles a policy set into a trace-time-specialized JAX
+program that evaluates the policy x resource cross-product as one
+batched device computation:
+
+- flatten:   resource JSON -> padded row tables (path hashes, typed
+             value lanes pre-parsed on host, byte pool for globs)
+- metadata:  match/exclude features (GVK, name/ns bytes, label hashes)
+- ir:        Rule -> device IR with capability analysis; rules using
+             constructs outside the device subset fall back to the
+             scalar engine per rule (never wrong, only slower)
+- evaluator: IR -> jitted batch program, vmapped over resources and
+             unrolled over rules; MXU-friendly instance joins
+- engine:    TpuEngine facade + sharded scan entry points
+"""
+
+from .compiler import CompiledPolicySet, compile_policy_set
+from .engine import ScanResult, TpuEngine
+

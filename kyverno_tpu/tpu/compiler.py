@@ -1,0 +1,94 @@
+"""Policy set -> compiled device artifact.
+
+compile_policy_set lowers every validate rule of every policy through
+the IR compiler (ir.py). Rules using constructs outside the device
+subset are recorded as host rules — the TpuEngine completes their
+verdicts with the scalar engine, so a compiled set always covers the
+full policy list (device where possible, host elsewhere).
+
+The compiled artifact is keyed by the policy set content; recompiling
+only happens when policies change (the reference's analogous concern is
+webhook/policycache refresh on policy resourceVersion changes).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
+
+import jax
+
+from ..api.policy import ClusterPolicy, Rule
+from .evaluator import build_program
+from .flatten import EncodeConfig
+from .ir import RuleProgram, Unsupported, compile_rule
+from .metadata import MetaConfig
+
+
+@dataclass
+class RuleEntry:
+    policy_idx: int
+    policy_name: str
+    rule_name: str
+    device_row: Optional[int]      # row in the device verdict table
+    fallback_reason: Optional[str]  # set for host rules
+
+
+@dataclass
+class CompiledPolicySet:
+    policies: List[ClusterPolicy]
+    rules: List[RuleEntry]
+    device_programs: List[RuleProgram]
+    byte_paths: Set[int]
+    encode_cfg: EncodeConfig
+    meta_cfg: MetaConfig
+    _fn: Optional[Callable] = field(default=None, repr=False)
+
+    @property
+    def host_rule_policies(self) -> List[int]:
+        """Policy indices owning at least one host-fallback rule."""
+        return sorted({e.policy_idx for e in self.rules if e.device_row is None})
+
+    def device_fn(self) -> Callable:
+        """The jitted batch program (compiled lazily, cached)."""
+        if self._fn is None:
+            self._fn = jax.jit(
+                build_program(self.device_programs, self.encode_cfg.max_instances)
+            )
+        return self._fn
+
+    def coverage(self) -> Tuple[int, int]:
+        dev = sum(1 for e in self.rules if e.device_row is not None)
+        return dev, len(self.rules)
+
+
+def compile_policy_set(
+    policies: Sequence[ClusterPolicy],
+    encode_cfg: Optional[EncodeConfig] = None,
+    meta_cfg: Optional[MetaConfig] = None,
+) -> CompiledPolicySet:
+    encode_cfg = encode_cfg or EncodeConfig()
+    meta_cfg = meta_cfg or MetaConfig()
+    entries: List[RuleEntry] = []
+    programs: List[RuleProgram] = []
+    byte_paths: Set[int] = set()
+    for pi, policy in enumerate(policies):
+        for rule in policy.get_rules():
+            if not rule.has_validate():
+                continue
+            try:
+                prog = compile_rule(policy, rule)
+                row = len(programs)
+                programs.append(prog)
+                byte_paths |= prog.byte_paths
+                entries.append(RuleEntry(pi, policy.name, rule.name, row, None))
+            except Unsupported as e:
+                entries.append(RuleEntry(pi, policy.name, rule.name, None, str(e)))
+    return CompiledPolicySet(
+        policies=list(policies),
+        rules=entries,
+        device_programs=programs,
+        byte_paths=byte_paths,
+        encode_cfg=encode_cfg,
+        meta_cfg=meta_cfg,
+    )

@@ -1,0 +1,190 @@
+"""TpuEngine — batch policy evaluation over the device plane.
+
+The scan-path equivalent of the reference's reports-controller hot loop
+(pkg/controllers/report/background/controller.go:299 reconcileReport ->
+engine.Validate per policy): encode the resource snapshot once, then
+evaluate the full policy x resource cross-product as one device
+program. Rules the IR compiler cannot lower (RuleEntry.fallback_reason)
+and resources exceeding encode caps are completed with the scalar
+engine, so results always cover everything.
+
+Verdict codes follow evaluator.py: 0 PASS, 1 SKIP, 2 FAIL,
+3 NOT_MATCHED, 4 ERROR (5 HOST never escapes — it is resolved here).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..api.policy import ClusterPolicy
+from ..engine.context import Context
+from ..engine.engine import Engine as ScalarEngine
+from ..engine.match import RequestInfo
+from ..engine.policycontext import PolicyContext
+from ..engine.response import EngineResponse
+from .compiler import CompiledPolicySet, compile_policy_set
+from .evaluator import ERROR, FAIL, HOST, NOT_MATCHED, PASS, SKIP, batch_to_device
+from .flatten import EncodeConfig, encode_resources
+from .metadata import MetaConfig, encode_metadata
+
+VERDICT_NAMES = {PASS: "pass", SKIP: "skip", FAIL: "fail",
+                 NOT_MATCHED: "not_matched", ERROR: "error"}
+
+_STATUS_TO_CODE = {"pass": PASS, "skip": SKIP, "fail": FAIL, "error": ERROR}
+
+
+def build_scan_context(
+    policy: ClusterPolicy,
+    resource: Dict[str, Any],
+    namespace_labels: Optional[Dict[str, str]],
+    operation: str = "",
+    admission_info: Optional[RequestInfo] = None,
+) -> PolicyContext:
+    """Background-scan PolicyContext: request.operation stays absent
+    unless a real admission operation exists (the charts' preconditions
+    rely on `request.operation || 'BACKGROUND'`). Match-gating still
+    defaults to CREATE (MatchesResourceDescription's default)."""
+    ctx = Context()
+    ctx.add_resource(resource)
+    if operation:
+        ctx.add_operation(operation)
+    info = admission_info or RequestInfo()
+    ctx.add_user_info({"username": info.username, "uid": info.uid, "groups": info.groups})
+    return PolicyContext(
+        policy=policy,
+        new_resource=resource,
+        admission_info=info,
+        namespace_labels=namespace_labels or {},
+        operation=operation or "CREATE",
+        json_context=ctx,
+    )
+
+
+@dataclass
+class ScanResult:
+    """(num_rules_total, N) verdict table + rule metadata."""
+
+    verdicts: np.ndarray
+    rules: List[Tuple[str, str]]  # (policy_name, rule_name) per row
+
+    def counts(self) -> Dict[str, int]:
+        out = {name: int((self.verdicts == code).sum()) for code, name in VERDICT_NAMES.items()}
+        return out
+
+    def violations(self) -> List[Tuple[int, int]]:
+        """(rule_row, resource_idx) pairs with FAIL verdicts."""
+        rows, cols = np.nonzero(self.verdicts == FAIL)
+        return list(zip(rows.tolist(), cols.tolist()))
+
+
+def _scalar_rule_verdicts(
+    engine: ScalarEngine, policy: ClusterPolicy, pctx: PolicyContext
+) -> Dict[str, int]:
+    """Run the scalar engine for one (policy, resource); map each
+    validate rule to a verdict code (absent response = not matched)."""
+    response: EngineResponse = engine.validate(pctx)
+    got = {rr.name: _STATUS_TO_CODE.get(rr.status, ERROR) for rr in response.policy_response.rules}
+    out: Dict[str, int] = {}
+    for rule in policy.get_rules():
+        if rule.has_validate():
+            out[rule.name] = got.get(rule.name, NOT_MATCHED)
+    return out
+
+
+class TpuEngine:
+    """Compile once, scan many — the device-backed engineapi.Engine
+    slice for background scans and CLI apply."""
+
+    def __init__(
+        self,
+        policies: Sequence[ClusterPolicy],
+        encode_cfg: Optional[EncodeConfig] = None,
+        meta_cfg: Optional[MetaConfig] = None,
+    ):
+        self.cps: CompiledPolicySet = compile_policy_set(policies, encode_cfg, meta_cfg)
+        self.scalar = ScalarEngine()
+
+    # -- encoding
+
+    def encode(
+        self,
+        resources: Sequence[Dict[str, Any]],
+        namespace_labels: Optional[Dict[str, Dict[str, str]]] = None,
+        operations: Optional[Sequence[str]] = None,
+        admission_infos: Optional[Sequence[Optional[RequestInfo]]] = None,
+    ):
+        rows = encode_resources(resources, self.cps.encode_cfg, self.cps.byte_paths)
+        meta = encode_metadata(resources, namespace_labels, operations,
+                               admission_infos, self.cps.meta_cfg)
+        return batch_to_device(rows, meta), rows, meta
+
+    # -- evaluation
+
+    def scan(
+        self,
+        resources: Sequence[Dict[str, Any]],
+        namespace_labels: Optional[Dict[str, Dict[str, str]]] = None,
+        operations: Optional[Sequence[str]] = None,
+        admission_infos: Optional[Sequence[Optional[RequestInfo]]] = None,
+    ) -> ScanResult:
+        batch, rows, meta = self.encode(resources, namespace_labels, operations, admission_infos)
+        device_table = np.asarray(self.cps.device_fn()(batch))  # (D, N)
+        return self.assemble(
+            device_table, resources, namespace_labels, operations, admission_infos
+        )
+
+    def assemble(
+        self,
+        device_table: np.ndarray,
+        resources: Sequence[Dict[str, Any]],
+        namespace_labels: Optional[Dict[str, Dict[str, str]]] = None,
+        operations: Optional[Sequence[str]] = None,
+        admission_infos: Optional[Sequence[Optional[RequestInfo]]] = None,
+    ) -> ScanResult:
+        """Merge device verdicts with host completions (host rules +
+        HOST-flagged resources)."""
+        n = len(resources)
+        total = np.full((len(self.cps.rules), n), NOT_MATCHED, dtype=np.int32)
+        ns_labels = namespace_labels or {}
+
+        # which (policy, resource) pairs need the scalar engine?
+        host_cells: Dict[Tuple[int, int], None] = {}
+        for ri, entry in enumerate(self.cps.rules):
+            if entry.device_row is None:
+                for ci in range(n):
+                    host_cells[(entry.policy_idx, ci)] = None
+            else:
+                row = device_table[entry.device_row]
+                total[ri] = row
+                for ci in np.nonzero(row == HOST)[0]:
+                    host_cells[(entry.policy_idx, int(ci))] = None
+
+        cache: Dict[Tuple[int, int], Dict[str, int]] = {}
+        for (pi, ci) in host_cells:
+            policy = self.cps.policies[pi]
+            res = resources[ci]
+            kind = res.get("kind", "")
+            ns = (res.get("metadata") or {}).get("namespace", "")
+            nsl = ns_labels.get((res.get("metadata") or {}).get("name", "") if kind == "Namespace" else ns, {})
+            op = (operations[ci] if operations else "") or ""
+            info = admission_infos[ci] if admission_infos else None
+            pctx = build_scan_context(policy, res, nsl, op, info)
+            cache[(pi, ci)] = _scalar_rule_verdicts(self.scalar, policy, pctx)
+        for ri, entry in enumerate(self.cps.rules):
+            for (pi, ci), verdicts in cache.items():
+                if pi == entry.policy_idx and entry.rule_name in verdicts:
+                    if entry.device_row is None or total[ri, ci] == HOST:
+                        total[ri, ci] = verdicts[entry.rule_name]
+
+        return ScanResult(
+            verdicts=total,
+            rules=[(e.policy_name, e.rule_name) for e in self.cps.rules],
+        )
+
+    # -- introspection
+
+    def coverage(self) -> Tuple[int, int]:
+        return self.cps.coverage()

@@ -1,0 +1,1055 @@
+"""IR -> JAX batch program (trace-time specialization).
+
+The policy set compiles into ONE jitted function evaluating every
+device rule against every resource in a batch:
+
+    fn(batch: dict[str, jnp.ndarray]) -> (num_rules, N) int32 verdicts
+
+Design choices (tpu-first):
+- trace-time unrolling over rules and pattern nodes: the policy set is
+  static per compiled artifact, so the tree walk happens at trace time
+  and the device program is pure vector ops — no dynamic control flow,
+  no string handling, static shapes throughout;
+- per-instance anchor semantics inside arrays-of-maps are masked
+  reductions over row tables, aggregated with one-hot einsums over the
+  scope index (MXU-friendly int8/f32 matmuls instead of scatters);
+- string comparisons are canonical-hash equalities; glob operands run
+  a bit-parallel NFA (lax.scan over padded byte tensors) against the
+  policy-aware byte pool;
+- the three-valued outcome algebra {PASS, SKIP, FAIL} reproduces the
+  reference's anchor fail/skip classification (validate.go:36-53,
+  anchor/handlers.go) exactly, including phase-1/phase-2 ordering.
+
+Verdict codes: 0 PASS, 1 SKIP, 2 FAIL, 3 NOT_MATCHED, 4 ERROR,
+5 HOST (resource exceeded encode caps -> host fallback).
+"""
+
+from __future__ import annotations
+
+import functools
+from fractions import Fraction
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..engine import pattern as patternpkg
+from ..engine.operator import Operator
+from ..utils.duration import parse_duration
+from ..utils.quantity import parse_quantity
+from .flatten import T_ARR, T_BOOL, T_MAP, T_NULL, T_NUM, T_STR, RowBatch, go_sprint
+from .hashing import (
+    ARRAY_SEG,
+    canon_duration,
+    canon_number,
+    canon_quantity,
+    hash_path,
+    hash_str,
+    split32,
+)
+from .ir import (
+    AnchorChild,
+    ArrayMapsNode,
+    ArrayScalarNode,
+    BoolLeaf,
+    CondIR,
+    CondTreeIR,
+    Cmp,
+    ExistenceNode,
+    FilterIR,
+    LeafNode,
+    MapNode,
+    MatchIR,
+    Node,
+    NullLeaf,
+    NumLeaf,
+    OpKey,
+    PathCollect,
+    RuleProgram,
+    StrLeaf,
+    Unsupported,
+)
+from .metadata import MetaBatch, OP_CODES
+
+PASS, SKIP, FAIL, NOT_MATCHED, ERROR, HOST = 0, 1, 2, 3, 4, 5
+
+
+# ---------------------------------------------------------------------------
+# batch assembly
+
+
+def batch_to_device(rows: RowBatch, meta: MetaBatch) -> Dict[str, jnp.ndarray]:
+    out = {k: jnp.asarray(v) for k, v in rows.arrays().items()}
+    for k, v in meta.arrays().items():
+        out["meta_" + k] = jnp.asarray(v)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# trace-time context with memoization
+
+
+class Ctx:
+    def __init__(self, batch: Dict[str, jnp.ndarray], max_instances: int):
+        self.b = batch
+        self.I = max_instances
+        n, r = batch["norm_hi"].shape
+        self.N, self.R = n, r
+        self._row_masks: Dict[Tuple[int, str], jnp.ndarray] = {}
+        self._glob_cache: Dict[Tuple[str, str], jnp.ndarray] = {}
+        self._oh: Optional[jnp.ndarray] = None
+        self._valid = batch["valid"].astype(bool)
+
+    # -- row masks
+
+    def rows_at(self, path: Tuple[str, ...]) -> jnp.ndarray:
+        return self._mask(hash_path(path), "norm")
+
+    def rows_with_parent(self, path: Tuple[str, ...]) -> jnp.ndarray:
+        return self._mask(hash_path(path), "parent")
+
+    def _mask(self, h: int, lane: str) -> jnp.ndarray:
+        key = (h, lane)
+        if key not in self._row_masks:
+            hi, lo = split32(h)
+            m = (
+                (self.b[lane + "_hi"] == np.uint32(hi))
+                & (self.b[lane + "_lo"] == np.uint32(lo))
+                & self._valid
+            )
+            self._row_masks[key] = m
+        return self._row_masks[key]
+
+    def heq(self, lane: str, h: int) -> jnp.ndarray:
+        hi, lo = split32(h)
+        return (self.b[lane + "_hi"] == np.uint32(hi)) & (self.b[lane + "_lo"] == np.uint32(lo))
+
+    def hset(self, lane: str, hashes: Sequence[int]) -> jnp.ndarray:
+        if not hashes:
+            return jnp.zeros((self.N, self.R), dtype=bool)
+        acc = self.heq(lane, hashes[0])
+        for h in hashes[1:]:
+            acc = acc | self.heq(lane, h)
+        return acc
+
+    def type_is(self, t: int) -> jnp.ndarray:
+        return self.b["type_tag"] == np.uint8(t)
+
+    @property
+    def onehot(self) -> jnp.ndarray:
+        """(N, R, I) f32 one-hot of scope1 — shared by all instance
+        aggregations; einsum against it is an MXU matmul."""
+        if self._oh is None:
+            s1 = self.b["scope1"]
+            oh = (s1[:, :, None] == jnp.arange(self.I, dtype=np.int32)[None, None, :])
+            self._oh = (oh & self._valid[:, :, None]).astype(jnp.float32)
+        return self._oh
+
+    # -- glob NFA over pool bytes; returns (N, K) accepts per pool slot
+
+    def glob_pool(self, pattern: str) -> jnp.ndarray:
+        key = (pattern, "pool")
+        if key not in self._glob_cache:
+            self._glob_cache[key] = glob_match(pattern, self.b["pool"], self.b["pool_len"])
+        return self._glob_cache[key]
+
+    def glob_meta(self, pattern: str, which: str) -> jnp.ndarray:
+        """which: name | ns | user. Returns (N,) accepts."""
+        key = (pattern, which)
+        if key not in self._glob_cache:
+            self._glob_cache[key] = glob_match(
+                pattern, self.b[f"meta_{which}_bytes"], self.b[f"meta_{which}_len"]
+            )
+        return self._glob_cache[key]
+
+    def glob_rows(self, pattern: str) -> jnp.ndarray:
+        """(N, R) glob accept per row via its byte-pool slot (False when
+        the row has no slot)."""
+        acc = self.glob_pool(pattern)  # (N, K)
+        slot = self.b["byte_slot"]
+        safe = jnp.clip(slot, 0, acc.shape[1] - 1)
+        got = jnp.take_along_axis(acc, safe.reshape(self.N, -1), axis=1).reshape(slot.shape)
+        return got & (slot >= 0)
+
+
+def glob_match(pattern: str, bytes_: jnp.ndarray, lens: jnp.ndarray) -> jnp.ndarray:
+    """Glob (*/?) NFA over padded byte tensors. bytes_: (..., B) uint8,
+    lens: (...) int32 -> (...) bool. Pad bytes are 0 and match nothing;
+    acceptance is read at position len."""
+    # collapse runs of '*'
+    chars: List[str] = []
+    for c in pattern:
+        if c == "*" and chars and chars[-1] == "*":
+            continue
+        chars.append(c)
+    m = len(chars)
+    lead = bytes_.shape[:-1]
+    B = bytes_.shape[-1]
+
+    def closure(dp_cols: List[jnp.ndarray]) -> List[jnp.ndarray]:
+        # epsilon moves: '*' at j-1 lets dp[j-1] flow into dp[j]
+        out = list(dp_cols)
+        for j in range(1, m + 1):
+            if chars[j - 1] == "*":
+                out[j] = out[j] | out[j - 1]
+        return out
+
+    dp0 = [jnp.ones(lead, dtype=bool)] + [jnp.zeros(lead, dtype=bool)] * m
+    dp0 = closure(dp0)
+
+    def step(dp, c):
+        cols = [jnp.moveaxis(dp, -1, 0)[j] for j in range(m + 1)]
+        new = [jnp.zeros(lead, dtype=bool)]
+        for j in range(1, m + 1):
+            pc = chars[j - 1]
+            if pc == "*":
+                new.append(cols[j])  # self-loop; epsilon handled in closure
+            elif pc == "?":
+                new.append(cols[j - 1] & (c != 0))
+            else:
+                new.append(cols[j - 1] & (c == np.uint8(ord(pc) & 0xFF)))
+        new = closure(new)
+        out = jnp.stack(new, axis=-1)
+        return out, new[m]
+
+    seq = jnp.moveaxis(bytes_, -1, 0)  # (B, ...)
+    _, accepts = jax.lax.scan(step, jnp.stack(dp0, axis=-1), seq)
+    all_accepts = jnp.concatenate([dp0[m][None], accepts], axis=0)  # (B+1, ...)
+    sel = jnp.arange(B + 1, dtype=np.int32).reshape((B + 1,) + (1,) * len(lead)) == lens[None]
+    return jnp.sum(all_accepts & sel, axis=0).astype(bool)
+
+
+# ---------------------------------------------------------------------------
+# scopes: depth-0 (per-resource) vs instance (per array element)
+
+
+class Depth0:
+    shape_suffix = ()
+
+    def any(self, rowpred: jnp.ndarray) -> jnp.ndarray:
+        return rowpred.any(axis=-1)
+
+    def count(self, rowpred: jnp.ndarray) -> jnp.ndarray:
+        return rowpred.sum(axis=-1)
+
+
+class InstScope:
+    def __init__(self, ctx: Ctx):
+        self.ctx = ctx
+
+    def any(self, rowpred: jnp.ndarray) -> jnp.ndarray:
+        return self.count(rowpred) > 0.5
+
+    def count(self, rowpred: jnp.ndarray) -> jnp.ndarray:
+        return jnp.einsum("nr,nri->ni", rowpred.astype(jnp.float32), self.ctx.onehot)
+
+
+# ---------------------------------------------------------------------------
+# leaf row predicates (pattern.Validate lowering, pattern.go:26)
+
+
+def leaf_row_pred(ctx: Ctx, leaf: Any) -> jnp.ndarray:
+    if isinstance(leaf, BoolLeaf):
+        return ctx.type_is(T_BOOL) & (ctx.b["bool_val"] == np.uint8(1 if leaf.value else 0))
+    if isinstance(leaf, NumLeaf):
+        canon = canon_number(leaf.value)
+        num_eq = ctx.heq("num", canon)
+        grammar = ctx.b["str_goint" if leaf.is_int else "str_gofloat"] == 1
+        return (ctx.type_is(T_NUM) & num_eq) | (ctx.type_is(T_STR) & grammar & num_eq)
+    if isinstance(leaf, NullLeaf):
+        return (
+            ctx.type_is(T_NULL)
+            | (ctx.type_is(T_BOOL) & (ctx.b["bool_val"] == 0))
+            | (ctx.type_is(T_NUM) & (ctx.b["num_val"] == 0))
+            | (ctx.type_is(T_STR) & ctx.heq("repr", hash_str("", tag="s")))
+        )
+    if isinstance(leaf, StrLeaf):
+        pred = ctx.type_is(T_STR) & ctx.heq("repr", hash_str(leaf.full, tag="s"))
+        for units in leaf.alternatives:
+            conj = None
+            for unit in units:
+                disj = None
+                for c in unit:
+                    p = _cmp_pred(ctx, c)
+                    disj = p if disj is None else (disj | p)
+                if disj is None:  # unmatched range -> always false
+                    disj = jnp.zeros((ctx.N, ctx.R), dtype=bool)
+                conj = disj if conj is None else (conj & disj)
+            if conj is not None:
+                pred = pred | conj
+        return pred
+    raise Unsupported(f"leaf {type(leaf).__name__}")
+
+
+_ORD_OPS = {
+    Operator.EQUAL: "eq", Operator.NOT_EQUAL: "ne", Operator.MORE: "gt",
+    Operator.LESS: "lt", Operator.MORE_EQUAL: "ge", Operator.LESS_EQUAL: "le",
+}
+
+
+def _ord_cmp(val: jnp.ndarray, const: float, canon_eq: jnp.ndarray, op: Operator) -> jnp.ndarray:
+    """Ordered compare on f32 lanes; equality points are exact via the
+    canonical hash, strict compares are exact except in the final ulp."""
+    kind = _ORD_OPS[op]
+    c = np.float32(const)
+    if kind == "eq":
+        return canon_eq
+    if kind == "ne":
+        return ~canon_eq
+    if kind == "gt":
+        return (val > c) & ~canon_eq
+    if kind == "lt":
+        return (val < c) & ~canon_eq
+    if kind == "ge":
+        return (val > c) | canon_eq
+    return (val < c) | canon_eq
+
+
+def _cmp_pred(ctx: Ctx, c: Cmp) -> jnp.ndarray:
+    """One operator+operand term (pattern.go:207 validateString trial
+    order: duration, then quantity, then string)."""
+    if c.op not in _ORD_OPS:
+        return jnp.zeros((ctx.N, ctx.R), dtype=bool)
+    res: Optional[jnp.ndarray] = None
+    processed: Optional[jnp.ndarray] = None
+    if c.dur_ns is not None:
+        has = ctx.b["has_dur"] == 1
+        r = _ord_cmp(ctx.b["dur_val"], c.dur_ns / 1e9, ctx.heq("dur", canon_duration(c.dur_ns)), c.op)
+        res, processed = jnp.where(has, r, False), has
+    if c.qty is not None:
+        has_q = (ctx.b["has_qty"] == 1)
+        if processed is not None:
+            has_q = has_q & ~processed
+        r = _ord_cmp(ctx.b["qty_val"], float(c.qty), ctx.heq("qty", canon_quantity(c.qty)), c.op)
+        if res is None:
+            res, processed = jnp.where(has_q, r, False), has_q
+        else:
+            res = jnp.where(has_q, r, res)
+            processed = processed | has_q
+    # string branch (only Equal / NotEqual ever succeed, pattern.go:272)
+    if c.op in (Operator.EQUAL, Operator.NOT_EQUAL):
+        has_repr = ctx.b["has_repr"] == 1
+        if c.operand == "*":
+            m = jnp.ones((ctx.N, ctx.R), dtype=bool)
+        elif c.is_glob:
+            m = ctx.glob_rows(c.operand)
+        else:
+            m = ctx.heq("repr", hash_str(c.operand, tag="s"))
+        s = has_repr & (~m if c.op is Operator.NOT_EQUAL else m)
+    else:
+        s = jnp.zeros((ctx.N, ctx.R), dtype=bool)
+    if res is None:
+        return s
+    return jnp.where(processed, res, s)
+
+
+# ---------------------------------------------------------------------------
+# pattern node evaluation
+
+
+def _leaf_missing_cls(leaf: Any) -> int:
+    """validate(None, pattern) is a compile-time constant."""
+    if isinstance(leaf, NullLeaf):
+        return PASS
+    if isinstance(leaf, (BoolLeaf, NumLeaf)):
+        return FAIL
+    if isinstance(leaf, StrLeaf):
+        return PASS if patternpkg.validate(None, leaf.full) else FAIL
+    return FAIL
+
+
+def _first_nonpass(classes: List[jnp.ndarray], shape) -> jnp.ndarray:
+    res = jnp.full(shape, PASS, dtype=jnp.int32)
+    taken = jnp.zeros(shape, dtype=bool)
+    for cls in classes:
+        take = (~taken) & (cls != PASS)
+        res = jnp.where(take, cls, res)
+        taken = taken | (cls != PASS)
+    return res
+
+
+def eval_node(ctx: Ctx, scope, node: Node) -> jnp.ndarray:
+    if isinstance(node, LeafNode):
+        return _eval_leaf(ctx, scope, node)
+    if isinstance(node, MapNode):
+        return _eval_map(ctx, scope, node)
+    if isinstance(node, ArrayMapsNode):
+        return _eval_array_maps(ctx, scope, node)
+    if isinstance(node, ArrayScalarNode):
+        return _eval_array_scalar(ctx, scope, node)
+    raise Unsupported(f"node {type(node).__name__}")
+
+
+def _eval_leaf(ctx: Ctx, scope, node: LeafNode) -> jnp.ndarray:
+    mask = ctx.rows_at(node.path)
+    exists = scope.any(mask)
+    is_arr = scope.any(mask & ctx.type_is(T_ARR))
+    pred = leaf_row_pred(ctx, node.leaf)
+    scalar_ok = scope.any(mask & pred & ~ctx.type_is(T_ARR))
+    elem_mask = ctx.rows_at(node.path + (ARRAY_SEG,))
+    n_elem = scope.count(elem_mask)
+    n_ok = scope.count(elem_mask & pred)
+    arr_ok = n_elem == n_ok  # every element matches; empty array passes
+    ok = jnp.where(is_arr, arr_ok, scalar_ok)
+    missing = _leaf_missing_cls(node.leaf)
+    cls = jnp.where(ok, PASS, FAIL)
+    return jnp.where(exists, cls, jnp.full_like(cls, missing))
+
+
+def _eval_map(ctx: Ctx, scope, node: MapNode) -> jnp.ndarray:
+    mask = ctx.rows_at(node.path)
+    exists = scope.any(mask)
+    is_map = scope.any(mask & ctx.type_is(T_MAP))
+
+    anchor_cls: List[jnp.ndarray] = []
+    for a in node.anchors:
+        cpath = node.path + (a.key,)
+        cexists = scope.any(ctx.rows_at(cpath))
+        if a.kind == "negation":
+            cls = jnp.where(cexists, FAIL, PASS)
+        elif a.kind == "condition":
+            ch = eval_node(ctx, scope, a.child)
+            cls = jnp.where(cexists & (ch == PASS), PASS, SKIP)
+        elif a.kind == "equality":
+            ch = eval_node(ctx, scope, a.child)
+            cls = jnp.where(cexists, ch, PASS)
+        else:  # existence
+            cls = _eval_existence(ctx, scope, a.child, cexists)
+        anchor_cls.append(cls)
+
+    shape = exists.shape
+    if anchor_cls:
+        any_fail = functools.reduce(jnp.logical_or, [c == FAIL for c in anchor_cls])
+        all_skip = functools.reduce(jnp.logical_and, [c == SKIP for c in anchor_cls])
+    else:
+        any_fail = jnp.zeros(shape, dtype=bool)
+        all_skip = jnp.zeros(shape, dtype=bool)
+
+    p2_cls: List[jnp.ndarray] = []
+    for c in node.phase2:
+        cpath = node.path + (c.key,)
+        cmask = ctx.rows_at(cpath)
+        cexists = scope.any(cmask)
+        if c.is_star and not c.is_global:
+            # "*" under a plain key: present and non-null (handlers.go:128)
+            non_null = scope.any(cmask & ~ctx.type_is(T_NULL))
+            cls = jnp.where(cexists & non_null, PASS, FAIL)
+        elif c.is_global:
+            ch = eval_node(ctx, scope, c.child)
+            cls = jnp.where(cexists, jnp.where(ch == PASS, PASS, SKIP), PASS)
+        else:
+            cls = eval_node(ctx, scope, c.child)
+        p2_cls.append(cls)
+
+    phase2 = _first_nonpass(p2_cls, shape)
+    cls = jnp.where(any_fail, FAIL, jnp.where(all_skip, SKIP, phase2))
+    return jnp.where(exists & is_map, cls, jnp.full(shape, FAIL, dtype=jnp.int32))
+
+
+def _eval_existence(ctx: Ctx, scope, node: ExistenceNode, cexists: jnp.ndarray) -> jnp.ndarray:
+    if not isinstance(scope, Depth0):
+        raise Unsupported("existence anchor in array scope")
+    mask = ctx.rows_at(node.path)
+    is_arr = (mask & ctx.type_is(T_ARR)).any(axis=-1)
+    inst = InstScope(ctx)
+    valid_i = inst.any(ctx.rows_at(node.path + (ARRAY_SEG,)))
+    sat = jnp.ones(cexists.shape, dtype=bool)
+    for pm in node.elements:
+        cls_i = eval_node(ctx, inst, pm)  # (N, I)
+        sat = sat & (valid_i & (cls_i == PASS)).any(axis=-1)
+    cls = jnp.where(is_arr, jnp.where(sat, PASS, FAIL), FAIL)
+    return jnp.where(cexists, cls, PASS)
+
+
+def _eval_array_maps(ctx: Ctx, scope, node: ArrayMapsNode) -> jnp.ndarray:
+    if not isinstance(scope, Depth0):
+        raise Unsupported("array-of-maps in array scope")
+    mask = ctx.rows_at(node.path)
+    exists = mask.any(axis=-1)
+    is_arr = (mask & ctx.type_is(T_ARR)).any(axis=-1)
+    inst = InstScope(ctx)
+    valid_i = inst.any(ctx.rows_at(node.path + (ARRAY_SEG,)))
+    elem = eval_node(ctx, inst, node.element)  # (N, I)
+    any_fail = (valid_i & (elem == FAIL)).any(axis=-1)
+    any_pass = (valid_i & (elem == PASS)).any(axis=-1)
+    nonempty = valid_i.any(axis=-1)
+    cls = jnp.where(
+        any_fail, FAIL, jnp.where(any_pass, PASS, jnp.where(nonempty, SKIP, PASS))
+    )
+    return jnp.where(exists & is_arr, cls, jnp.full(cls.shape, FAIL, dtype=jnp.int32))
+
+
+def _eval_array_scalar(ctx: Ctx, scope, node: ArrayScalarNode) -> jnp.ndarray:
+    mask = ctx.rows_at(node.path)
+    exists = scope.any(mask)
+    is_arr = scope.any(mask & ctx.type_is(T_ARR))
+    pred = leaf_row_pred(ctx, node.leaf)
+    elem_mask = ctx.rows_at(node.path + (ARRAY_SEG,))
+    all_ok = scope.count(elem_mask) == scope.count(elem_mask & pred)
+    cls = jnp.where(all_ok, PASS, FAIL)
+    bad = jnp.full(cls.shape, FAIL, dtype=jnp.int32)
+    return jnp.where(exists & is_arr, cls, bad)
+
+
+# ---------------------------------------------------------------------------
+# condition evaluation (deny / preconditions)
+
+
+def _op_canon(op: str) -> str:
+    op = op.lower()
+    return {"equal": "equals", "notequal": "notequals"}.get(op, op)
+
+
+_IN_MODES = {"anyin": "any_in", "allin": "all_in",
+             "anynotin": "any_not_in", "allnotin": "all_not_in"}
+_NUM_OPS = {"greaterthan": "gt", "greaterthanorequals": "ge",
+            "lessthan": "lt", "lessthanorequals": "le"}
+
+
+def eval_cond_tree(ctx: Ctx, tree: Optional[CondTreeIR]) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Returns (ok, err), each (N,) bool."""
+    ok = jnp.ones((ctx.N,), dtype=bool)
+    err = jnp.zeros((ctx.N,), dtype=bool)
+    if tree is None:
+        return ok, err
+    for any_list, all_list in tree.blocks:
+        if any_list:
+            acc = jnp.zeros((ctx.N,), dtype=bool)
+            for c in any_list:
+                p, e = eval_cond(ctx, c)
+                acc = acc | p
+                err = err | e
+            ok = ok & acc
+        for c in all_list:
+            p, e = eval_cond(ctx, c)
+            ok = ok & p
+            err = err | e
+    return ok, err
+
+
+def eval_cond(ctx: Ctx, ir: CondIR) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    op = _op_canon(ir.op)
+    if isinstance(ir.key, OpKey):
+        return _eval_op_cond(ctx, ir.key, op, ir.value), jnp.zeros((ctx.N,), dtype=bool)
+    return _eval_path_cond(ctx, ir.key, op, ir.value)
+
+
+def _eval_op_cond(ctx: Ctx, key: OpKey, op: str, value: Any) -> jnp.ndarray:
+    """request.operation comparisons: a per-resource vocabulary-code
+    compare. The vocab covers the four admission ops plus any literal
+    strings appearing in this condition."""
+    vocab: Dict[str, int] = {}
+
+    def code(s: str) -> int:
+        if s not in vocab:
+            vocab[s] = len(vocab)
+        return vocab[s]
+
+    for s in OP_CODES:
+        code(s)
+    op_lane = ctx.b["meta_op_code"]  # 0..4 per OP_CODES order of insertion
+    present = op_lane != 0
+    if key.default is not None:
+        key_code = jnp.where(present, op_lane, np.int32(code(key.default)))
+        key_present = jnp.ones_like(present)
+    else:
+        key_code = op_lane
+        key_present = present
+    if op in ("equals", "notequals"):
+        if not isinstance(value, str):
+            eq = jnp.zeros((ctx.N,), dtype=bool)
+        else:
+            eq = key_present & (key_code == np.int32(code(value)))
+        return ~eq if op == "notequals" else eq
+    if op in _IN_MODES:
+        vals = value if isinstance(value, list) else [value]
+        vcodes = [code(v) for v in vals if isinstance(v, str)]
+        hit = jnp.zeros((ctx.N,), dtype=bool)
+        for vc in vcodes:
+            hit = hit | (key_code == np.int32(vc))
+        hit = key_present & hit
+        mode = _IN_MODES[op]
+        if mode in ("any_in", "all_in"):
+            return hit
+        return key_present & ~hit
+    # numeric on operation strings never succeeds
+    return jnp.zeros((ctx.N,), dtype=bool)
+
+
+def _collect_masks(ctx: Ctx, pc: PathCollect, literals: List[Any]) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(mask, in_set) over rows for a projection collect; literal
+    membership per row uses the sprint lane (value states) or key lane
+    (keys() states)."""
+    lits = [go_sprint(v) for v in literals]
+    lits = [l for l in lits if l is not None]
+    sprint_set = [hash_str(l, tag="s") for l in lits]
+    key_set = [hash_str(l, tag="k") for l in lits]
+    mask = jnp.zeros((ctx.N, ctx.R), dtype=bool)
+    in_set = jnp.zeros((ctx.N, ctx.R), dtype=bool)
+    for st in pc.states:
+        if st.mode == "keys":
+            m = ctx.rows_with_parent(st.segs)
+            s = ctx.hset("key", key_set)
+        else:
+            m = ctx.rows_at(st.segs)
+            if st.no_arr:
+                m = m & ~ctx.type_is(T_ARR)
+            if st.no_null:
+                m = m & ~ctx.type_is(T_NULL)
+            s = ctx.hset("sprint", sprint_set)
+        mask = mask | m
+        in_set = in_set | (m & s)
+    return mask, in_set
+
+
+def _list_exists(ctx: Ctx, pc: PathCollect) -> jnp.ndarray:
+    """Projection result is a list (vs null) when any root produces one."""
+    ex = jnp.zeros((ctx.N,), dtype=bool)
+    for segs, kind in pc.array_roots:
+        m = ctx.rows_at(segs)
+        if kind == "array":
+            ex = ex | (m & ctx.type_is(T_ARR)).any(axis=-1)
+        else:  # mselect: any non-null input yields a literal list
+            ex = ex | (m & ~ctx.type_is(T_NULL)).any(axis=-1)
+    return ex
+
+
+def _keys_errors(ctx: Ctx, pc: PathCollect) -> jnp.ndarray:
+    """keys(@) on a non-object element is a JMESPath error -> rule ERROR."""
+    err = jnp.zeros((ctx.N,), dtype=bool)
+    for st in pc.keys_error_states:
+        m = ctx.rows_at(st.segs)
+        bad = ctx.type_is(T_BOOL) | ctx.type_is(T_NUM) | ctx.type_is(T_STR)
+        if not st.no_arr:
+            bad = bad | ctx.type_is(T_ARR)
+        if not st.no_null:
+            bad = bad | ctx.type_is(T_NULL)
+        err = err | (m & bad).any(axis=-1)
+    return err
+
+
+def _scalar_falsy(ctx: Ctx, mask: jnp.ndarray) -> jnp.ndarray:
+    """JMESPath falsy for a scalar path value: missing/null/''/false/
+    empty map/empty list."""
+    exists = mask.any(axis=-1)
+    null = (mask & ctx.type_is(T_NULL)).any(axis=-1)
+    empty_str = (mask & ctx.type_is(T_STR) & ctx.heq("repr", hash_str("", tag="s"))).any(axis=-1)
+    false_b = (mask & ctx.type_is(T_BOOL) & (ctx.b["bool_val"] == 0)).any(axis=-1)
+    empty_cont = (
+        mask & (ctx.type_is(T_MAP) | ctx.type_is(T_ARR)) & (ctx.b["arr_len"] == 0)
+    ).any(axis=-1)
+    return (~exists) | null | empty_str | false_b | empty_cont
+
+
+def _scalar_membership_const(default: Any, literals: List[Any], mode: str) -> bool:
+    """Host-computed membership result when the || default kicks in
+    (exact conditions.py semantics via the scalar oracle)."""
+    from ..engine.conditions import _membership
+
+    return _membership(default, literals, mode)
+
+
+def _eval_path_cond(ctx: Ctx, pc: PathCollect, op: str, value: Any) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    err = _keys_errors(ctx, pc)
+    if op in _IN_MODES:
+        mode = _IN_MODES[op]
+        literals = value if isinstance(value, list) else [value]
+        if pc.is_projection:
+            mask, in_set = _collect_masks(ctx, pc, literals)
+            count = mask.sum(axis=-1)
+            present = _list_exists(ctx, pc)
+            any_in = (in_set).any(axis=-1)
+            any_not_in = (mask & ~in_set).any(axis=-1)
+            res = {
+                "any_in": any_in,
+                "all_in": ~any_not_in,
+                "any_not_in": any_not_in,
+                "all_not_in": ~any_in,
+            }[mode]
+            res = present & res
+            if pc.default is not None:
+                falsy = (~present) | (count == 0)
+                const = _scalar_membership_const(pc.default, literals, mode)
+                res = jnp.where(falsy, const, res)
+            return res, err
+        # scalar chain key
+        st = pc.states[0]
+        mask = ctx.rows_at(st.segs)
+        lits = [go_sprint(v) for v in (value if isinstance(value, list) else [value])]
+        sset = [hash_str(l, tag="s") for l in lits if l is not None]
+        in_set = ctx.hset("sprint", sset)
+        is_scalar = (mask & (ctx.type_is(T_STR) | ctx.type_is(T_NUM) | ctx.type_is(T_BOOL))).any(-1)
+        is_arr = (mask & ctx.type_is(T_ARR)).any(-1)
+        hit = (mask & in_set).any(-1)
+        em = ctx.rows_at(st.segs + (ARRAY_SEG,))
+        e_any_in = (em & in_set).any(-1)
+        e_any_not = (em & ~in_set).any(-1)
+        res = {
+            "any_in": jnp.where(is_arr, e_any_in, is_scalar & hit),
+            "all_in": jnp.where(is_arr, ~e_any_not, is_scalar & hit),
+            "any_not_in": jnp.where(is_arr, e_any_not, is_scalar & ~hit),
+            "all_not_in": jnp.where(is_arr, ~e_any_in, is_scalar & ~hit),
+        }[mode]
+        if pc.default is not None:
+            falsy = _scalar_falsy(ctx, mask)
+            const = _scalar_membership_const(pc.default, value if isinstance(value, list) else [value], mode)
+            res = jnp.where(falsy, const, res)
+        return res, err
+    if op in ("equals", "notequals"):
+        if pc.is_projection:
+            # list-vs-literal deep equality: lists never equal scalars;
+            # only list literals could match — unsupported at compile
+            res = jnp.zeros((ctx.N,), dtype=bool)
+        else:
+            res = _eval_scalar_equals(ctx, pc, value)
+        return (~res if op == "notequals" else res), err
+    if op in _NUM_OPS:
+        if pc.is_projection:
+            return jnp.zeros((ctx.N,), dtype=bool), err
+        return _eval_scalar_numeric(ctx, pc, _NUM_OPS[op], value), err
+    return jnp.zeros((ctx.N,), dtype=bool), err
+
+
+def _jcmp(kind: str, val, const: float, canon_eq) -> jnp.ndarray:
+    c = np.float32(const)
+    if kind == "ge":
+        return (val > c) | canon_eq
+    if kind == "le":
+        return (val < c) | canon_eq
+    if kind == "gt":
+        return (val > c) & ~canon_eq
+    return (val < c) & ~canon_eq
+
+
+def _eval_scalar_equals(ctx: Ctx, pc: PathCollect, v: Any) -> jnp.ndarray:
+    st = pc.states[0]
+    mask = ctx.rows_at(st.segs)
+    b = ctx.b
+    t_str = mask & ctx.type_is(T_STR)
+    t_num = mask & ctx.type_is(T_NUM)
+    t_bool = mask & ctx.type_is(T_BOOL)
+    zero_repr = ctx.heq("repr", hash_str("0", tag="s"))
+    key_d_valid = t_str & (b["has_dur"] == 1) & ~zero_repr
+
+    if isinstance(v, bool):
+        return (t_bool & (b["bool_val"] == (1 if v else 0))).any(-1)
+    if v is None:
+        return jnp.zeros((ctx.N,), dtype=bool)
+    if isinstance(v, (int, float)):
+        num_eq = (t_num & ctx.heq("num", canon_number(v))).any(-1)
+        dur_eq = (key_d_valid & ctx.heq("dur", canon_duration(int(float(v) * 1e9)))).any(-1)
+        return num_eq | dur_eq
+    if isinstance(v, str):
+        vd = parse_duration(v) if v != "0" else None
+        vq = parse_quantity(v)
+        try:
+            vf: Optional[float] = float(v)
+        except ValueError:
+            vf = None
+        # string keys (equal.go:70-99): duration pair, then quantity
+        # (no fallthrough), then exact/wildcard string compare
+        if vd is not None:
+            dur_eq_str = key_d_valid & ctx.heq("dur", canon_duration(vd))
+            dur_proc_str = key_d_valid
+        else:
+            dur_eq_str = jnp.zeros_like(mask)
+            dur_proc_str = jnp.zeros_like(mask)
+        has_q = t_str & (b["has_qty"] == 1)
+        qty_eq = (has_q & ctx.heq("qty", canon_quantity(vq))) if vq is not None \
+            else jnp.zeros_like(mask)
+        exact = ctx.heq("repr", hash_str(v, tag="s"))
+        str_eq = jnp.where(
+            dur_proc_str, dur_eq_str, jnp.where(has_q, qty_eq, t_str & exact)
+        )
+        # numeric keys only try float(value) (equal.go _equals_number)
+        if vf is not None:
+            num_eq = t_num & ctx.heq("num", canon_number(float(vf)))
+        else:
+            num_eq = jnp.zeros_like(mask)
+        return ((t_str & str_eq) | num_eq).any(-1)
+    return jnp.zeros((ctx.N,), dtype=bool)
+
+
+def _eval_scalar_numeric(ctx: Ctx, pc: PathCollect, kind: str, v: Any) -> jnp.ndarray:
+    st = pc.states[0]
+    mask = ctx.rows_at(st.segs)
+    b = ctx.b
+    t_str = mask & ctx.type_is(T_STR)
+    t_num = mask & ctx.type_is(T_NUM)
+    zero_repr = ctx.heq("repr", hash_str("0", tag="s"))
+    key_d_valid = t_str & (b["has_dur"] == 1) & ~zero_repr
+    z = jnp.zeros_like(mask)
+
+    if isinstance(v, bool) or v is None or isinstance(v, (list, dict)):
+        return jnp.zeros((ctx.N,), dtype=bool)
+    if isinstance(v, (int, float)):
+        num_cmp = t_num & _jcmp(kind, b["num_val"], float(v), ctx.heq("num", canon_number(v)))
+        dur_cmp = key_d_valid & _jcmp(
+            kind, b["dur_val"], float(v), ctx.heq("dur", canon_duration(int(float(v) * 1e9))))
+        lane_num = t_str & (b["has_num"] == 1) & _jcmp(
+            kind, b["num_val"], float(v), ctx.heq("num", canon_number(v)))
+        str_cmp = jnp.where(key_d_valid, dur_cmp, lane_num)
+        return (num_cmp | (t_str & str_cmp)).any(-1)
+    # v is str
+    vd = parse_duration(v) if v != "0" else None
+    vq = parse_quantity(v)
+    try:
+        vf: Optional[float] = float(v)
+    except ValueError:
+        vf = None
+    num_key = z
+    if vd is not None:
+        num_key = t_num & _jcmp(kind, b["num_val"] * np.float32(1e9), float(vd),
+                                ctx.heq("dur", canon_duration(vd)))
+    elif vf is not None:
+        num_key = t_num & _jcmp(kind, b["num_val"], vf, ctx.heq("num", canon_number(float(vf))))
+    # string key trial order: duration pair, quantity, float lane
+    dur_b = z
+    if vd is not None:
+        dur_b = key_d_valid & _jcmp(kind, b["dur_val"], vd / 1e9, ctx.heq("dur", canon_duration(vd)))
+    qty_b = z
+    if vq is not None:
+        qty_b = t_str & (b["has_qty"] == 1) & _jcmp(
+            kind, b["qty_val"], float(vq), ctx.heq("qty", canon_quantity(vq)))
+    flt_b = z
+    if vf is not None:
+        flt_b = t_str & (b["has_num"] == 1) & _jcmp(
+            kind, b["num_val"], vf, ctx.heq("num", canon_number(float(vf))))
+    dur_proc = key_d_valid if vd is not None else z
+    qty_proc = (t_str & (b["has_qty"] == 1)) if vq is not None else z
+    str_key = jnp.where(dur_proc, dur_b, jnp.where(qty_proc, qty_b, flt_b))
+    return (num_key | str_key).any(-1)
+
+
+# ---------------------------------------------------------------------------
+# match / exclude program (MatchesResourceDescription, match.go:168)
+
+
+def _meta_heq(ctx: Ctx, lane: str, s: str, tag: str) -> jnp.ndarray:
+    hi, lo = split32(hash_str(s, tag=tag))
+    l = ctx.b["meta_" + lane]
+    return (l[..., 0] == np.uint32(hi)) & (l[..., 1] == np.uint32(lo))
+
+
+def _pairs_any(ctx: Ctx, kh_lane: str, vh_lane: str, n_lane: str,
+               k: Optional[str], v: Optional[str], ktag: str, vtag: str) -> jnp.ndarray:
+    """Any (key, value) pair matching; None key/value = wildcard slot."""
+    kh = ctx.b["meta_" + kh_lane]  # (N, L, 2)
+    vh = ctx.b["meta_" + vh_lane]
+    n = ctx.b["meta_" + n_lane]
+    L = kh.shape[1]
+    live = jnp.arange(L, dtype=np.int32)[None, :] < n[:, None]
+    acc = live
+    if k is not None:
+        hi, lo = split32(hash_str(k, tag=ktag))
+        acc = acc & (kh[..., 0] == np.uint32(hi)) & (kh[..., 1] == np.uint32(lo))
+    if v is not None:
+        hi, lo = split32(hash_str(v, tag=vtag))
+        acc = acc & (vh[..., 0] == np.uint32(hi)) & (vh[..., 1] == np.uint32(lo))
+    return acc.any(axis=-1)
+
+
+def _glob_or_eq(ctx: Ctx, pattern: str, which: str, hash_lane: str, tag: str) -> jnp.ndarray:
+    from ..utils.wildcard import contains_wildcard
+
+    if contains_wildcard(pattern):
+        return ctx.glob_meta(pattern, which)
+    return _meta_heq(ctx, hash_lane, pattern, tag)
+
+
+def _eval_selector(ctx: Ctx, sel, kh_lane: str, vh_lane: str, n_lane: str) -> jnp.ndarray:
+    if sel.invalid:
+        return jnp.zeros((ctx.N,), dtype=bool)
+    ok = jnp.ones((ctx.N,), dtype=bool)
+    for k, v in sel.match_labels:
+        ok = ok & _pairs_any(ctx, kh_lane, vh_lane, n_lane, k, v, "lk", "lv")
+    for key, op, values in sel.expressions:
+        if op == "In":
+            hit = jnp.zeros((ctx.N,), dtype=bool)
+            for v in values:
+                hit = hit | _pairs_any(ctx, kh_lane, vh_lane, n_lane, key, v, "lk", "lv")
+            ok = ok & hit
+        elif op == "NotIn":
+            hit = jnp.zeros((ctx.N,), dtype=bool)
+            for v in values:
+                hit = hit | _pairs_any(ctx, kh_lane, vh_lane, n_lane, key, v, "lk", "lv")
+            ok = ok & ~hit
+        elif op == "Exists":
+            ok = ok & _pairs_any(ctx, kh_lane, vh_lane, n_lane, key, None, "lk", "lv")
+        elif op == "DoesNotExist":
+            ok = ok & ~_pairs_any(ctx, kh_lane, vh_lane, n_lane, key, None, "lk", "lv")
+        else:
+            ok = jnp.zeros((ctx.N,), dtype=bool)
+    return ok
+
+
+def _hash_in_lanes(ctx: Ctx, lane: str, n_lane: str, values: List[str], tag: str) -> jnp.ndarray:
+    """Any of the per-resource hash slots equals any of the values."""
+    arr = ctx.b["meta_" + lane]  # (N, L, 2)
+    n = ctx.b["meta_" + n_lane]
+    L = arr.shape[1]
+    live = jnp.arange(L, dtype=np.int32)[None, :] < n[:, None]
+    acc = jnp.zeros((ctx.N,), dtype=bool)
+    for v in values:
+        hi, lo = split32(hash_str(v, tag=tag))
+        acc = acc | (live & (arr[..., 0] == np.uint32(hi)) & (arr[..., 1] == np.uint32(lo))).any(-1)
+    return acc
+
+
+def _eval_condition_block(ctx: Ctx, f: FilterIR, with_user: bool) -> jnp.ndarray:
+    """doesResourceMatchConditionBlock (match.go:52): AND across
+    attributes, OR within list attributes."""
+    ok = jnp.ones((ctx.N,), dtype=bool)
+    if f.operations:
+        codes = [OP_CODES.get(o, -1) for o in f.operations]
+        # background scans evaluate as CREATE (the scalar engine default)
+        eff = jnp.where(ctx.b["meta_op_code"] == 0, np.int32(OP_CODES["CREATE"]),
+                        ctx.b["meta_op_code"])
+        hit = jnp.zeros((ctx.N,), dtype=bool)
+        for c in codes:
+            hit = hit | (eff == np.int32(c))
+        ok = ok & hit
+    if f.kinds:
+        hit = jnp.zeros((ctx.N,), dtype=bool)
+        for ks in f.kinds:
+            p = jnp.ones((ctx.N,), dtype=bool)
+            if ks.group != "*":
+                p = p & _meta_heq(ctx, "group_h", ks.group, "g")
+            if ks.version != "*":
+                p = p & _meta_heq(ctx, "version_h", ks.version, "v")
+            if ks.kind != "*":
+                p = p & _meta_heq(ctx, "kind_h", ks.kind, "K")
+            if ks.sub not in ("", "*"):
+                p = p & False  # background scans carry no subresource
+            hit = hit | p
+        ok = ok & hit
+    if f.name:
+        ok = ok & _glob_or_eq(ctx, f.name, "name", "name_h", "m")
+    if f.names:
+        hit = jnp.zeros((ctx.N,), dtype=bool)
+        for nm in f.names:
+            hit = hit | _glob_or_eq(ctx, nm, "name", "name_h", "m")
+        ok = ok & hit
+    if f.namespaces:
+        # Namespace-kind resources compare their name (match.go:18-31)
+        is_ns = ctx.b["meta_is_namespace_kind"] == 1
+        hit = jnp.zeros((ctx.N,), dtype=bool)
+        for ns in f.namespaces:
+            by_ns = _glob_or_eq(ctx, ns, "ns", "ns_h", "N")
+            by_name = _glob_or_eq(ctx, ns, "name", "name_h", "m")
+            hit = hit | jnp.where(is_ns, by_name, by_ns)
+        ok = ok & hit
+    if f.annotations:
+        for k, v in f.annotations:
+            ok = ok & _pairs_any(ctx, "ann_kh", "ann_vh", "ann_n", k, v, "ak", "av")
+    if f.selector is not None:
+        ok = ok & _eval_selector(ctx, f.selector, "labels_kh", "labels_vh", "labels_n")
+    if f.ns_selector is not None:
+        is_ns = ctx.b["meta_is_namespace_kind"] == 1
+        sel_ok = _eval_selector(ctx, f.ns_selector, "nsl_kh", "nsl_vh", "nsl_n")
+        ok = ok & ~is_ns & sel_ok
+    if with_user:
+        if f.roles:
+            ok = ok & _hash_in_lanes(ctx, "roles_h", "roles_n", f.roles, "r")
+        if f.cluster_roles:
+            ok = ok & _hash_in_lanes(ctx, "croles_h", "croles_n", f.cluster_roles, "r")
+        if f.subjects:
+            hit = jnp.zeros((ctx.N,), dtype=bool)
+            for s in f.subjects:
+                kind, name = s.get("kind"), s.get("name", "")
+                if kind == "ServiceAccount":
+                    uname = f"system:serviceaccount:{s.get('namespace', '')}:{name}"
+                    hit = hit | _meta_heq(ctx, "user_h", uname, "u")
+                elif kind == "User":
+                    hit = hit | _meta_heq(ctx, "user_h", name, "u")
+                else:  # Group
+                    hit = hit | _hash_in_lanes(ctx, "groups_h", "groups_n", [name], "u")
+            ok = ok & hit
+    return ok
+
+
+def _eval_match_filter(ctx: Ctx, f: FilterIR) -> jnp.ndarray:
+    """_match_helper (match.go:253): empty-admission requests drop user
+    constraints; fully-empty filters never match."""
+    adm_empty = ctx.b["meta_admission_empty"] == 1
+    with_user = _eval_condition_block(ctx, f, with_user=True)
+    without_user = _eval_condition_block(ctx, f, with_user=False)
+    empty_bg = f.resources_empty           # user dropped => match cannot be empty
+    empty_adm = f.resources_empty and f.user_empty
+    bg = jnp.zeros((ctx.N,), dtype=bool) if empty_bg else without_user
+    adm = jnp.zeros((ctx.N,), dtype=bool) if empty_adm else with_user
+    return jnp.where(adm_empty, bg, adm)
+
+
+def _eval_exclude_filter(ctx: Ctx, f: FilterIR) -> jnp.ndarray:
+    """_exclude_helper (match.go:278): empty excludes nothing; user
+    constraints always evaluated (empty admission naturally fails them)."""
+    if f.resources_empty and f.user_empty:
+        return jnp.zeros((ctx.N,), dtype=bool)
+    return _eval_condition_block(ctx, f, with_user=True)
+
+
+def eval_match(ctx: Ctx, match: MatchIR, exclude: MatchIR, policy_ns: str) -> jnp.ndarray:
+    if match.mode == "any":
+        m = jnp.zeros((ctx.N,), dtype=bool)
+        for f in match.filters:
+            m = m | _eval_match_filter(ctx, f)
+    else:  # all | legacy
+        m = jnp.ones((ctx.N,), dtype=bool)
+        for f in match.filters:
+            m = m & _eval_match_filter(ctx, f)
+    if policy_ns:
+        m = m & _meta_heq(ctx, "ns_h", policy_ns, "N")
+    if exclude.mode == "any":
+        e = jnp.zeros((ctx.N,), dtype=bool)
+        for f in exclude.filters:
+            e = e | _eval_exclude_filter(ctx, f)
+    elif exclude.mode == "all":
+        e = jnp.ones((ctx.N,), dtype=bool)
+        for f in exclude.filters:
+            e = e & _eval_exclude_filter(ctx, f)
+    else:
+        e = _eval_exclude_filter(ctx, exclude.filters[0])
+    return m & ~e
+
+
+# ---------------------------------------------------------------------------
+# rule & policy-set assembly
+
+
+def eval_rule(ctx: Ctx, prog: RuleProgram) -> jnp.ndarray:
+    matched = eval_match(ctx, prog.match, prog.exclude, prog.policy_namespace)
+    pre_ok, pre_err = eval_cond_tree(ctx, prog.preconditions)
+    if prog.kind == "deny":
+        denied, deny_err = eval_cond_tree(ctx, prog.deny)
+        cls = jnp.where(denied, FAIL, PASS)
+        err = deny_err
+    elif prog.kind == "pattern":
+        cls = eval_node(ctx, Depth0(), prog.patterns[0])
+        err = jnp.zeros((ctx.N,), dtype=bool)
+    else:  # any_pattern (validate_resource.go:382)
+        classes = [eval_node(ctx, Depth0(), p) for p in prog.patterns]
+        any_pass = functools.reduce(jnp.logical_or, [c == PASS for c in classes])
+        any_skip = functools.reduce(jnp.logical_or, [c == SKIP for c in classes])
+        any_fail = functools.reduce(jnp.logical_or, [c == FAIL for c in classes])
+        cls = jnp.where(any_pass, PASS, jnp.where(any_skip & ~any_fail, SKIP, FAIL))
+        err = jnp.zeros((ctx.N,), dtype=bool)
+    verdict = jnp.where(err, ERROR, cls)
+    verdict = jnp.where(pre_err, ERROR, jnp.where(pre_ok, verdict, SKIP))
+    verdict = jnp.where(matched, verdict, NOT_MATCHED)
+    fallback = (ctx.b["fallback"] == 1) | (ctx.b["meta_fallback"] == 1)
+    return jnp.where(fallback, HOST, verdict)
+
+
+def build_program(programs: Sequence[RuleProgram], max_instances: int) -> Callable:
+    """Returns a jittable fn(batch dict) -> (num_rules, N) int32."""
+
+    def run(batch: Dict[str, jnp.ndarray]) -> jnp.ndarray:
+        ctx = Ctx(batch, max_instances)
+        outs = [eval_rule(ctx, p) for p in programs]
+        if not outs:
+            return jnp.zeros((0, ctx.N), dtype=jnp.int32)
+        return jnp.stack(outs, axis=0).astype(jnp.int32)
+
+    return run

@@ -1,0 +1,295 @@
+"""Resource JSON -> padded row tables (the device-side resource encoding).
+
+Every node of the resource tree (maps, arrays, scalars, nulls) becomes
+one row. Rows carry:
+
+- ``norm`` hash: the normalized path (array indices -> the reserved
+  ``[]`` segment), the join key for pattern-leaf and deny-path lookups;
+- ``parent`` hash: normalized parent path (for ``keys(@)`` collections);
+- ``key`` hash: the map-key name of this node (last path segment);
+- ``scope1``/``scope2``: element index within the outermost / second
+  enclosing array (-1 when none) — the per-instance join keys used by
+  anchor semantics inside arrays-of-maps;
+- typed value lanes, pre-parsed on host so the device never touches
+  strings: Go-repr canonical hash (exact equality), f32 numeric lanes
+  + canonical hashes for quantity / duration / Go-number comparisons
+  (mirrors pattern.go:207-215 trial order), bool lane, array length;
+- an optional byte-pool slot for values that compiled policies need to
+  glob-match (operands containing ``*``/``?``).
+
+Encoding is resource-count-linear: one pass per resource regardless of
+how many policies later evaluate against it — this is what turns the
+reference's O(policies x rules x resources) tree walks
+(pkg/engine/validate/validate.go:31) into O(resources) host work plus
+a device cross-product.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from ..engine.pattern import go_format_float_e, go_parse_float, go_parse_int
+from ..utils.duration import parse_duration
+from ..utils.quantity import parse_quantity
+from .hashing import (
+    ARRAY_SEG,
+    canon_duration,
+    canon_number,
+    canon_quantity,
+    hash_path,
+    hash_str,
+    split32,
+)
+
+# type tags
+T_NULL, T_BOOL, T_NUM, T_STR, T_MAP, T_ARR = 0, 1, 2, 3, 4, 5
+
+ROOT_HASH = hash_path(())
+
+
+class EncodeConfig:
+    """Shape caps. Exceeding a cap flags the resource for host fallback
+    (never silently wrong)."""
+
+    def __init__(
+        self,
+        max_rows: int = 256,
+        max_instances: int = 16,
+        byte_pool_slots: int = 16,
+        byte_pool_width: int = 96,
+    ):
+        self.max_rows = max_rows
+        self.max_instances = max_instances
+        self.byte_pool_slots = byte_pool_slots
+        self.byte_pool_width = byte_pool_width
+
+
+_LANES_U32 = (
+    "norm_hi", "norm_lo", "parent_hi", "parent_lo", "key_hi", "key_lo",
+    "repr_hi", "repr_lo", "qty_hi", "qty_lo", "dur_hi", "dur_lo",
+    "num_hi", "num_lo", "sprint_hi", "sprint_lo",
+)
+_LANES_F32 = ("num_val", "qty_val", "dur_val", "arr_len")
+_LANES_I32 = ("scope1", "scope2", "byte_slot")
+_LANES_U8 = (
+    "type_tag", "bool_val", "has_repr", "has_qty", "has_dur", "has_num",
+    "str_goint", "str_gofloat",
+)
+
+
+class RowBatch:
+    """Struct-of-arrays over (n_resources, max_rows)."""
+
+    def __init__(self, n: int, cfg: EncodeConfig):
+        r = cfg.max_rows
+        self.cfg = cfg
+        for name in _LANES_U32:
+            setattr(self, name, np.zeros((n, r), dtype=np.uint32))
+        for name in _LANES_F32:
+            setattr(self, name, np.zeros((n, r), dtype=np.float32))
+        for name in _LANES_I32:
+            setattr(self, name, np.full((n, r), -1, dtype=np.int32))
+        for name in _LANES_U8:
+            setattr(self, name, np.zeros((n, r), dtype=np.uint8))
+        self.valid = np.zeros((n, r), dtype=np.uint8)
+        self.n_rows = np.zeros((n,), dtype=np.int32)
+        self.fallback = np.zeros((n,), dtype=np.uint8)  # caps exceeded
+        self.pool = np.zeros((n, cfg.byte_pool_slots, cfg.byte_pool_width), dtype=np.uint8)
+        self.pool_len = np.zeros((n, cfg.byte_pool_slots), dtype=np.int32)
+
+    def arrays(self) -> Dict[str, np.ndarray]:
+        out = {name: getattr(self, name) for name in
+               _LANES_U32 + _LANES_F32 + _LANES_I32 + _LANES_U8}
+        out.update(valid=self.valid, n_rows=self.n_rows, fallback=self.fallback,
+                   pool=self.pool, pool_len=self.pool_len)
+        return out
+
+
+def _go_repr(value: Any) -> Optional[str]:
+    """The string form used by pattern.go compareString (pattern.go:270):
+    bools spell true/false, floats use FormatFloat('E', -1, 64)."""
+    if isinstance(value, bool):
+        return "true" if value else "false"
+    if isinstance(value, str):
+        return value
+    if isinstance(value, float):
+        return go_format_float_e(value)
+    if isinstance(value, int):
+        return str(value)
+    return None
+
+
+def go_sprint(value: Any) -> Optional[str]:
+    """fmt.Sprint spelling for scalars (conditions.py _go_sprint), None
+    for null/map/array (those never match literal sets)."""
+    if isinstance(value, bool):
+        return "true" if value else "false"
+    if isinstance(value, float):
+        return str(int(value)) if value == int(value) else repr(value)
+    if isinstance(value, int):
+        return str(value)
+    if isinstance(value, str):
+        return value
+    return None
+
+
+def _number_string(value: Any) -> Optional[str]:
+    """pattern.go:307 convertNumberToString: nil -> "0", float -> %f."""
+    if value is None:
+        return "0"
+    if isinstance(value, bool):
+        return None
+    if isinstance(value, str):
+        return value
+    if isinstance(value, float):
+        return "%f" % value
+    if isinstance(value, int):
+        return str(value)
+    return None
+
+
+class _ResourceEncoder:
+    def __init__(self, batch: RowBatch, res_idx: int, byte_paths: Set[int]):
+        self.b = batch
+        self.i = res_idx
+        self.byte_paths = byte_paths
+        self.row = 0
+        self.pool_used = 0
+        self.ok = True
+
+    def _emit(self, segs: Tuple[str, ...], scope1: int, scope2: int) -> int:
+        if self.row >= self.b.cfg.max_rows:
+            self.ok = False
+            return -1
+        r = self.row
+        self.row += 1
+        b, i = self.b, self.i
+        norm = hash_path(segs)
+        parent = hash_path(segs[:-1]) if segs else 0
+        key = hash_str(segs[-1], tag="k") if segs else 0
+        b.norm_hi[i, r], b.norm_lo[i, r] = split32(norm)
+        b.parent_hi[i, r], b.parent_lo[i, r] = split32(parent)
+        b.key_hi[i, r], b.key_lo[i, r] = split32(key)
+        b.scope1[i, r] = scope1
+        b.scope2[i, r] = scope2
+        b.valid[i, r] = 1
+        return r
+
+    def _fill_scalar(self, r: int, norm: int, value: Any) -> None:
+        b, i = self.b, self.i
+        if value is None:
+            b.type_tag[i, r] = T_NULL
+        elif isinstance(value, bool):
+            b.type_tag[i, r] = T_BOOL
+            b.bool_val[i, r] = 1 if value else 0
+        elif isinstance(value, (int, float)):
+            b.type_tag[i, r] = T_NUM
+            b.num_val[i, r] = np.float32(value)
+            b.has_num[i, r] = 1
+            b.num_hi[i, r], b.num_lo[i, r] = split32(canon_number(value))
+        else:
+            b.type_tag[i, r] = T_STR
+            # int-pattern vs string value requires the *int* grammar,
+            # float-pattern the float grammar (pattern.go:71,107); the
+            # str_goint / str_gofloat flags keep them distinct on device
+            g_int = go_parse_int(value)
+            g_float = go_parse_float(value)
+            if g_int is not None:
+                b.str_goint[i, r] = 1
+            if g_float is not None:
+                b.str_gofloat[i, r] = 1
+            num = g_int if g_int is not None else g_float
+            if num is not None:
+                b.has_num[i, r] = 1
+                b.num_val[i, r] = np.float32(num)
+                b.num_hi[i, r], b.num_lo[i, r] = split32(canon_number(num))
+
+        # repr lane (string comparisons, pattern.go:270 spelling)
+        rep = _go_repr(value)
+        if rep is not None:
+            b.has_repr[i, r] = 1
+            b.repr_hi[i, r], b.repr_lo[i, r] = split32(hash_str(rep, tag="s"))
+            if norm in self.byte_paths:
+                self._assign_pool(r, rep)
+        # sprint lane (fmt.Sprint spelling used by condition operators:
+        # integral floats print as ints, pkg/engine/variables/operator)
+        sp = go_sprint(value)
+        if sp is not None:
+            b.sprint_hi[i, r], b.sprint_lo[i, r] = split32(hash_str(sp, tag="s"))
+        # quantity / duration trial lanes (pattern.go:217,243 both go
+        # through convertNumberToString first)
+        ns = _number_string(value)
+        if ns is not None:
+            q = parse_quantity(ns)
+            if q is not None:
+                b.has_qty[i, r] = 1
+                b.qty_val[i, r] = np.float32(q)
+                b.qty_hi[i, r], b.qty_lo[i, r] = split32(canon_quantity(q))
+            d = parse_duration(ns)
+            if d is not None:
+                b.has_dur[i, r] = 1
+                b.dur_val[i, r] = np.float32(d / 1e9)
+                b.dur_hi[i, r], b.dur_lo[i, r] = split32(canon_duration(d))
+
+    def _assign_pool(self, r: int, s: str) -> None:
+        b, i = self.b, self.i
+        data = s.encode("utf-8")
+        if len(data) > b.cfg.byte_pool_width or self.pool_used >= b.cfg.byte_pool_slots:
+            self.ok = False
+            return
+        slot = self.pool_used
+        self.pool_used += 1
+        b.pool[i, slot, : len(data)] = np.frombuffer(data, dtype=np.uint8)
+        b.pool_len[i, slot] = len(data)
+        b.byte_slot[i, r] = slot
+
+    def walk(self, node: Any, segs: Tuple[str, ...], scope1: int, scope2: int, depth: int) -> None:
+        r = self._emit(segs, scope1, scope2)
+        if r < 0:
+            return
+        b, i = self.b, self.i
+        if isinstance(node, dict):
+            b.type_tag[i, r] = T_MAP
+            b.arr_len[i, r] = len(node)
+            for k, v in node.items():
+                self.walk(v, segs + (str(k),), scope1, scope2, depth)
+        elif isinstance(node, list):
+            b.type_tag[i, r] = T_ARR
+            b.arr_len[i, r] = len(node)
+            if len(node) > b.cfg.max_instances and depth == 0:
+                # instance joins cap out; deny-path collection still works
+                # so only flag when the policy set does instance joins —
+                # handled conservatively: flag always (cheap, rare)
+                self.ok = False
+            for idx, v in enumerate(node):
+                s1, s2 = scope1, scope2
+                if depth == 0:
+                    s1 = idx
+                elif depth == 1:
+                    s2 = idx
+                self.walk(v, segs + (ARRAY_SEG,), s1, s2, depth + 1)
+        else:
+            self._fill_scalar(r, hash_path(segs), node)
+
+
+def encode_resources(
+    resources: Sequence[Dict[str, Any]],
+    cfg: Optional[EncodeConfig] = None,
+    byte_paths: Optional[Iterable[int]] = None,
+) -> RowBatch:
+    """Encode a list of resource dicts into a padded RowBatch.
+
+    ``byte_paths``: normalized-path hashes whose string values must be
+    available as raw bytes (compiled policy set's glob operand paths).
+    """
+    cfg = cfg or EncodeConfig()
+    bp = set(byte_paths or ())
+    batch = RowBatch(len(resources), cfg)
+    for i, res in enumerate(resources):
+        enc = _ResourceEncoder(batch, i, bp)
+        enc.walk(res, (), -1, -1, 0)
+        batch.n_rows[i] = enc.row
+        batch.fallback[i] = 0 if enc.ok else 1
+    return batch

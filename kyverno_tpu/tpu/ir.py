@@ -1,0 +1,782 @@
+"""Rule -> device IR with capability analysis.
+
+The compiler lowers each rule's match/exclude block, preconditions, and
+validate body (pattern / anyPattern / deny) into a device IR that the
+evaluator turns into JAX ops. Anything outside the supported subset
+raises :class:`Unsupported`; the policy-set compiler catches it and
+routes that rule to the scalar engine (host fallback) — the device path
+is never wrong, only selectively absent.
+
+Supported subset (grown over rounds):
+- patterns: map trees with condition ``()``, equality ``=()``, negation
+  ``X()``, existence ``^()`` and global ``<()`` anchors; arrays-of-maps
+  (one array level deep on any path); scalar-array broadcast; scalar
+  leaves with the full ``|``/``&``/operator/range grammar
+  (pkg/engine/pattern/pattern.go) including glob operands;
+- deny/preconditions: keys that are single ``{{ ... }}`` JMESPath
+  templates over ``request.object`` path chains, multiselects,
+  ``[]`` projections, ``keys(@)`` and ``|| literal`` defaults; also
+  ``request.operation``; operators Equals/NotEquals, the In family,
+  numeric/duration comparisons with literal values;
+- match/exclude: kinds (exact or ``*`` segments), names/namespaces with
+  globs, exact annotations, label/namespace selectors without
+  wildcards, operations, exact user roles/clusterRoles/subjects.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from fractions import Fraction
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+from ..api.policy import ClusterPolicy, ResourceDescription, ResourceFilter, Rule, UserInfo
+from ..engine.jmespath.parser import Parser as JmesParser
+from ..engine.operator import (
+    IN_RANGE_RE,
+    NOT_IN_RANGE_RE,
+    Operator,
+    get_operator_from_string_pattern,
+)
+from ..engine.pattern import go_parse_float, go_parse_int
+from ..utils import kube
+from ..utils.duration import parse_duration
+from ..utils.quantity import parse_quantity
+from ..utils.wildcard import contains_wildcard
+from .hashing import ARRAY_SEG, hash_path, hash_str
+from .metadata import OP_CODES
+
+
+class Unsupported(Exception):
+    """Construct outside the device subset -> host fallback."""
+
+
+# ---------------------------------------------------------------------------
+# scalar leaf IR (pattern.Validate lowering)
+
+
+@dataclass
+class Cmp:
+    """One operator+operand comparison (one &-term, after range expansion)."""
+
+    op: Operator
+    operand: str
+    dur_ns: Optional[int] = None      # operand parsed as Go duration
+    qty: Optional[Fraction] = None    # operand parsed as k8s quantity
+    is_glob: bool = False             # operand contains * or ?
+
+    def __post_init__(self) -> None:
+        self.dur_ns = parse_duration(self.operand)
+        self.qty = parse_quantity(self.operand)
+        self.is_glob = contains_wildcard(self.operand)
+
+
+@dataclass
+class StrLeaf:
+    full: str
+    # disjunction (|) of conjunctions (&) of disjunctions (notrange pairs)
+    alternatives: List[List[List[Cmp]]] = field(default_factory=list)
+    is_star: bool = False
+
+    @classmethod
+    def compile(cls, pattern: str) -> "StrLeaf":
+        alts: List[List[List[Cmp]]] = []
+        for condition in pattern.split("|"):
+            condition = condition.strip(" ")
+            units: List[List[Cmp]] = []
+            for term in condition.split("&"):
+                term = term.strip(" ")
+                op = get_operator_from_string_pattern(term)
+                if op is Operator.IN_RANGE:
+                    m = IN_RANGE_RE.match(term)
+                    if not m:
+                        units.append([])  # unmatched range -> always false
+                        continue
+                    units.append([Cmp(Operator.MORE_EQUAL, m.group(1).strip())])
+                    units.append([Cmp(Operator.LESS_EQUAL, m.group(2).strip())])
+                elif op is Operator.NOT_IN_RANGE:
+                    m = NOT_IN_RANGE_RE.match(term)
+                    if not m:
+                        units.append([])
+                        continue
+                    units.append([
+                        Cmp(Operator.LESS, m.group(1).strip()),
+                        Cmp(Operator.MORE, m.group(2).strip()),
+                    ])
+                else:
+                    units.append([Cmp(op, term[len(op.value):].strip())])
+            alts.append(units)
+        return cls(full=pattern, alternatives=alts, is_star=(pattern == "*"))
+
+
+@dataclass
+class BoolLeaf:
+    value: bool
+
+
+@dataclass
+class NumLeaf:
+    value: Any  # int | float
+    is_int: bool
+
+
+@dataclass
+class NullLeaf:
+    pass
+
+
+Leaf = Any  # BoolLeaf | NumLeaf | NullLeaf | StrLeaf
+
+
+def compile_leaf(pattern: Any) -> Leaf:
+    if isinstance(pattern, bool):
+        return BoolLeaf(pattern)
+    if isinstance(pattern, int):
+        return NumLeaf(pattern, True)
+    if isinstance(pattern, float):
+        return NumLeaf(pattern, False)
+    if pattern is None:
+        return NullLeaf()
+    if isinstance(pattern, str):
+        if "{{" in pattern:
+            raise Unsupported("variable in pattern leaf")
+        return StrLeaf.compile(pattern)
+    raise Unsupported(f"unsupported leaf pattern type {type(pattern).__name__}")
+
+
+# ---------------------------------------------------------------------------
+# pattern tree IR
+
+
+@dataclass
+class Node:
+    path: Tuple[str, ...]
+    scope: Optional[Tuple[str, ...]]  # enclosing array path (<=1 level)
+
+
+@dataclass
+class LeafNode(Node):
+    leaf: Leaf
+
+
+@dataclass
+class MapEmptyNode(Node):
+    """Pattern ``{}``-equivalent or dict-type check via scalar dispatch."""
+
+
+@dataclass
+class AnchorChild:
+    kind: str          # condition | equality | negation | existence
+    key: str
+    raw_key: str       # with modifier, phase-1 iterates sorted raw keys
+    child: Optional["Node"]
+
+
+@dataclass
+class Phase2Child:
+    key: str
+    is_global: bool
+    is_star: bool      # pattern literal "*" under a plain key
+    child: Optional["Node"]
+
+
+@dataclass
+class MapNode(Node):
+    anchors: List[AnchorChild] = field(default_factory=list)
+    phase2: List[Phase2Child] = field(default_factory=list)
+
+
+@dataclass
+class ArrayMapsNode(Node):
+    element: "Node" = None  # MapNode over elements
+
+
+@dataclass
+class ArrayScalarNode(Node):
+    leaf: Leaf = None
+
+
+@dataclass
+class ExistenceNode(Node):
+    """^(key) anchor value: list of element-map patterns, each must be
+    satisfied by at least one resource element (handlers.go:228)."""
+
+    elements: List["Node"] = field(default_factory=list)
+
+
+_GLOBBY_KEY = re.compile(r"[*?]")
+
+
+class PatternCompiler:
+    def __init__(self) -> None:
+        self.byte_paths: Set[int] = set()
+
+    def compile(self, pattern: Any) -> Node:
+        if not isinstance(pattern, dict):
+            raise Unsupported("non-map pattern root")
+        self._scan_vars(pattern)
+        return self._map(pattern, (), None)
+
+    def _scan_vars(self, tree: Any) -> None:
+        if isinstance(tree, dict):
+            for k, v in tree.items():
+                if "{{" in str(k):
+                    raise Unsupported("variable in pattern key")
+                self._scan_vars(v)
+        elif isinstance(tree, list):
+            for v in tree:
+                self._scan_vars(v)
+        elif isinstance(tree, str) and "{{" in tree:
+            raise Unsupported("variable in pattern")
+
+    def _element(self, pattern: Any, path: Tuple[str, ...],
+                 scope: Optional[Tuple[str, ...]]) -> Node:
+        """_validate_resource_element dispatch (validate.go:71)."""
+        if isinstance(pattern, dict):
+            return self._map(pattern, path, scope)
+        if isinstance(pattern, list):
+            return self._array(pattern, path, scope)
+        leaf = compile_leaf(pattern)
+        self._note_glob_paths(leaf, path)
+        return LeafNode(path, scope, leaf)
+
+    def _note_glob_paths(self, leaf: Leaf, path: Tuple[str, ...]) -> None:
+        if isinstance(leaf, StrLeaf):
+            globby = any(
+                c.is_glob and c.op in (Operator.EQUAL, Operator.NOT_EQUAL) and c.operand != "*"
+                for units in leaf.alternatives for unit in units for c in unit
+            )
+            if globby:
+                # glob compare runs on raw bytes of the value *and* of
+                # any array elements it may broadcast over
+                self.byte_paths.add(hash_path(path))
+                self.byte_paths.add(hash_path(path + (ARRAY_SEG,)))
+
+    def _map(self, pattern: Dict[str, Any], path: Tuple[str, ...],
+             scope: Optional[Tuple[str, ...]]) -> MapNode:
+        from ..engine import anchor as anchorpkg
+
+        anchors: List[AnchorChild] = []
+        phase2: List[Phase2Child] = []
+        anchor_keys: Dict[str, Any] = {}
+        resource_keys: Dict[str, Any] = {}
+        for key, value in pattern.items():
+            key = str(key)
+            a = anchorpkg.parse(key)
+            if anchorpkg.is_condition(a) or anchorpkg.is_existence(a) \
+                    or anchorpkg.is_equality(a) or anchorpkg.is_negation(a):
+                anchor_keys[key] = (a, value)
+            else:
+                resource_keys[key] = (a, value)
+            inner = a.key if a is not None else key
+            if _GLOBBY_KEY.search(inner):
+                raise Unsupported("wildcard pattern key (ExpandInMetadata)")
+
+        for raw_key in sorted(anchor_keys.keys()):
+            a, value = anchor_keys[raw_key]
+            kind = (
+                "condition" if anchorpkg.is_condition(a)
+                else "equality" if anchorpkg.is_equality(a)
+                else "negation" if anchorpkg.is_negation(a)
+                else "existence"
+            )
+            child: Optional[Node] = None
+            if kind == "negation":
+                child = None  # value never evaluated (handlers.go:66)
+            elif kind == "existence":
+                child = self._existence(value, path + (a.key,), scope)
+            else:
+                child = self._element(value, path + (a.key,), scope)
+            anchors.append(AnchorChild(kind, a.key, raw_key, child))
+
+        # phase-2 order: getSortedNestedAnchorResource — stable sorted
+        # keys, then keys that are global anchors or contain nested
+        # anchors are pushed front (reversing their relative order)
+        front: List[str] = []
+        back: List[str] = []
+        for k in sorted(resource_keys.keys()):
+            a, value = resource_keys[k]
+            if anchorpkg.is_global(a) or self._has_nested_anchors(value):
+                front.insert(0, k)
+            else:
+                back.append(k)
+        for k in front + back:
+            a, value = resource_keys[k]
+            is_global = anchorpkg.is_global(a)
+            inner = a.key if is_global else k
+            is_star = value == "*"
+            child = self._element(value, path + (inner,), scope)
+            phase2.append(Phase2Child(inner, is_global, is_star, child))
+        return MapNode(path, scope, anchors, phase2)
+
+    @staticmethod
+    def _has_nested_anchors(pattern: Any) -> bool:
+        from ..engine.validate import _has_nested_anchors
+
+        return _has_nested_anchors(pattern)
+
+    def _array(self, pattern: List[Any], path: Tuple[str, ...],
+               scope: Optional[Tuple[str, ...]]) -> Node:
+        if len(pattern) == 0:
+            raise Unsupported("empty pattern array")  # constant FAIL; rare
+        first = pattern[0]
+        if isinstance(first, dict):
+            if scope is not None:
+                raise Unsupported("array-of-maps nested beyond one level")
+            element = self._map(first, path + (ARRAY_SEG,), path)
+            return ArrayMapsNode(path, scope, element)
+        if isinstance(first, list):
+            raise Unsupported("positional array-of-arrays pattern")
+        leaf = compile_leaf(first)
+        self._note_glob_paths(leaf, path + (ARRAY_SEG,))
+        return ArrayScalarNode(path, scope, leaf)
+
+    def _existence(self, value: Any, path: Tuple[str, ...],
+                   scope: Optional[Tuple[str, ...]]) -> ExistenceNode:
+        if scope is not None:
+            raise Unsupported("existence anchor nested in array scope")
+        if not isinstance(value, list):
+            # non-list pattern under ^() is a constant error (handlers.go:243)
+            raise Unsupported("existence anchor with non-list pattern")
+        elements: List[Node] = []
+        for pm in value:
+            if not isinstance(pm, dict):
+                raise Unsupported("existence anchor with non-map element")
+            elements.append(self._map(pm, path + (ARRAY_SEG,), path))
+        return ExistenceNode(path, scope, elements)
+
+
+# ---------------------------------------------------------------------------
+# condition (deny / precondition) IR
+
+
+@dataclass
+class PathState:
+    segs: Tuple[str, ...]
+    mode: str  # value | keys | mselect
+    no_arr: bool = False   # array rows here were spliced by a flatten
+    no_null: bool = False  # null rows dropped (projection semantics)
+
+
+@dataclass
+class OpKey:
+    """key == {{ request.operation }} (with optional || default)."""
+
+    default: Optional[str]
+
+
+@dataclass
+class PathCollect:
+    """key collects rows of the flattened resource."""
+
+    states: List[PathState]
+    # (path, kind) pairs whose presence makes the key a list (vs null):
+    # kind 'array' => row at path exists with type array;
+    # kind 'mselect' => row at path exists non-null (multiselect lists)
+    array_roots: List[Tuple[Tuple[str, ...], str]]
+    is_projection: bool                 # list-valued (vs scalar path chain)
+    default: Optional[Any]
+    # element paths keys(@) was applied to: non-map elements there make
+    # the whole condition a query error -> rule ERROR
+    keys_error_states: List[PathState] = field(default_factory=list)
+
+
+@dataclass
+class CondIR:
+    key: Any                 # OpKey | PathCollect
+    op: str                  # canonical lower-case operator
+    value: Any               # literal (list or scalar)
+
+
+@dataclass
+class CondTreeIR:
+    """AnyAllConditions: ANDed blocks of {any, all} lists."""
+
+    blocks: List[Tuple[List[CondIR], List[CondIR]]]  # (any, all) per block
+
+
+_VAR_RE = re.compile(r"^\{\{(.*)\}\}$", re.DOTALL)
+
+# deprecated In/NotIn have strict invalid-type semantics dependent on
+# runtime key element types (in.go:35-43) -> host only
+_SUPPORTED_OPS = {
+    "equals", "equal", "notequals", "notequal",
+    "anyin", "allin", "anynotin", "allnotin",
+    "greaterthan", "greaterthanorequals", "lessthan", "lessthanorequals",
+}
+
+
+class ConditionCompiler:
+    def __init__(self) -> None:
+        self._parser = JmesParser()
+
+    def compile_tree(self, conditions: Any) -> Optional[CondTreeIR]:
+        """None/empty conditions -> None (always pass)."""
+        if conditions is None:
+            return None
+        blocks: List[Tuple[List[CondIR], List[CondIR]]] = []
+        if isinstance(conditions, list):
+            flat: List[CondIR] = []
+            for item in conditions:
+                if not isinstance(item, dict):
+                    raise Unsupported("non-map condition")
+                if "any" in item or "all" in item:
+                    blocks.append(self._block(item))
+                else:
+                    flat.append(self.compile_condition(item))
+            if flat:
+                blocks.append(([], flat))
+        elif isinstance(conditions, dict):
+            blocks.append(self._block(conditions))
+        else:
+            raise Unsupported("invalid conditions type")
+        if not blocks:
+            return None
+        return CondTreeIR(blocks)
+
+    def _block(self, block: Dict[str, Any]) -> Tuple[List[CondIR], List[CondIR]]:
+        any_list = [self.compile_condition(c) for c in (block.get("any") or [])]
+        all_list = [self.compile_condition(c) for c in (block.get("all") or [])]
+        return any_list, all_list
+
+    def compile_condition(self, cond: Dict[str, Any]) -> CondIR:
+        op = str(cond.get("operator", "")).lower()
+        if op not in _SUPPORTED_OPS:
+            raise Unsupported(f"operator {op}")
+        value = cond.get("value")
+        self._check_literal_value(value)
+        key = cond.get("key")
+        if not isinstance(key, str):
+            raise Unsupported("non-string condition key")
+        m = _VAR_RE.match(key.strip())
+        if not m:
+            # literal string key (no variable): constant-foldable, but
+            # rare — keep host
+            raise Unsupported("non-variable condition key")
+        expr = m.group(1).strip()
+        if "{{" in expr:
+            raise Unsupported("nested variables in key")
+        ast = self._parser.parse(expr)
+        key_ir = self._compile_key(ast)
+        if op in ("equals", "equal", "notequals", "notequal") and isinstance(value, (list, dict)):
+            raise Unsupported("deep-equality condition value")
+        if op in ("greaterthan", "greaterthanorequals", "lessthan", "lessthanorequals"):
+            if isinstance(value, str) and value != "0":
+                vd = parse_duration(value)
+                vq = parse_quantity(value)
+                try:
+                    vf: Optional[float] = float(value)
+                except ValueError:
+                    vf = None
+                if vd is None and vq is None and vf is None:
+                    raise Unsupported("possible semver comparison value")
+        return CondIR(key_ir, op, value)
+
+    def _check_literal_value(self, value: Any) -> None:
+        if isinstance(value, str):
+            if "{{" in value:
+                raise Unsupported("variable in condition value")
+            if contains_wildcard(value):
+                raise Unsupported("glob condition value")
+            if get_operator_from_string_pattern(value) in (Operator.IN_RANGE, Operator.NOT_IN_RANGE):
+                raise Unsupported("range expression value")
+            try:
+                import json
+
+                if isinstance(json.loads(value), list):
+                    raise Unsupported("JSON-array-encoded condition value")
+            except ValueError:
+                pass
+            return
+        if isinstance(value, list):
+            for v in value:
+                self._check_literal_value(v)
+            return
+        if isinstance(value, (bool, int, float)) or value is None:
+            return
+        raise Unsupported("unsupported condition value type")
+
+    # -- key AST lowering
+
+    def _compile_key(self, ast: Tuple) -> Any:
+        default: Optional[Any] = None
+        if ast[0] == "or":
+            lhs, rhs = ast[1], ast[2]
+            if rhs[0] != "literal":
+                raise Unsupported("non-literal || default")
+            default = rhs[1]
+            ast = lhs
+        if ast == ("subexpression", ("field", "request"), ("field", "operation")):
+            return OpKey(default if isinstance(default, (str, type(None))) else None)
+        self._keys_error_states: List[PathState] = []
+        states, roots, is_proj = self._walk(ast)
+        return PathCollect(states, roots, is_proj, default,
+                           keys_error_states=self._keys_error_states)
+
+    def _walk(self, ast: Tuple) -> Tuple[List[PathState], List[Tuple[str, ...]], bool]:
+        """Symbolic path-set evaluation. Returns (states, array_roots,
+        is_projection). The AST must be rooted at request.object."""
+        kind = ast[0]
+        if kind == "subexpression":
+            states, roots, proj = self._walk_lhs(ast[1])
+            return self._apply_rhs(ast[2], states, roots, proj)
+        if kind == "projection":
+            flat = ast[1]
+            if flat[0] != "flatten":
+                raise Unsupported("non-flatten projection")
+            states, roots, _ = self._walk_lhs(flat[1])
+            estates, eroots = self._flatten(states)
+            roots = roots + eroots
+            out_states, out_roots, _ = self._apply_rhs(ast[2], estates, roots, True)
+            return out_states, out_roots, True
+        if kind == "field":
+            raise Unsupported("key not rooted at request.object")
+        raise Unsupported(f"jmespath construct {kind}")
+
+    def _walk_lhs(self, ast: Tuple) -> Tuple[List[PathState], List[Tuple[str, ...]], bool]:
+        # base case: request.object
+        if ast == ("subexpression", ("field", "request"), ("field", "object")):
+            return [PathState((), "value")], [], False
+        if ast[0] in ("subexpression", "projection"):
+            return self._walk(ast)
+        raise Unsupported(f"jmespath construct {ast[0]}")
+
+    def _apply_rhs(self, rhs: Tuple, states: List[PathState],
+                   roots: List[Tuple[str, ...]], proj: bool):
+        kind = rhs[0]
+        if kind == "field":
+            out = []
+            for st in states:
+                if st.mode == "keys":
+                    raise Unsupported("field access on keys()")
+                # extending the path resets splice exclusion (it applied
+                # to rows at the previous depth); projections still drop
+                # null results
+                out.append(PathState(st.segs + (rhs[1],), "value", no_null=proj))
+            return out, roots, proj
+        if kind == "subexpression":
+            states, roots, proj = self._apply_rhs(rhs[1], states, roots, proj)
+            return self._apply_rhs(rhs[2], states, roots, proj)
+        if kind == "multiselect_list":
+            out = []
+            for sub in rhs[1]:
+                s2, r2, _ = self._apply_rhs(sub, states, roots, proj)
+                out.extend(s2)
+            # a multiselect yields a literal list whenever its input is
+            # non-null; record the input paths as mselect roots and mark
+            # states so a following flatten treats each as one element
+            roots = roots + [(s.segs, "mselect") for s in states if s.mode == "value"]
+            return [PathState(s.segs, "mselect") for s in out], roots, proj
+        if kind == "identity" or kind == "current":
+            return states, roots, proj
+        if kind == "function" and rhs[1] == "keys":
+            if rhs[2] != [("current",)] and rhs[2] != [("identity",)]:
+                raise Unsupported("keys() with non-@ argument")
+            self._keys_error_states.extend(states)
+            return [PathState(s.segs, "keys") for s in states], roots, proj
+        raise Unsupported(f"jmespath construct {kind}")
+
+    def _flatten(self, states: List[PathState]):
+        """[] applied to the value(s): arrays are spliced one level,
+        non-array elements (maps, scalars, nulls) stay as elements."""
+        out: List[PathState] = []
+        roots: List[Tuple[Tuple[str, ...], str]] = []
+        for st in states:
+            if st.mode == "keys":
+                out.append(st)  # already a flat string list
+            elif st.mode == "mselect":
+                # element is the sub-value itself; arrays splice
+                out.append(PathState(st.segs, "value", no_arr=True, no_null=True))
+                out.append(PathState(st.segs + (ARRAY_SEG,), "value", no_null=True))
+            else:
+                out.append(PathState(st.segs + (ARRAY_SEG,), "value",
+                                     no_arr=True, no_null=True))
+                out.append(PathState(st.segs + (ARRAY_SEG, ARRAY_SEG), "value",
+                                     no_null=True))
+                roots.append((st.segs, "array"))
+        return out, roots
+
+
+# ---------------------------------------------------------------------------
+# match / exclude IR
+
+
+@dataclass
+class KindSel:
+    group: str
+    version: str
+    kind: str
+    sub: str
+
+
+@dataclass
+class SelectorIR:
+    match_labels: List[Tuple[str, str]]
+    expressions: List[Tuple[str, str, List[str]]]  # (key, op, values)
+    invalid: bool  # malformed selector => constant "does not match"
+
+
+@dataclass
+class FilterIR:
+    kinds: List[KindSel]
+    name: str
+    names: List[str]
+    namespaces: List[str]
+    annotations: List[Tuple[str, str]]
+    selector: Optional[SelectorIR]
+    ns_selector: Optional[SelectorIR]
+    operations: List[str]
+    roles: List[str]
+    cluster_roles: List[str]
+    subjects: List[Dict[str, Any]]
+    resources_empty: bool
+    user_empty: bool
+
+
+@dataclass
+class MatchIR:
+    mode: str  # any | all | legacy
+    filters: List[FilterIR]
+
+
+def _compile_selector(sel: Optional[Dict[str, Any]]) -> Optional[SelectorIR]:
+    if sel is None:
+        return None
+    from ..engine.selector import SelectorError, matches_selector
+
+    ml = [(str(k), str(v)) for k, v in (sel.get("matchLabels") or {}).items()]
+    for k, v in ml:
+        if contains_wildcard(k) or contains_wildcard(v):
+            raise Unsupported("wildcard label selector")
+    exprs: List[Tuple[str, str, List[str]]] = []
+    for e in sel.get("matchExpressions") or []:
+        exprs.append((str(e.get("key")), str(e.get("operator")), [str(v) for v in (e.get("values") or [])]))
+    # malformed selectors become a constant no-match (scalar engine adds
+    # a "failed to parse selector" reason)
+    try:
+        matches_selector(sel, {})
+        invalid = False
+    except SelectorError:
+        invalid = True
+    except Exception:
+        raise Unsupported("selector evaluation error")
+    return SelectorIR(ml, exprs, invalid)
+
+
+def _compile_filter(rf: ResourceFilter) -> FilterIR:
+    rd: ResourceDescription = rf.resources
+    ui: UserInfo = rf.user_info
+    kinds: List[KindSel] = []
+    for k in rd.kinds:
+        g, v, kk, sub = kube.parse_kind_selector(k)
+        for part in (g, v, kk, sub):
+            if contains_wildcard(part) and part != "*":
+                raise Unsupported(f"glob kind selector {k}")
+        kinds.append(KindSel(g, v, kk, sub))
+    for a_k, a_v in (rd.annotations or {}).items():
+        if contains_wildcard(str(a_k)) or contains_wildcard(str(a_v)):
+            raise Unsupported("glob annotations match")
+    for s in ui.subjects or []:
+        if contains_wildcard(str(s.get("name", ""))) or contains_wildcard(str(s.get("namespace", ""))):
+            raise Unsupported("glob subject")
+        if s.get("kind") not in ("ServiceAccount", "User", "Group"):
+            raise Unsupported(f"subject kind {s.get('kind')}")
+    for r in list(ui.roles or []) + list(ui.cluster_roles or []):
+        if contains_wildcard(r):
+            raise Unsupported("glob role")
+    return FilterIR(
+        kinds=kinds,
+        name=rd.name,
+        names=list(rd.names),
+        namespaces=list(rd.namespaces),
+        annotations=[(str(k), str(v)) for k, v in (rd.annotations or {}).items()],
+        selector=_compile_selector(rd.selector),
+        ns_selector=_compile_selector(rd.namespace_selector),
+        operations=list(rd.operations),
+        roles=list(ui.roles),
+        cluster_roles=list(ui.cluster_roles),
+        subjects=list(ui.subjects),
+        resources_empty=rd.is_empty(),
+        user_empty=ui.is_empty(),
+    )
+
+
+def compile_match(rule: Rule) -> Tuple[MatchIR, MatchIR]:
+    match = rule.match
+    if match.any:
+        m = MatchIR("any", [_compile_filter(rf) for rf in match.any])
+    elif match.all:
+        m = MatchIR("all", [_compile_filter(rf) for rf in match.all])
+    else:
+        m = MatchIR("legacy", [_compile_filter(
+            ResourceFilter(resources=match.resources, user_info=match.user_info))])
+    exclude = rule.exclude
+    if exclude.any:
+        e = MatchIR("any", [_compile_filter(rf) for rf in exclude.any])
+    elif exclude.all:
+        e = MatchIR("all", [_compile_filter(rf) for rf in exclude.all])
+    else:
+        e = MatchIR("legacy", [_compile_filter(
+            ResourceFilter(resources=exclude.resources, user_info=exclude.user_info))])
+    return m, e
+
+
+# ---------------------------------------------------------------------------
+# rule program
+
+
+@dataclass
+class RuleProgram:
+    policy_name: str
+    rule_name: str
+    policy_namespace: str
+    match: Optional[MatchIR]
+    exclude: Optional[MatchIR]
+    preconditions: Optional[CondTreeIR]
+    kind: str  # pattern | any_pattern | deny
+    patterns: List[Node] = field(default_factory=list)
+    deny: Optional[CondTreeIR] = None
+    byte_paths: Set[int] = field(default_factory=set)
+    message: str = ""
+    # set when this rule cannot run on device
+    fallback_reason: Optional[str] = None
+
+
+def compile_rule(policy: ClusterPolicy, rule: Rule) -> RuleProgram:
+    """Compile one validate rule; raises Unsupported for host-only rules."""
+    v = rule.validation
+    if v is None:
+        raise Unsupported("not a validate rule")
+    if rule.context:
+        raise Unsupported("rule context entries")
+    match_ir, exclude_ir = compile_match(rule)
+    cc = ConditionCompiler()
+    pre_ir = cc.compile_tree(rule.preconditions)
+
+    prog = RuleProgram(
+        policy_name=policy.name,
+        rule_name=rule.name,
+        policy_namespace=policy.namespace,
+        match=match_ir,
+        exclude=exclude_ir,
+        preconditions=pre_ir,
+        kind="",
+        message=v.message or "",
+    )
+    if v.deny is not None:
+        prog.kind = "deny"
+        prog.deny = cc.compile_tree((v.deny or {}).get("conditions"))
+        return prog
+    if v.pattern is not None:
+        pc = PatternCompiler()
+        prog.kind = "pattern"
+        prog.patterns = [pc.compile(v.pattern)]
+        prog.byte_paths = pc.byte_paths
+        return prog
+    if v.any_pattern is not None:
+        pc = PatternCompiler()
+        prog.kind = "any_pattern"
+        prog.patterns = [pc.compile(p) for p in v.any_pattern]
+        prog.byte_paths = pc.byte_paths
+        return prog
+    raise Unsupported("foreach/podSecurity/cel/manifest rule")

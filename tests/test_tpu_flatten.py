@@ -1,0 +1,109 @@
+"""Flattener / metadata encoder unit tests."""
+
+import numpy as np
+
+from kyverno_tpu.tpu import flatten
+from kyverno_tpu.tpu.flatten import EncodeConfig, T_ARR, T_BOOL, T_MAP, T_NUM, T_STR, encode_resources
+from kyverno_tpu.tpu.hashing import hash_path, hash_str, split32
+from kyverno_tpu.tpu.metadata import encode_metadata
+
+POD = {
+    "apiVersion": "v1",
+    "kind": "Pod",
+    "metadata": {"name": "web", "namespace": "prod", "labels": {"app": "web"}},
+    "spec": {
+        "hostNetwork": False,
+        "containers": [
+            {"name": "a", "image": "nginx", "securityContext": {"privileged": True}},
+            {"name": "b", "image": "redis:7", "resources": {"limits": {"memory": "100Mi"}}},
+        ],
+    },
+}
+
+
+def _row(batch, i, segs):
+    h, l = split32(hash_path(segs))
+    mask = (batch.norm_hi[i] == h) & (batch.norm_lo[i] == l) & (batch.valid[i] == 1)
+    idx = np.nonzero(mask)[0]
+    return idx
+
+
+def test_row_paths_and_types():
+    b = encode_resources([POD])
+    (r,) = _row(b, 0, ("spec", "hostNetwork"))
+    assert b.type_tag[0, r] == T_BOOL and b.bool_val[0, r] == 0
+    (r,) = _row(b, 0, ("spec", "containers"))
+    assert b.type_tag[0, r] == T_ARR and b.arr_len[0, r] == 2
+    rows = _row(b, 0, ("spec", "containers", "[]"))
+    assert len(rows) == 2
+    assert sorted(b.scope1[0, rows].tolist()) == [0, 1]
+    rows = _row(b, 0, ("spec", "containers", "[]", "image"))
+    assert len(rows) == 2
+    assert all(b.type_tag[0, r] == T_STR for r in rows)
+
+
+def test_scope_indices_follow_elements():
+    b = encode_resources([POD])
+    rows = _row(b, 0, ("spec", "containers", "[]", "securityContext", "privileged"))
+    (r,) = rows
+    assert b.scope1[0, r] == 0  # only container a has privileged
+
+
+def test_numeric_lanes():
+    b = encode_resources([{"a": 2, "b": "2", "c": "2.0", "d": 2.0, "e": "100Mi"}])
+    (ra,) = _row(b, 0, ("a",))
+    (rb,) = _row(b, 0, ("b",))
+    (rc,) = _row(b, 0, ("c",))
+    (rd,) = _row(b, 0, ("d",))
+    (re_,) = _row(b, 0, ("e",))
+    # canonical number hash: 2 == "2" == 2.0 collapse; "2.0" only via float grammar
+    assert (b.num_hi[0, ra], b.num_lo[0, ra]) == (b.num_hi[0, rb], b.num_lo[0, rb])
+    assert (b.num_hi[0, ra], b.num_lo[0, ra]) == (b.num_hi[0, rd], b.num_lo[0, rd])
+    assert (b.num_hi[0, rc], b.num_lo[0, rc]) == (b.num_hi[0, ra], b.num_lo[0, ra])
+    assert b.str_goint[0, rb] == 1 and b.str_goint[0, rc] == 0 and b.str_gofloat[0, rc] == 1
+    # quantity lane: 100Mi parses
+    assert b.has_qty[0, re_] == 1 and b.qty_val[0, re_] == np.float32(100 * 2**20)
+    # "2" as quantity too
+    assert b.has_qty[0, rb] == 1
+
+
+def test_byte_pool_policy_aware():
+    p = hash_path(("spec", "containers", "[]", "image"))
+    b = encode_resources([POD], byte_paths={p})
+    rows = _row(b, 0, ("spec", "containers", "[]", "image"))
+    slots = b.byte_slot[0, rows]
+    assert all(s >= 0 for s in slots)
+    texts = set()
+    for s in slots:
+        n = b.pool_len[0, s]
+        texts.add(bytes(b.pool[0, s, :n]).decode())
+    assert texts == {"nginx", "redis:7"}
+    # non-requested paths get no slot
+    (r,) = _row(b, 0, ("metadata", "name"))
+    assert b.byte_slot[0, r] == -1
+
+
+def test_overflow_flags_fallback():
+    big = {"items": [{"x": i} for i in range(40)]}
+    b = encode_resources([big], EncodeConfig(max_rows=32))
+    assert b.fallback[0] == 1
+    b2 = encode_resources([POD])
+    assert b2.fallback[0] == 0
+
+
+def test_metadata_encoding():
+    m = encode_metadata(
+        [POD],
+        namespace_labels={"prod": {"env": "prod"}},
+        operations=["CREATE"],
+    )
+    assert tuple(m.kind_h[0]) == split32(hash_str("Pod", tag="K"))
+    assert bytes(m.name_bytes[0, : m.name_len[0]]).decode() == "web"
+    assert m.labels_n[0] == 1
+    assert m.nsl_n[0] == 1
+    assert m.op_code[0] == 1
+    assert m.admission_empty[0] == 1
+    ns = {"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": "prod"}}
+    m2 = encode_metadata([ns], namespace_labels={"prod": {"env": "prod"}})
+    assert m2.is_namespace_kind[0] == 1
+    assert m2.nsl_n[0] == 1  # Namespace resources join their own labels

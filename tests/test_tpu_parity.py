@@ -1,0 +1,363 @@
+"""Device-vs-scalar parity: the TPU program must agree with the scalar
+oracle on every (rule, resource) verdict."""
+
+import numpy as np
+import pytest
+
+from kyverno_tpu.api.policy import ClusterPolicy
+from kyverno_tpu.engine.engine import Engine as ScalarEngine
+from kyverno_tpu.tpu.engine import (
+    NOT_MATCHED,
+    TpuEngine,
+    VERDICT_NAMES,
+    _scalar_rule_verdicts,
+    build_scan_context,
+)
+
+
+def make_policy(name, rules):
+    return ClusterPolicy.from_dict(
+        {
+            "apiVersion": "kyverno.io/v1",
+            "kind": "ClusterPolicy",
+            "metadata": {"name": name},
+            "spec": {"rules": rules},
+        }
+    )
+
+
+def scalar_table(policies, resources, ns_labels=None, operations=None):
+    eng = ScalarEngine()
+    rows = []
+    for policy in policies:
+        rule_names = [r.name for r in policy.get_rules() if r.has_validate()]
+        per_rule = {rn: [] for rn in rule_names}
+        for ci, res in enumerate(resources):
+            kind = res.get("kind", "")
+            ns = (res.get("metadata") or {}).get("namespace", "")
+            key = (res.get("metadata") or {}).get("name", "") if kind == "Namespace" else ns
+            nsl = (ns_labels or {}).get(key, {})
+            op = (operations[ci] if operations else "") or ""
+            pctx = build_scan_context(policy, res, nsl, op)
+            verdicts = _scalar_rule_verdicts(eng, policy, pctx)
+            for rn in rule_names:
+                per_rule[rn].append(verdicts[rn])
+        for rn in rule_names:
+            rows.append(((policy.name, rn), per_rule[rn]))
+    return rows
+
+
+def check_parity(policies, resources, ns_labels=None, operations=None):
+    eng = TpuEngine(policies)
+    result = eng.scan(resources, ns_labels, operations)
+    expected = scalar_table(policies, resources, ns_labels, operations)
+    assert [r for r in result.rules] == [e[0] for e in expected]
+    for row, ((pname, rname), exp) in enumerate(expected):
+        got = result.verdicts[row].tolist()
+        assert got == exp, (
+            f"{pname}/{rname}: device={[VERDICT_NAMES[v] for v in got]} "
+            f"scalar={[VERDICT_NAMES[v] for v in exp]}"
+        )
+    return eng
+
+
+def pod(name="p", ns="default", spec=None, labels=None, kind="Pod"):
+    return {
+        "apiVersion": "v1",
+        "kind": kind,
+        "metadata": {"name": name, "namespace": ns, **({"labels": labels} if labels else {})},
+        "spec": spec if spec is not None else {},
+    }
+
+
+HOST_NS_RULE = {
+    "name": "host-namespaces",
+    "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+    "validate": {
+        "message": "host namespaces are disallowed",
+        "pattern": {
+            "spec": {"=(hostPID)": "false", "=(hostIPC)": "false", "=(hostNetwork)": "false"}
+        },
+    },
+}
+
+
+def test_equality_anchor_pattern():
+    policies = [make_policy("disallow-host-namespaces", [HOST_NS_RULE])]
+    resources = [
+        pod(spec={}),                                  # keys absent -> pass
+        pod(spec={"hostPID": True}),                   # true -> fail
+        pod(spec={"hostNetwork": False}),              # false -> pass
+        pod(spec={"hostIPC": "false"}),                # string false -> pass
+        pod(kind="Service"),                           # not matched
+        pod(spec={"hostPID": False, "hostIPC": True}),  # one bad -> fail
+    ]
+    eng = check_parity(policies, resources)
+    assert eng.coverage() == (1, 1)
+
+
+PRIVILEGED_RULE = {
+    "name": "privileged-containers",
+    "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+    "validate": {
+        "message": "privileged mode is disallowed",
+        "pattern": {
+            "spec": {
+                "=(ephemeralContainers)": [{"=(securityContext)": {"=(privileged)": "false"}}],
+                "=(initContainers)": [{"=(securityContext)": {"=(privileged)": "false"}}],
+                "containers": [{"=(securityContext)": {"=(privileged)": "false"}}],
+            }
+        },
+    },
+}
+
+
+def test_array_of_maps_anchors():
+    policies = [make_policy("disallow-privileged", [PRIVILEGED_RULE])]
+    resources = [
+        pod(spec={"containers": [{"name": "a"}]}),
+        pod(spec={"containers": [{"name": "a", "securityContext": {"privileged": True}}]}),
+        pod(spec={"containers": [{"name": "a", "securityContext": {"privileged": False}}]}),
+        pod(spec={"containers": [{"name": "a"}],
+                  "initContainers": [{"name": "b", "securityContext": {"privileged": True}}]}),
+        pod(spec={"containers": []}),
+        pod(spec={}),  # containers missing -> fail (plain key)
+        pod(spec={"containers": [{"securityContext": {}}]}),
+        pod(spec={"containers": [{"securityContext": {"privileged": "true"}}]}),
+    ]
+    check_parity(policies, resources)
+
+
+SECCOMP_RULE = {
+    "name": "seccomp",
+    "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+    "validate": {
+        "message": "custom seccomp profiles are disallowed",
+        "pattern": {
+            "spec": {
+                "=(securityContext)": {"=(seccompProfile)": {"=(type)": "RuntimeDefault | Localhost"}},
+                "containers": [
+                    {"=(securityContext)": {"=(seccompProfile)": {"=(type)": "RuntimeDefault | Localhost"}}}
+                ],
+            }
+        },
+    },
+}
+
+
+def test_or_alternatives_leaf():
+    policies = [make_policy("restrict-seccomp", [SECCOMP_RULE])]
+    resources = [
+        pod(spec={"containers": [{"name": "a"}]}),
+        pod(spec={"securityContext": {"seccompProfile": {"type": "Unconfined"}},
+                  "containers": [{"name": "a"}]}),
+        pod(spec={"securityContext": {"seccompProfile": {"type": "RuntimeDefault"}},
+                  "containers": [{"name": "a"}]}),
+        pod(spec={"containers": [{"securityContext": {"seccompProfile": {"type": "Localhost"}}}]}),
+        pod(spec={"containers": [{"securityContext": {"seccompProfile": {"type": "Bad"}}}]}),
+    ]
+    check_parity(policies, resources)
+
+
+CAPABILITIES_DENY_RULE = {
+    "name": "adding-capabilities",
+    "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+    "preconditions": {
+        "all": [
+            {"key": "{{ request.operation || 'BACKGROUND' }}", "operator": "NotEquals", "value": "DELETE"}
+        ]
+    },
+    "validate": {
+        "message": "capabilities beyond the allowed list are disallowed",
+        "deny": {
+            "conditions": {
+                "all": [
+                    {
+                        "key": "{{ request.object.spec.[ephemeralContainers, initContainers, containers][].securityContext.capabilities.add[] }}",
+                        "operator": "AnyNotIn",
+                        "value": ["AUDIT_WRITE", "CHOWN", "KILL", "NET_BIND_SERVICE", "SETUID"],
+                    }
+                ]
+            }
+        },
+    },
+}
+
+
+def test_deny_multiselect_capabilities():
+    policies = [make_policy("disallow-capabilities", [CAPABILITIES_DENY_RULE])]
+    resources = [
+        pod(spec={"containers": [{"name": "a"}]}),
+        pod(spec={"containers": [{"securityContext": {"capabilities": {"add": ["CHOWN"]}}}]}),
+        pod(spec={"containers": [{"securityContext": {"capabilities": {"add": ["SYS_ADMIN"]}}}]}),
+        pod(spec={
+            "containers": [{"securityContext": {"capabilities": {"add": ["KILL"]}}}],
+            "initContainers": [{"securityContext": {"capabilities": {"add": ["NET_RAW"]}}}],
+        }),
+        pod(spec={"containers": [{"securityContext": {"capabilities": {}}}]}),
+    ]
+    check_parity(policies, resources, operations=["", "", "", "", "DELETE"])
+
+
+VOLUME_TYPES_RULE = {
+    "name": "restricted-volumes",
+    "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+    "validate": {
+        "message": "only allowed volume types",
+        "deny": {
+            "conditions": {
+                "all": [
+                    {
+                        "key": "{{ request.object.spec.volumes[].keys(@)[] || '' }}",
+                        "operator": "AnyNotIn",
+                        "value": ["name", "configMap", "secret", "emptyDir",
+                                  "projected", "persistentVolumeClaim", "downwardAPI",
+                                  "csi", "ephemeral", ""],
+                    }
+                ]
+            }
+        },
+    },
+}
+
+
+def test_deny_keys_projection():
+    policies = [make_policy("restrict-volume-types", [VOLUME_TYPES_RULE])]
+    resources = [
+        pod(spec={}),
+        pod(spec={"volumes": []}),
+        pod(spec={"volumes": [{"name": "v", "configMap": {"name": "c"}}]}),
+        pod(spec={"volumes": [{"name": "v", "hostPath": {"path": "/"}}]}),
+        pod(spec={"volumes": [{"name": "v", "secret": {}}, {"name": "w", "nfs": {}}]}),
+    ]
+    check_parity(policies, resources)
+
+
+def test_negation_and_anypattern():
+    rules = [
+        {
+            "name": "no-hostpath",
+            "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+            "validate": {
+                "pattern": {"spec": {"=(volumes)": [{"X(hostPath)": "null"}]}},
+            },
+        },
+        {
+            "name": "run-as-nonroot",
+            "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+            "validate": {
+                "anyPattern": [
+                    {"spec": {"securityContext": {"runAsNonRoot": True},
+                              "containers": [{"=(securityContext)": {"=(runAsNonRoot)": True}}]}},
+                    {"spec": {"containers": [{"securityContext": {"runAsNonRoot": True}}]}},
+                ],
+            },
+        },
+    ]
+    policies = [make_policy("p", rules)]
+    resources = [
+        pod(spec={"volumes": [{"name": "v", "emptyDir": {}}],
+                  "containers": [{"name": "a"}]}),
+        pod(spec={"volumes": [{"name": "v", "hostPath": {"path": "/"}}],
+                  "containers": [{"securityContext": {"runAsNonRoot": True}}]}),
+        pod(spec={"securityContext": {"runAsNonRoot": True},
+                  "containers": [{"name": "a"}]}),
+        pod(spec={"securityContext": {"runAsNonRoot": True},
+                  "containers": [{"securityContext": {"runAsNonRoot": False}}]}),
+        pod(spec={"containers": [{"securityContext": {"runAsNonRoot": True}},
+                                 {"securityContext": {"runAsNonRoot": True}}]}),
+    ]
+    check_parity(policies, resources)
+
+
+def test_match_exclude_selectors_namespaces():
+    rules = [
+        {
+            "name": "ns-gate",
+            "match": {"any": [{"resources": {"kinds": ["Pod"], "namespaces": ["prod-*"],
+                                             "selector": {"matchLabels": {"app": "web"}}}}]},
+            "exclude": {"any": [{"resources": {"names": ["skip-me"]}}]},
+            "validate": {"pattern": {"spec": {"=(hostNetwork)": "false"}}},
+        }
+    ]
+    policies = [make_policy("gated", rules)]
+    resources = [
+        pod(ns="prod-eu", labels={"app": "web"}, spec={"hostNetwork": True}),
+        pod(ns="prod-eu", labels={"app": "db"}, spec={"hostNetwork": True}),
+        pod(ns="dev", labels={"app": "web"}, spec={"hostNetwork": True}),
+        pod(name="skip-me", ns="prod-us", labels={"app": "web"}, spec={"hostNetwork": True}),
+        pod(ns="prod-us", labels={"app": "web"}, spec={}),
+    ]
+    check_parity(policies, resources)
+
+
+def test_glob_leaf_operand():
+    rules = [
+        {
+            "name": "image-registry",
+            "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+            "validate": {
+                "pattern": {"spec": {"containers": [{"image": "registry.corp.io/* | docker.io/*"}]}},
+            },
+        }
+    ]
+    policies = [make_policy("images", rules)]
+    resources = [
+        pod(spec={"containers": [{"image": "registry.corp.io/app:1"}]}),
+        pod(spec={"containers": [{"image": "evil.io/app"}]}),
+        pod(spec={"containers": [{"image": "docker.io/nginx"},
+                                 {"image": "registry.corp.io/x"}]}),
+        pod(spec={"containers": [{"image": "docker.io/nginx"}, {"image": "quay.io/x"}]}),
+        pod(spec={"containers": [{"name": "no-image"}]}),
+    ]
+    check_parity(policies, resources)
+
+
+def test_operator_leaves():
+    rules = [
+        {
+            "name": "limits",
+            "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+            "validate": {
+                "pattern": {
+                    "spec": {
+                        "containers": [
+                            {"resources": {"limits": {"memory": "<=1Gi", "cpu": "<2"}}}
+                        ]
+                    }
+                },
+            },
+        }
+    ]
+    policies = [make_policy("limits", rules)]
+    resources = [
+        pod(spec={"containers": [{"resources": {"limits": {"memory": "512Mi", "cpu": "500m"}}}]}),
+        pod(spec={"containers": [{"resources": {"limits": {"memory": "2Gi", "cpu": "1"}}}]}),
+        pod(spec={"containers": [{"resources": {"limits": {"memory": "1Gi", "cpu": 2}}}]}),
+        pod(spec={"containers": [{"resources": {"limits": {"memory": "1024Mi", "cpu": "1.5"}}}]}),
+        pod(spec={"containers": [{"name": "a"}]}),
+    ]
+    check_parity(policies, resources)
+
+
+def test_host_fallback_rules_complete():
+    rules = [
+        {
+            "name": "foreach-rule",  # unsupported on device
+            "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+            "validate": {
+                "foreach": [
+                    {"list": "request.object.spec.containers",
+                     "pattern": {"image": "docker.io/*"}}
+                ],
+            },
+        },
+        HOST_NS_RULE,
+    ]
+    policies = [make_policy("mixed", rules)]
+    resources = [
+        pod(spec={"containers": [{"image": "docker.io/a"}], "hostPID": True}),
+        pod(spec={"containers": [{"image": "evil.io/a"}]}),
+    ]
+    eng = check_parity(policies, resources)
+    assert eng.coverage() == (1, 2)
