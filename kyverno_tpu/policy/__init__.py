@@ -1,0 +1,1 @@
+"""Policy lifecycle services: autogen, loading, cache, validation."""

@@ -1,0 +1,128 @@
+"""Full-set parity fuzz: the autogen-expanded 18-policy PSS library vs
+randomized pod/controller resources, device verdicts == scalar oracle."""
+
+import random
+
+import pytest
+
+from kyverno_tpu.policies import load_pss_policies
+from kyverno_tpu.policy.autogen import expand_policy
+
+from test_tpu_parity import check_parity
+
+
+def _sec_ctx(rng, pod_level=False):
+    out = {}
+    if rng.random() < 0.3:
+        out["privileged"] = rng.choice([True, False, "false", "true"])
+    if rng.random() < 0.3:
+        out["allowPrivilegeEscalation"] = rng.choice([True, False])
+    if rng.random() < 0.3:
+        out["runAsNonRoot"] = rng.choice([True, False])
+    if rng.random() < 0.3:
+        out["runAsUser"] = rng.choice([0, 1000, "0", 65535])
+    if rng.random() < 0.2:
+        out["runAsGroup"] = rng.choice([0, 3000])
+    if rng.random() < 0.25:
+        out["seccompProfile"] = {"type": rng.choice(
+            ["RuntimeDefault", "Localhost", "Unconfined", None])}
+    if rng.random() < 0.2:
+        out["seLinuxOptions"] = {
+            k: v for k, v in {
+                "type": rng.choice(["container_t", "spc_t", None]),
+                "user": rng.choice(["system_u", None]),
+                "role": rng.choice(["system_r", None]),
+            }.items() if v is not None
+        }
+    if rng.random() < 0.2:
+        out["capabilities"] = {
+            rng.choice(["add", "drop"]): rng.sample(
+                ["ALL", "CHOWN", "SYS_ADMIN", "KILL", "NET_RAW", "NET_BIND_SERVICE"],
+                k=rng.randint(0, 3),
+            )
+        }
+    if not pod_level and rng.random() < 0.2:
+        out["procMount"] = rng.choice(["Default", "Unmasked"])
+    if not pod_level and rng.random() < 0.15:
+        out["windowsOptions"] = {"hostProcess": rng.choice([True, False])}
+    if pod_level and rng.random() < 0.2:
+        out["sysctls"] = [{"name": rng.choice(
+            ["kernel.shm_rmid_forced", "net.core.somaxconn", "net.ipv4.tcp_syncookies"]),
+            "value": "1"}]
+    if pod_level and rng.random() < 0.2:
+        out["supplementalGroups"] = rng.sample([0, 1000, 2000], k=rng.randint(1, 2))
+    if pod_level and rng.random() < 0.2:
+        out["fsGroup"] = rng.choice([0, 2000])
+    return out
+
+
+def _container(rng, name):
+    c = {"name": name, "image": rng.choice(["nginx", "docker.io/redis:7", "evil.io/x"])}
+    sc = _sec_ctx(rng)
+    if sc or rng.random() < 0.3:
+        c["securityContext"] = sc
+    if rng.random() < 0.25:
+        ports = [{"containerPort": 80}]
+        if rng.random() < 0.5:
+            ports[0]["hostPort"] = rng.choice([0, 8080])
+        c["ports"] = ports
+    return c
+
+
+def _volume(rng, i):
+    kind = rng.choice(["emptyDir", "configMap", "hostPath", "secret", "nfs"])
+    body = {"path": "/"} if kind == "hostPath" else {}
+    return {"name": f"v{i}", kind: body}
+
+
+def _pod_spec(rng):
+    spec = {"containers": [_container(rng, f"c{i}") for i in range(rng.randint(1, 3))]}
+    if rng.random() < 0.3:
+        spec["initContainers"] = [_container(rng, "init")]
+    if rng.random() < 0.15:
+        spec["ephemeralContainers"] = [_container(rng, "dbg")]
+    for key in ("hostPID", "hostIPC", "hostNetwork"):
+        if rng.random() < 0.2:
+            spec[key] = rng.choice([True, False])
+    if rng.random() < 0.35:
+        spec["volumes"] = [_volume(rng, i) for i in range(rng.randint(1, 3))]
+    sc = _sec_ctx(rng, pod_level=True)
+    if sc:
+        spec["securityContext"] = sc
+    return spec
+
+
+def _resource(rng, i):
+    kind = rng.choice(["Pod"] * 4 + ["Deployment", "CronJob", "Service"])
+    meta = {"name": f"r{i}", "namespace": rng.choice(["default", "prod", "kube-system"])}
+    if rng.random() < 0.2:
+        meta["annotations"] = {
+            "container.apparmor.security.beta.kubernetes.io/c0": rng.choice(
+                ["runtime/default", "localhost/prof", "unconfined"])
+        }
+    if kind == "Pod":
+        return {"apiVersion": "v1", "kind": "Pod", "metadata": meta, "spec": _pod_spec(rng)}
+    if kind == "Deployment":
+        return {
+            "apiVersion": "apps/v1", "kind": "Deployment", "metadata": meta,
+            "spec": {"replicas": 1,
+                     "template": {"metadata": {"labels": {"app": "x"}},
+                                  "spec": _pod_spec(rng)}},
+        }
+    if kind == "CronJob":
+        return {
+            "apiVersion": "batch/v1", "kind": "CronJob", "metadata": meta,
+            "spec": {"schedule": "* * * * *",
+                     "jobTemplate": {"spec": {"template": {"spec": _pod_spec(rng)}}}},
+        }
+    return {"apiVersion": "v1", "kind": "Service", "metadata": meta,
+            "spec": {"ports": [{"port": 80}]}}
+
+
+@pytest.mark.parametrize("seed", [7, 21, 1234])
+def test_pss_full_set_parity(seed):
+    rng = random.Random(seed)
+    policies = [expand_policy(p) for p in load_pss_policies()]
+    resources = [_resource(rng, i) for i in range(40)]
+    operations = [rng.choice(["", "CREATE", "UPDATE", "DELETE"]) for _ in resources]
+    check_parity(policies, resources, operations=operations)
