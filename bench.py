@@ -1,0 +1,113 @@
+#!/usr/bin/env python
+"""Benchmark: background-scan throughput of the TPU policy evaluator.
+
+Reproduces BASELINE.json config #2 (reports-controller full scan:
+bundled PSS policy set x resource snapshot) on whatever accelerator is
+attached, and prints ONE JSON line:
+
+    {"metric": "rule_resource_evals_per_sec", "value": ..., "unit":
+     "evals/s", "vs_baseline": ...}
+
+vs_baseline is measured / 1e6 — the north-star is >=1M rule x resource
+evaluations per second per chip (SURVEY §6).
+"""
+
+import json
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def make_snapshot(n, seed=0):
+    """Synthetic cluster snapshot: pods with varied security settings."""
+    rng = random.Random(seed)
+    out = []
+    for i in range(n):
+        containers = []
+        for c in range(rng.randint(1, 3)):
+            sc = {}
+            if rng.random() < 0.3:
+                sc["privileged"] = rng.choice([True, False])
+            if rng.random() < 0.4:
+                sc["allowPrivilegeEscalation"] = rng.choice([True, False])
+            if rng.random() < 0.3:
+                sc["runAsNonRoot"] = rng.choice([True, False])
+            if rng.random() < 0.3:
+                sc["seccompProfile"] = {"type": rng.choice(
+                    ["RuntimeDefault", "Unconfined", "Localhost"])}
+            if rng.random() < 0.2:
+                sc["capabilities"] = {"add": rng.sample(
+                    ["CHOWN", "KILL", "SYS_ADMIN", "NET_RAW"], k=rng.randint(1, 2))}
+            containers.append({
+                "name": f"c{c}", "image": rng.choice(["nginx:1.25", "redis:7"]),
+                **({"securityContext": sc} if sc else {}),
+                "resources": {"limits": {"memory": rng.choice(["256Mi", "1Gi", "4Gi"])}},
+            })
+        spec = {"containers": containers}
+        if rng.random() < 0.2:
+            spec["hostNetwork"] = rng.choice([True, False])
+        if rng.random() < 0.3:
+            spec["volumes"] = [{"name": "v", rng.choice(
+                ["emptyDir", "configMap", "hostPath", "secret"]): {}}]
+        if rng.random() < 0.3:
+            spec["securityContext"] = {"runAsUser": rng.choice([0, 1000])}
+        out.append({
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": f"pod-{i}",
+                         "namespace": rng.choice(["default", "prod", "dev"]),
+                         "labels": {"app": f"app-{i % 17}"}},
+            "spec": spec,
+        })
+    return out
+
+
+def main():
+    import jax
+    import numpy as np
+
+    from kyverno_tpu.policies import load_pss_policies
+    from kyverno_tpu.policy.autogen import expand_policy
+    from kyverno_tpu.parallel import ShardedScanner, make_mesh
+
+    n_resources = int(os.environ.get("BENCH_RESOURCES", "8192"))
+    policies = [expand_policy(p) for p in load_pss_policies()]
+    scanner = ShardedScanner(policies, mesh=make_mesh())
+    num_rules = len(scanner.cps.device_programs)
+
+    resources = make_snapshot(n_resources)
+    t0 = time.perf_counter()
+    batch, n = scanner.encode(resources)
+    t_encode = time.perf_counter() - t0
+
+    step = scanner.step_jitted()
+    # compile + warmup
+    v, c = step(batch)
+    jax.block_until_ready((v, c))
+
+    iters = int(os.environ.get("BENCH_ITERS", "10"))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        v, c = step(batch)
+    jax.block_until_ready((v, c))
+    dt = (time.perf_counter() - t0) / iters
+
+    evals = num_rules * scanner.pad(n)
+    evals_per_sec = evals / dt
+    result = {
+        "metric": "rule_resource_evals_per_sec",
+        "value": round(evals_per_sec, 1),
+        "unit": "evals/s",
+        "vs_baseline": round(evals_per_sec / 1e6, 3),
+    }
+    print(json.dumps(result))
+    if os.environ.get("BENCH_VERBOSE"):
+        print(f"# rules={num_rules} resources={n} step={dt*1000:.2f}ms "
+              f"encode={t_encode:.2f}s device={jax.devices()[0].platform}",
+              file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,1 @@
+"""kubectl-kyverno-equivalent CLI (cmd/cli/kubectl-kyverno)."""

@@ -1,0 +1,29 @@
+"""CLI entry: python -m kyverno_tpu.cli <command>."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from . import apply as apply_cmd
+from . import jp as jp_cmd
+
+VERSION = "0.1.0"
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="kyverno-tpu",
+        description="TPU-native Kyverno-equivalent policy CLI",
+    )
+    sub = parser.add_subparsers(dest="command", required=True)
+    apply_cmd.add_parser(sub)
+    jp_cmd.add_parser(sub)
+    v = sub.add_parser("version", help="print version")
+    v.set_defaults(func=lambda a: (print(f"kyverno-tpu {VERSION}"), 0)[1])
+    args = parser.parse_args(argv)
+    return args.func(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,199 @@
+"""`apply` — evaluate policies against resources offline.
+
+Equivalent of cmd/cli/kubectl-kyverno/commands/apply (command.go:72,
+processor/policy_processor.go:59): load policies and resources from
+files/dirs/stdin, autogen-expand, run mutate then validate per
+resource, print results and exit non-zero on enforce failures.
+
+The validate stage runs on the batch engine: `--engine tpu` (default)
+compiles the policy set once and evaluates the full cross-product on
+the accelerator; `--engine scalar` forces the host oracle (the
+reference's Go-path analogue, selectable like pkg/toggle gates).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+import yaml
+
+from ..api.policy import ClusterPolicy, is_policy_document
+from ..engine.engine import Engine as ScalarEngine
+from ..policy.autogen import expand_policy
+from ..tpu.evaluator import FAIL, NOT_MATCHED
+
+
+def _iter_yaml_files(paths: List[str]):
+    for p in paths:
+        if p == "-":
+            yield "-", sys.stdin.read()
+            continue
+        if os.path.isdir(p):
+            for root, _, files in os.walk(p):
+                for f in sorted(files):
+                    if f.endswith((".yaml", ".yml", ".json")):
+                        fp = os.path.join(root, f)
+                        with open(fp) as fh:
+                            yield fp, fh.read()
+        else:
+            with open(p) as fh:
+                yield p, fh.read()
+
+
+def _load_docs(paths: List[str]) -> List[Dict[str, Any]]:
+    docs: List[Dict[str, Any]] = []
+    for name, text in _iter_yaml_files(paths):
+        try:
+            for d in yaml.safe_load_all(text):
+                if isinstance(d, dict):
+                    docs.append(d)
+        except yaml.YAMLError as e:
+            raise SystemExit(f"failed to parse {name}: {e}")
+    return docs
+
+
+def add_parser(sub: argparse._SubParsersAction) -> None:
+    p = sub.add_parser("apply", help="apply policies to resources")
+    p.add_argument("policies", nargs="+", help="policy files or directories")
+    p.add_argument("--resource", "-r", action="append", default=[],
+                   help="resource file/dir (repeatable, '-' for stdin)")
+    p.add_argument("--engine", choices=["tpu", "scalar"], default="tpu",
+                   help="validate executor (default tpu; scalar = host oracle)")
+    p.add_argument("--audit-warn", action="store_true",
+                   help="treat Audit-mode failures as warnings for the exit code")
+    p.add_argument("--detailed-results", action="store_true",
+                   help="print one line per rule result")
+    p.add_argument("--output-json", action="store_true",
+                   help="machine-readable summary on stdout")
+    p.set_defaults(func=run)
+
+
+def _verdict_rows(policies, resources, ns_labels, engine_kind):
+    """Returns list of (policy, rule_name, resource_idx, status, message)."""
+    if engine_kind == "tpu":
+        from ..tpu.engine import TpuEngine, VERDICT_NAMES
+
+        eng = TpuEngine(policies)
+        result = eng.scan(resources, ns_labels)
+        out = []
+        for row, (pname, rname) in enumerate(result.rules):
+            entry = eng.cps.rules[row]
+            policy = eng.cps.policies[entry.policy_idx]
+            for ci in range(len(resources)):
+                code = int(result.verdicts[row, ci])
+                if code == NOT_MATCHED:  # no result, like the engine
+                    continue
+                msg = ""
+                if code == FAIL:
+                    prog_msg = _rule_message(policy, rname)
+                    msg = prog_msg
+                out.append((policy, rname, ci, VERDICT_NAMES[code], msg))
+        return out
+    # scalar path
+    from ..tpu.engine import build_scan_context, _scalar_rule_verdicts, VERDICT_NAMES
+
+    eng = ScalarEngine()
+    out = []
+    for policy in policies:
+        for ci, res in enumerate(resources):
+            ns = (res.get("metadata") or {}).get("namespace", "")
+            pctx = build_scan_context(policy, res, (ns_labels or {}).get(ns, {}))
+            response = eng.validate(pctx)
+            for rr in response.policy_response.rules:
+                out.append((policy, rr.name, ci, rr.status, rr.message))
+    return out
+
+
+def _rule_message(policy: ClusterPolicy, rule_name: str) -> str:
+    for r in policy.get_rules():
+        if r.name == rule_name and r.validation is not None:
+            return (r.validation.message or "").strip()
+    return ""
+
+
+def _res_id(res: Dict[str, Any]) -> str:
+    meta = res.get("metadata") or {}
+    ns = meta.get("namespace", "")
+    kind = res.get("kind", "?")
+    name = meta.get("name", "?")
+    return f"{ns + '/' if ns else ''}{kind}/{name}"
+
+
+def _apply_mutations(policies, resources) -> Tuple[List[Dict[str, Any]], List[Tuple]]:
+    """Mutate stage (policy_processor.go:109): sequentially apply every
+    policy's mutate rules per resource; validation then runs on the
+    patched resources."""
+    from ..tpu.engine import build_scan_context
+
+    eng = ScalarEngine()
+    mutating = [p for p in policies if any(r.has_mutate() for r in p.get_rules())]
+    if not mutating:
+        return list(resources), []
+    patched_resources: List[Dict[str, Any]] = []
+    results: List[Tuple] = []
+    for ci, res in enumerate(resources):
+        current = res
+        for policy in mutating:
+            pctx = build_scan_context(policy, current, None)
+            response = eng.mutate(pctx)
+            for rr in response.policy_response.rules:
+                results.append((policy, rr.name, ci, rr.status, rr.message))
+            if response.patched_resource is not None:
+                current = response.patched_resource
+        patched_resources.append(current)
+    return patched_resources, results
+
+
+def run(args: argparse.Namespace) -> int:
+    policy_docs = [d for d in _load_docs(args.policies) if is_policy_document(d)]
+    if not policy_docs:
+        print("no policies found", file=sys.stderr)
+        return 2
+    resource_docs = [d for d in _load_docs(args.resource) if not is_policy_document(d)]
+    if not resource_docs:
+        print("no resources found", file=sys.stderr)
+        return 2
+    policies = [expand_policy(ClusterPolicy.from_dict(d)) for d in policy_docs]
+    enforce = {p.name: (p.spec.validation_failure_action or "Audit").lower()
+               for p in policies}
+
+    resource_docs, mutate_rows = _apply_mutations(policies, resource_docs)
+    rows = mutate_rows + _verdict_rows(policies, resource_docs, None, args.engine)
+
+    counts = {"pass": 0, "fail": 0, "warn": 0, "error": 0, "skip": 0}
+    failures: List[Tuple[str, str, str, str]] = []
+    for policy, rule, ci, status, msg in rows:
+        if status == "fail":
+            action = enforce.get(policy.name, "audit")
+            if args.audit_warn and action.startswith("audit"):
+                counts["warn"] += 1
+            else:
+                counts["fail"] += 1
+            failures.append((policy.name, rule, _res_id(resource_docs[ci]), msg))
+        elif status in counts:
+            counts[status] += 1
+        if args.detailed_results:
+            print(f"{policy.name}/{rule} -> {_res_id(resource_docs[ci])}: {status}"
+                  + (f" ({msg})" if msg and status != "pass" else ""))
+
+    if args.output_json:
+        print(json.dumps({"summary": counts,
+                          "failures": [
+                              {"policy": p, "rule": r, "resource": res, "message": m}
+                              for p, r, res, m in failures]}))
+    else:
+        for pname, rule, res, msg in failures:
+            first = (msg or "validation failure").splitlines()[0]
+            print(f"policy {pname} -> resource {res} failed:")
+            print(f"  {rule}: {first}")
+        total = sum(counts.values())
+        print(f"\nApplied {len(policies)} policy rule(s) to {len(resource_docs)} resource(s)...")
+        print(f"pass: {counts['pass']}, fail: {counts['fail']}, warn: {counts['warn']}, "
+              f"error: {counts['error']}, skip: {counts['skip']}")
+    if counts["error"]:
+        return 3
+    return 1 if counts["fail"] else 0

@@ -1,0 +1,3 @@
+"""Device-mesh parallelism for the scan engine."""
+
+from .sharding import ShardedScanner, make_mesh

@@ -1,0 +1,122 @@
+"""Sharded batch evaluation over a jax.sharding.Mesh.
+
+The scan workload is data-parallel over resources: every batch lane has
+a leading N axis, the compiled program is elementwise across it, and
+per-rule verdict counts are the only cross-device reduction (XLA lowers
+the sum over the sharded axis to an ICI all-reduce / reduce-scatter).
+This mirrors how the reference scales scans — sharding the resource
+keyspace across workers and replicas (SURVEY §2.7) — except the shards
+are TPU cores on one mesh instead of goroutine pools.
+
+Policies are replicated (they are compile-time constants baked into the
+program); resources shard. For multi-host, the same program runs under
+jax.distributed with the mesh spanning hosts — DCN carries only the
+final counts, ICI the within-slice reductions.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..api.policy import ClusterPolicy
+from ..tpu.compiler import CompiledPolicySet, compile_policy_set
+from ..tpu.evaluator import build_program
+from ..tpu.flatten import EncodeConfig, encode_resources
+from ..tpu.metadata import encode_metadata
+from ..tpu.evaluator import batch_to_device
+
+
+def make_mesh(devices: Optional[Sequence] = None, axis: str = "data") -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    return Mesh(np.array(devices), (axis,))
+
+
+class ShardedScanner:
+    """Compile once, evaluate resource batches sharded across a mesh.
+
+    The jitted step returns (verdicts, counts): the (rules, N) verdict
+    table sharded over N, plus per-(rule, verdict-class) totals reduced
+    across devices — the scan-service summary used for report rollups.
+    """
+
+    NUM_CLASSES = 6
+
+    def __init__(
+        self,
+        policies: Sequence[ClusterPolicy],
+        mesh: Optional[Mesh] = None,
+        encode_cfg: Optional[EncodeConfig] = None,
+    ):
+        self.cps: CompiledPolicySet = compile_policy_set(policies, encode_cfg)
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.axis = self.mesh.axis_names[0]
+        self._raw_fn = build_program(
+            self.cps.device_programs, self.cps.encode_cfg.max_instances
+        )
+        data_sharding = NamedSharding(self.mesh, P(self.axis))
+        repl = NamedSharding(self.mesh, P())
+
+        def step(batch: Dict[str, jnp.ndarray]):
+            verdicts = self._raw_fn(batch)  # (rules, N)
+            counts = jnp.stack(
+                [(verdicts == c).sum(axis=1) for c in range(self.NUM_CLASSES)],
+                axis=-1,
+            )  # (rules, classes) — cross-device reduction over the N shard
+            return verdicts, counts
+
+        self._step = jax.jit(
+            step,
+            in_shardings=({k: NamedSharding(self.mesh, P(self.axis))
+                           for k in self._batch_keys()},),
+            out_shardings=(NamedSharding(self.mesh, P(None, self.axis)), repl),
+        )
+
+    def _batch_keys(self):
+        # all batch lanes lead with N; enumerate from a tiny probe encode
+        rows = encode_resources([{}], self.cps.encode_cfg, ())
+        meta = encode_metadata([{}])
+        return list(batch_to_device(rows, meta).keys())
+
+    @property
+    def n_devices(self) -> int:
+        return self.mesh.size
+
+    def pad(self, n: int) -> int:
+        d = self.n_devices
+        return ((n + d - 1) // d) * d
+
+    def encode(self, resources, namespace_labels=None, operations=None):
+        n = len(resources)
+        padded = self.pad(max(n, 1))
+        res = list(resources) + [{} for _ in range(padded - n)]
+        ops = (list(operations) + [""] * (padded - n)) if operations else None
+        rows = encode_resources(res, self.cps.encode_cfg, self.cps.byte_paths)
+        meta = encode_metadata(res, namespace_labels, ops)
+        return batch_to_device(rows, meta), n
+
+    def scan_device(self, resources, namespace_labels=None, operations=None) -> Tuple[np.ndarray, np.ndarray]:
+        """Device layer only: (verdicts (device_rules, n), counts).
+        Verdicts may contain HOST(5) for resources exceeding encode
+        caps, and host-fallback rules are absent — use scan() for the
+        complete, resolved result."""
+        batch, n = self.encode(resources, namespace_labels, operations)
+        verdicts, counts = self._step(batch)
+        return np.asarray(verdicts)[:, :n], np.asarray(counts)
+
+    def scan(self, resources, namespace_labels=None, operations=None):
+        """Complete ScanResult over ALL rules: device verdicts merged
+        with scalar-engine completions (host rules + capped resources) —
+        HOST never escapes."""
+        from ..tpu.engine import TpuEngine
+
+        device_table, _ = self.scan_device(resources, namespace_labels, operations)
+        eng = TpuEngine.from_compiled(self.cps)
+        return eng.assemble(device_table, resources, namespace_labels, operations)
+
+    def step_jitted(self):
+        return self._step

@@ -107,6 +107,13 @@ class TpuEngine:
         self.cps: CompiledPolicySet = compile_policy_set(policies, encode_cfg, meta_cfg)
         self.scalar = ScalarEngine()
 
+    @classmethod
+    def from_compiled(cls, cps: CompiledPolicySet) -> "TpuEngine":
+        self = cls.__new__(cls)
+        self.cps = cps
+        self.scalar = ScalarEngine()
+        return self
+
     # -- encoding
 
     def encode(

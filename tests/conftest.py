@@ -8,3 +8,10 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+# the axon sitecustomize registers the tunneled TPU at interpreter
+# start and force-updates jax_platforms to "axon,cpu", overriding the
+# env var — update the config back so tests run on the virtual CPU mesh
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,82 @@
+"""CLI apply smoke tests (both engines share verdicts and exit codes)."""
+
+import json
+
+import pytest
+import yaml
+
+from kyverno_tpu.cli.__main__ import main
+
+POLICY = """
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata: {name: no-privileged}
+spec:
+  validationFailureAction: Enforce
+  rules:
+    - name: privileged
+      match: {any: [{resources: {kinds: [Pod]}}]}
+      validate:
+        message: privileged is forbidden
+        pattern:
+          spec:
+            containers:
+              - =(securityContext):
+                  =(privileged): "false"
+"""
+
+RESOURCES = """
+apiVersion: v1
+kind: Pod
+metadata: {name: bad, namespace: default}
+spec:
+  containers: [{name: c, image: nginx, securityContext: {privileged: true}}]
+---
+apiVersion: v1
+kind: Pod
+metadata: {name: ok, namespace: default}
+spec:
+  containers: [{name: c, image: nginx}]
+"""
+
+
+@pytest.fixture
+def files(tmp_path):
+    pol = tmp_path / "policy.yaml"
+    pol.write_text(POLICY)
+    res = tmp_path / "resources.yaml"
+    res.write_text(RESOURCES)
+    return str(pol), str(res)
+
+
+@pytest.mark.parametrize("engine", ["tpu", "scalar"])
+def test_apply_exit_code_and_summary(files, engine, capsys):
+    pol, res = files
+    rc = main(["apply", pol, "-r", res, "--engine", engine, "--output-json"])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 1
+    assert out["summary"]["fail"] == 1
+    # autogen expands the rule but controllers do not match pods
+    assert out["failures"][0]["resource"] == "default/Pod/bad"
+
+
+def test_apply_pass_exit_zero(files, tmp_path, capsys):
+    pol, _ = files
+    good = tmp_path / "good.yaml"
+    good.write_text(RESOURCES.split("---")[1])
+    rc = main(["apply", pol, "-r", str(good), "--output-json"])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0 and out["summary"]["fail"] == 0
+
+
+def test_jp_query(capsys):
+    import io
+    import sys
+
+    sys.stdin = io.StringIO('{"a": [1, 2, 3]}')
+    try:
+        rc = main(["jp", "query", "sum(a)"])
+    finally:
+        sys.stdin = sys.__stdin__
+    assert rc == 0
+    assert json.loads(capsys.readouterr().out) == 6
