@@ -33,7 +33,8 @@ def _iter_yaml_files(paths: List[str]):
             yield "-", sys.stdin.read()
             continue
         if os.path.isdir(p):
-            for root, _, files in os.walk(p):
+            for root, dirs, files in os.walk(p):
+                dirs.sort()  # deterministic policy load (and mutate) order
                 for f in sorted(files):
                     if f.endswith((".yaml", ".yml", ".json")):
                         fp = os.path.join(root, f)
@@ -83,18 +84,16 @@ def _verdict_rows(policies, resources, ns_labels, engine_kind):
         for row, (pname, rname) in enumerate(result.rules):
             entry = eng.cps.rules[row]
             policy = eng.cps.policies[entry.policy_idx]
+            fail_msg = _rule_message(policy, rname)
             for ci in range(len(resources)):
                 code = int(result.verdicts[row, ci])
                 if code == NOT_MATCHED:  # no result, like the engine
                     continue
-                msg = ""
-                if code == FAIL:
-                    prog_msg = _rule_message(policy, rname)
-                    msg = prog_msg
+                msg = fail_msg if code == FAIL else ""
                 out.append((policy, rname, ci, VERDICT_NAMES[code], msg))
         return out
     # scalar path
-    from ..tpu.engine import build_scan_context, _scalar_rule_verdicts, VERDICT_NAMES
+    from ..tpu.engine import build_scan_context
 
     eng = ScalarEngine()
     out = []
@@ -166,14 +165,17 @@ def run(args: argparse.Namespace) -> int:
 
     counts = {"pass": 0, "fail": 0, "warn": 0, "error": 0, "skip": 0}
     failures: List[Tuple[str, str, str, str]] = []
+    warnings: List[Tuple[str, str, str, str]] = []
     for policy, rule, ci, status, msg in rows:
         if status == "fail":
             action = enforce.get(policy.name, "audit")
+            entry = (policy.name, rule, _res_id(resource_docs[ci]), msg)
             if args.audit_warn and action.startswith("audit"):
                 counts["warn"] += 1
+                warnings.append(entry)
             else:
                 counts["fail"] += 1
-            failures.append((policy.name, rule, _res_id(resource_docs[ci]), msg))
+                failures.append(entry)
         elif status in counts:
             counts[status] += 1
         if args.detailed_results:
@@ -181,14 +183,19 @@ def run(args: argparse.Namespace) -> int:
                   + (f" ({msg})" if msg and status != "pass" else ""))
 
     if args.output_json:
-        print(json.dumps({"summary": counts,
-                          "failures": [
-                              {"policy": p, "rule": r, "resource": res, "message": m}
-                              for p, r, res, m in failures]}))
+        as_dicts = lambda items: [  # noqa: E731
+            {"policy": p, "rule": r, "resource": res, "message": m}
+            for p, r, res, m in items]
+        print(json.dumps({"summary": counts, "failures": as_dicts(failures),
+                          "warnings": as_dicts(warnings)}))
     else:
         for pname, rule, res, msg in failures:
             first = (msg or "validation failure").splitlines()[0]
             print(f"policy {pname} -> resource {res} failed:")
+            print(f"  {rule}: {first}")
+        for pname, rule, res, msg in warnings:
+            first = (msg or "validation failure").splitlines()[0]
+            print(f"policy {pname} -> resource {res} warning:")
             print(f"  {rule}: {first}")
         total = sum(counts.values())
         print(f"\nApplied {len(policies)} policy rule(s) to {len(resource_docs)} resource(s)...")

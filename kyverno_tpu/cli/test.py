@@ -1,0 +1,176 @@
+"""`test` — declarative regression harness (kyverno-test.yaml).
+
+Equivalent of cmd/cli/kubectl-kyverno/commands/test: discover test
+manifests, load their policies/resources, run the engine, and diff
+actual rule results against the declared expectations. Autogen rule
+names match through their base rule (a `rule: check-x` expectation
+accepts `autogen-check-x` / `autogen-cronjob-check-x` responses, the
+same normalization the reference applies in test/output.go).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+import yaml
+
+from ..api.policy import ClusterPolicy, is_policy_document
+from ..engine.engine import Engine as ScalarEngine
+from ..policy.autogen import expand_policy
+
+
+def add_parser(sub: argparse._SubParsersAction) -> None:
+    p = sub.add_parser("test", help="run declarative kyverno-test.yaml tests")
+    p.add_argument("paths", nargs="*", default=["."],
+                   help="dirs/files to search for kyverno-test.yaml")
+    p.add_argument("--fail-only", action="store_true",
+                   help="only print failing checks")
+    p.set_defaults(func=run)
+
+
+def _discover(paths: List[str]) -> List[str]:
+    found = []
+    for p in paths or ["."]:
+        if os.path.isfile(p):
+            found.append(p)
+            continue
+        for root, _, files in os.walk(p):
+            for f in files:
+                if f in ("kyverno-test.yaml", "kyverno-test.yml"):
+                    found.append(os.path.join(root, f))
+    return sorted(found)
+
+
+def _load_yaml_docs(path: str) -> List[Dict[str, Any]]:
+    with open(path) as f:
+        return [d for d in yaml.safe_load_all(f) if isinstance(d, dict)]
+
+
+class TestCase:
+    def __init__(self, path: str):
+        self.path = path
+        docs = _load_yaml_docs(path)
+        if not docs:
+            raise ValueError(f"{path}: empty test manifest")
+        self.spec = docs[0]
+        base = os.path.dirname(path)
+        self.policies: List[ClusterPolicy] = []
+        self.resources: List[Dict[str, Any]] = []
+        for rel in self.spec.get("policies") or []:
+            for d in _load_yaml_docs(os.path.join(base, rel)):
+                if is_policy_document(d):
+                    self.policies.append(ClusterPolicy.from_dict(d))
+        for rel in self.spec.get("resources") or []:
+            for d in _load_yaml_docs(os.path.join(base, rel)):
+                if not is_policy_document(d):
+                    self.resources.append(d)
+        values = self.spec.get("values") or {}
+        self.ns_labels: Dict[str, Dict[str, str]] = {}
+        for ns in values.get("namespaces") or []:
+            meta = ns.get("metadata") or {}
+            self.ns_labels[meta.get("name", "")] = dict(meta.get("labels") or {})
+        self.variables: Dict[str, Any] = {}
+        for gv in values.get("globalValues") or []:
+            self.variables.update(gv if isinstance(gv, dict) else {})
+        self.results: List[Dict[str, Any]] = list(self.spec.get("results") or [])
+
+    def name(self) -> str:
+        meta = self.spec.get("metadata") or {}
+        return meta.get("name") or self.spec.get("name") or self.path
+
+
+def _rule_names_match(expected: str, actual: str) -> bool:
+    return actual in (expected, f"autogen-{expected}", f"autogen-cronjob-{expected}")
+
+
+def _run_case(case: TestCase) -> List[Tuple[Dict[str, Any], str, bool]]:
+    """Returns (expected-result row, actual, ok) per declared result."""
+    from ..tpu.engine import build_scan_context
+
+    eng = ScalarEngine()
+    # evaluate every (policy, resource) once; collect rule responses
+    responses: List[Tuple[str, str, Dict[str, Any], str]] = []
+    patched: Dict[int, Dict[str, Any]] = {}
+    for policy in [expand_policy(p) for p in case.policies]:
+        for ri, res in enumerate(case.resources):
+            current = patched.get(ri, res)
+            meta = current.get("metadata") or {}
+            ns = meta.get("namespace", "")
+            key = meta.get("name", "") if current.get("kind") == "Namespace" else ns
+            pctx = build_scan_context(policy, current, case.ns_labels.get(key, {}))
+            for name, value in case.variables.items():
+                pctx.json_context.add_variable(name, value)
+            if any(r.has_mutate() for r in policy.get_rules()):
+                m = eng.mutate(pctx)
+                for rr in m.policy_response.rules:
+                    responses.append((policy.name, rr.name, current, rr.status))
+                if m.patched_resource is not None:
+                    patched[ri] = m.patched_resource
+                    current = m.patched_resource
+                    pctx = build_scan_context(policy, current, case.ns_labels.get(key, {}))
+                    for name, value in case.variables.items():
+                        pctx.json_context.add_variable(name, value)
+            v = eng.validate(pctx)
+            for rr in v.policy_response.rules:
+                responses.append((policy.name, rr.name, current, rr.status))
+
+    out = []
+    for exp in case.results:
+        want = (exp.get("result") or exp.get("status") or "").lower()
+        names = list(exp.get("resources") or [])
+        if exp.get("resource"):
+            names.append(exp["resource"])
+        kind = exp.get("kind")
+        matching = []
+        for pname, rname, res, status in responses:
+            if pname != exp.get("policy"):
+                continue
+            if exp.get("rule") and not _rule_names_match(exp["rule"], rname):
+                continue
+            meta = res.get("metadata") or {}
+            rid = meta.get("name", "")
+            nsid = f"{meta.get('namespace')}/{rid}" if meta.get("namespace") else rid
+            if names and rid not in names and nsid not in names:
+                continue
+            if kind and res.get("kind") != kind:
+                continue
+            matching.append(status)
+        if not matching:
+            out.append((exp, "no result found", False))
+            continue
+        # every matching response must carry the expected result
+        actual = sorted(set(matching))
+        ok = actual == [want]
+        out.append((exp, ",".join(actual), ok))
+    return out
+
+
+def run(args: argparse.Namespace) -> int:
+    files = _discover(args.paths)
+    if not files:
+        print("no kyverno-test.yaml found", file=sys.stderr)
+        return 2
+    total = failed = 0
+    for path in files:
+        try:
+            case = TestCase(path)
+        except Exception as e:
+            print(f"ERROR loading {path}: {e}", file=sys.stderr)
+            failed += 1
+            total += 1
+            continue
+        rows = _run_case(case)
+        for exp, actual, ok in rows:
+            total += 1
+            if not ok:
+                failed += 1
+            if ok and args.fail_only:
+                continue
+            tag = "PASS" if ok else "FAIL"
+            print(f"{tag}  {case.name()}: {exp.get('policy')}/{exp.get('rule')} "
+                  f"[{exp.get('kind')}] want={exp.get('result') or exp.get('status')} got={actual}")
+    print(f"\nTest summary: {total - failed} passed, {failed} failed")
+    return 1 if failed else 0

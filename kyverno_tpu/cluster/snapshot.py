@@ -1,0 +1,96 @@
+"""In-memory cluster snapshot — the scan engine's resource source.
+
+Plays the role of the reference's resource metadata cache + dynamic
+watchers (pkg/controllers/report/resource/controller.go:57
+MetadataCache): resources keyed by UID with a content hash so the scan
+service can detect change without re-reading; namespaces feed the
+namespaceSelector labels. Watch-style subscribers get (uid, change)
+callbacks, mirroring MetadataCache.AddEventHandler.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+
+def resource_hash(resource: Dict[str, Any]) -> str:
+    """Stable content hash (the reference hashes the full object JSON,
+    report/resource/controller.go)."""
+    return hashlib.sha256(
+        json.dumps(resource, sort_keys=True, separators=(",", ":")).encode()
+    ).hexdigest()[:16]
+
+
+def resource_uid(resource: Dict[str, Any]) -> str:
+    meta = resource.get("metadata") or {}
+    uid = meta.get("uid")
+    if uid:
+        return str(uid)
+    gvk = f"{resource.get('apiVersion', '')}/{resource.get('kind', '')}"
+    return f"{gvk}:{meta.get('namespace', '')}/{meta.get('name', '')}"
+
+
+class ClusterSnapshot:
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._resources: Dict[str, Dict[str, Any]] = {}
+        self._hashes: Dict[str, str] = {}
+        self._subscribers: List[Callable[[str, str], None]] = []
+
+    # -- mutation (watch events)
+
+    def upsert(self, resource: Dict[str, Any]) -> str:
+        uid = resource_uid(resource)
+        h = resource_hash(resource)
+        with self._lock:
+            changed = self._hashes.get(uid) != h
+            self._resources[uid] = resource
+            self._hashes[uid] = h
+        if changed:
+            self._notify(uid, "upsert")
+        return uid
+
+    def delete(self, uid_or_resource) -> None:
+        uid = uid_or_resource if isinstance(uid_or_resource, str) else resource_uid(uid_or_resource)
+        with self._lock:
+            self._resources.pop(uid, None)
+            self._hashes.pop(uid, None)
+        self._notify(uid, "delete")
+
+    def _notify(self, uid: str, change: str) -> None:
+        for fn in list(self._subscribers):
+            fn(uid, change)
+
+    def subscribe(self, fn: Callable[[str, str], None]) -> None:
+        self._subscribers.append(fn)
+
+    # -- reads
+
+    def get(self, uid: str) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            return self._resources.get(uid)
+
+    def hash_of(self, uid: str) -> Optional[str]:
+        with self._lock:
+            return self._hashes.get(uid)
+
+    def items(self) -> List[Tuple[str, Dict[str, Any], str]]:
+        with self._lock:
+            return [(uid, self._resources[uid], self._hashes[uid])
+                    for uid in self._resources]
+
+    def namespace_labels(self) -> Dict[str, Dict[str, str]]:
+        out: Dict[str, Dict[str, str]] = {}
+        with self._lock:
+            for res in self._resources.values():
+                if res.get("kind") == "Namespace":
+                    meta = res.get("metadata") or {}
+                    out[meta.get("name", "")] = dict(meta.get("labels") or {})
+        return out
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._resources)

@@ -1,0 +1,6 @@
+"""Observability: events, metrics, tracing (pkg/event, pkg/metrics,
+pkg/tracing equivalents)."""
+
+from .events import Event, EventGenerator
+from .metrics import MetricsRegistry, global_registry
+from .tracing import Span, Tracer

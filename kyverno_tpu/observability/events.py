@@ -1,0 +1,97 @@
+"""Event generator — async policy-event emission.
+
+Mirror of pkg/event/controller.go:34: events enqueue without blocking
+the admission/scan path, worker threads drain the queue to a pluggable
+sink (in-cluster this would be the Events API; offline it is a log or
+callback), the queue drops on overflow (maxQueuedEvents), and reasons
+can be omitted (omit-list, cmd/kyverno/main.go:354).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+REASON_POLICY_VIOLATION = "PolicyViolation"
+REASON_POLICY_APPLIED = "PolicyApplied"
+REASON_POLICY_ERROR = "PolicyError"
+REASON_POLICY_SKIPPED = "PolicySkipped"
+
+
+@dataclass
+class Event:
+    reason: str
+    message: str
+    policy: str = ""
+    rule: str = ""
+    resource_kind: str = ""
+    resource_name: str = ""
+    resource_namespace: str = ""
+    type: str = "Warning"  # Warning | Normal
+    related: Dict[str, Any] = field(default_factory=dict)
+
+
+class EventGenerator:
+    def __init__(
+        self,
+        sink: Optional[Callable[[Event], None]] = None,
+        workers: int = 3,
+        max_queued: int = 1000,
+        omit_reasons: Optional[List[str]] = None,
+    ) -> None:
+        self._sink = sink or (lambda e: None)
+        self._queue: "queue.Queue[Optional[Event]]" = queue.Queue(maxsize=max_queued)
+        self._omit = set(omit_reasons or [])
+        self.dropped = 0
+        self.emitted = 0
+        self._workers = [
+            threading.Thread(target=self._drain, daemon=True) for _ in range(workers)
+        ]
+        self._started = False
+        self._lock = threading.Lock()
+
+    def start(self) -> None:
+        with self._lock:
+            if not self._started:
+                for w in self._workers:
+                    w.start()
+                self._started = True
+
+    def add(self, *events: Event) -> None:
+        """Non-blocking enqueue; drops on overflow (the reference logs
+        and drops rather than back-pressuring admission)."""
+        self.start()
+        for e in events:
+            if e.reason in self._omit:
+                continue
+            try:
+                self._queue.put_nowait(e)
+            except queue.Full:
+                self.dropped += 1
+
+    def _drain(self) -> None:
+        while True:
+            e = self._queue.get()
+            if e is None:
+                return
+            try:
+                self._sink(e)
+                self.emitted += 1
+            except Exception:
+                pass
+
+    def flush(self, timeout: float = 5.0) -> None:
+        import time
+
+        deadline = time.time() + timeout
+        while not self._queue.empty() and time.time() < deadline:
+            time.sleep(0.01)
+
+    def stop(self) -> None:
+        for _ in self._workers:
+            try:
+                self._queue.put_nowait(None)
+            except queue.Full:
+                pass

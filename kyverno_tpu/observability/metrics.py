@@ -1,0 +1,158 @@
+"""Metrics — counters/gauges/histograms with Prometheus exposition.
+
+The reference instruments via OpenTelemetry with a Prometheus exporter
+(pkg/metrics/metrics.go:132). This registry covers the same instrument
+set (kyverno_policy_results_total, kyverno_policy_execution_duration_
+seconds, kyverno_admission_requests_total, ...) plus the TPU engine's
+own: batch sizes, device dispatch time, compile cache hits. Exposition
+is the Prometheus text format served by the admission server or a
+standalone endpoint.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+LabelKey = Tuple[Tuple[str, str], ...]
+
+
+def _labels_key(labels: Optional[Dict[str, str]]) -> LabelKey:
+    return tuple(sorted((labels or {}).items()))
+
+
+def _fmt_labels(key: LabelKey, extra: str = "") -> str:
+    parts = [f'{k}="{v}"' for k, v in key]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+class Counter:
+    def __init__(self, name: str, help_: str):
+        self.name, self.help = name, help_
+        self._values: Dict[LabelKey, float] = {}
+        self._lock = threading.Lock()
+
+    def inc(self, labels: Optional[Dict[str, str]] = None, value: float = 1.0) -> None:
+        k = _labels_key(labels)
+        with self._lock:
+            self._values[k] = self._values.get(k, 0.0) + value
+
+    def collect(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+        with self._lock:
+            for k, v in sorted(self._values.items()):
+                out.append(f"{self.name}{_fmt_labels(k)} {v}")
+        return out
+
+
+class Gauge(Counter):
+    def set(self, value: float, labels: Optional[Dict[str, str]] = None) -> None:
+        with self._lock:
+            self._values[_labels_key(labels)] = value
+
+    def collect(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
+        with self._lock:
+            for k, v in sorted(self._values.items()):
+                out.append(f"{self.name}{_fmt_labels(k)} {v}")
+        return out
+
+
+_DEFAULT_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                    0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+
+class Histogram:
+    def __init__(self, name: str, help_: str, buckets: Sequence[float] = _DEFAULT_BUCKETS):
+        self.name, self.help = name, help_
+        self.buckets = list(buckets)
+        self._counts: Dict[LabelKey, List[int]] = {}
+        self._sums: Dict[LabelKey, float] = {}
+        self._totals: Dict[LabelKey, int] = {}
+        self._lock = threading.Lock()
+
+    def observe(self, value: float, labels: Optional[Dict[str, str]] = None) -> None:
+        k = _labels_key(labels)
+        with self._lock:
+            counts = self._counts.setdefault(k, [0] * len(self.buckets))
+            i = bisect.bisect_left(self.buckets, value)
+            if i < len(counts):
+                counts[i] += 1
+            self._sums[k] = self._sums.get(k, 0.0) + value
+            self._totals[k] = self._totals.get(k, 0) + 1
+
+    def collect(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
+        with self._lock:
+            for k in sorted(self._counts):
+                cum = 0
+                for b, c in zip(self.buckets, self._counts[k]):
+                    cum += c
+                    out.append(f"{self.name}_bucket{_fmt_labels(k, f'le=\"{b}\"')} {cum}")
+                out.append(f"{self.name}_bucket{_fmt_labels(k, 'le=\"+Inf\"')} {self._totals[k]}")
+                out.append(f"{self.name}_sum{_fmt_labels(k)} {self._sums[k]}")
+                out.append(f"{self.name}_count{_fmt_labels(k)} {self._totals[k]}")
+        return out
+
+
+class MetricsRegistry:
+    def __init__(self) -> None:
+        self._instruments: Dict[str, object] = {}
+        self._lock = threading.Lock()
+        # the reference's instrument set (pkg/metrics)
+        self.policy_results = self.counter(
+            "kyverno_policy_results_total", "policy rule results by status")
+        self.policy_duration = self.histogram(
+            "kyverno_policy_execution_duration_seconds", "per-policy evaluation latency")
+        self.admission_requests = self.counter(
+            "kyverno_admission_requests_total", "admission requests handled")
+        self.admission_duration = self.histogram(
+            "kyverno_admission_review_duration_seconds", "admission review latency")
+        self.policy_changes = self.counter(
+            "kyverno_policy_changes_total", "policy create/update/delete events")
+        # TPU engine instruments
+        self.batch_size = self.histogram(
+            "kyverno_tpu_batch_size", "resources per device dispatch",
+            buckets=(1, 8, 32, 128, 512, 2048, 8192, 32768))
+        self.device_dispatch = self.histogram(
+            "kyverno_tpu_device_dispatch_seconds", "device program wall time")
+        self.compile_cache = self.counter(
+            "kyverno_tpu_compile_cache_total", "policy-set compiles by outcome")
+
+    def counter(self, name: str, help_: str) -> Counter:
+        with self._lock:
+            inst = self._instruments.get(name)
+            if inst is None:
+                inst = Counter(name, help_)
+                self._instruments[name] = inst
+            return inst  # type: ignore[return-value]
+
+    def gauge(self, name: str, help_: str) -> Gauge:
+        with self._lock:
+            inst = self._instruments.get(name)
+            if inst is None:
+                inst = Gauge(name, help_)
+                self._instruments[name] = inst
+            return inst  # type: ignore[return-value]
+
+    def histogram(self, name: str, help_: str, buckets: Sequence[float] = _DEFAULT_BUCKETS) -> Histogram:
+        with self._lock:
+            inst = self._instruments.get(name)
+            if inst is None:
+                inst = Histogram(name, help_, buckets)
+                self._instruments[name] = inst
+            return inst  # type: ignore[return-value]
+
+    def exposition(self) -> str:
+        lines: List[str] = []
+        with self._lock:
+            insts = list(self._instruments.values())
+        for inst in insts:
+            lines.extend(inst.collect())  # type: ignore[attr-defined]
+        return "\n".join(lines) + "\n"
+
+
+global_registry = MetricsRegistry()

@@ -1,0 +1,70 @@
+"""Tracing — span instrumentation around encode/compile/dispatch.
+
+The reference wraps every rule and policy evaluation in OTel spans
+(pkg/tracing, engine.go:243). The batch engine's natural span points
+are coarser: snapshot encode, policy-set compile, device dispatch,
+host completion. Spans collect into an in-memory exporter by default;
+an OTLP exporter can be plugged when the collector dependency exists.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+
+@dataclass
+class Span:
+    name: str
+    start: float
+    end: float = 0.0
+    attributes: Dict[str, Any] = field(default_factory=dict)
+    parent: Optional[str] = None
+    status: str = "ok"
+
+    @property
+    def duration(self) -> float:
+        return (self.end or time.perf_counter()) - self.start
+
+
+class Tracer:
+    def __init__(self, exporter=None, max_spans: int = 4096) -> None:
+        self._exporter = exporter
+        self._spans: List[Span] = []
+        self._lock = threading.Lock()
+        self._max = max_spans
+        self._local = threading.local()
+
+    @contextmanager
+    def span(self, name: str, **attributes):
+        parent = getattr(self._local, "current", None)
+        s = Span(name=name, start=time.perf_counter(),
+                 attributes=dict(attributes), parent=parent)
+        self._local.current = name
+        try:
+            yield s
+        except Exception:
+            s.status = "error"
+            raise
+        finally:
+            s.end = time.perf_counter()
+            self._local.current = parent
+            with self._lock:
+                self._spans.append(s)
+                if len(self._spans) > self._max:
+                    self._spans = self._spans[-self._max:]
+            if self._exporter is not None:
+                try:
+                    self._exporter(s)
+                except Exception:
+                    pass
+
+    def finished(self, name: Optional[str] = None) -> List[Span]:
+        with self._lock:
+            return [s for s in self._spans if name is None or s.name == name]
+
+
+global_tracer = Tracer()

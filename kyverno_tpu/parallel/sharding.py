@@ -25,10 +25,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..api.policy import ClusterPolicy
 from ..tpu.compiler import CompiledPolicySet, compile_policy_set
-from ..tpu.evaluator import build_program
+from ..tpu.evaluator import batch_to_device, build_program
 from ..tpu.flatten import EncodeConfig, encode_resources
 from ..tpu.metadata import encode_metadata
-from ..tpu.evaluator import batch_to_device
 
 
 def make_mesh(devices: Optional[Sequence] = None, axis: str = "data") -> Mesh:
@@ -71,8 +70,7 @@ class ShardedScanner:
 
         self._step = jax.jit(
             step,
-            in_shardings=({k: NamedSharding(self.mesh, P(self.axis))
-                           for k in self._batch_keys()},),
+            in_shardings=({k: data_sharding for k in self._batch_keys()},),
             out_shardings=(NamedSharding(self.mesh, P(None, self.axis)), repl),
         )
 

@@ -100,19 +100,18 @@ class TpuEngine:
 
     def __init__(
         self,
-        policies: Sequence[ClusterPolicy],
+        policies: Sequence[ClusterPolicy] = (),
         encode_cfg: Optional[EncodeConfig] = None,
         meta_cfg: Optional[MetaConfig] = None,
+        cps: Optional[CompiledPolicySet] = None,
     ):
-        self.cps: CompiledPolicySet = compile_policy_set(policies, encode_cfg, meta_cfg)
+        self.cps: CompiledPolicySet = cps if cps is not None \
+            else compile_policy_set(policies, encode_cfg, meta_cfg)
         self.scalar = ScalarEngine()
 
     @classmethod
     def from_compiled(cls, cps: CompiledPolicySet) -> "TpuEngine":
-        self = cls.__new__(cls)
-        self.cps = cps
-        self.scalar = ScalarEngine()
-        return self
+        return cls(cps=cps)
 
     # -- encoding
 

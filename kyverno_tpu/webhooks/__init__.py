@@ -1,0 +1,5 @@
+"""Admission server: AdmissionReview handling + micro-batched TPU
+validation (pkg/webhooks equivalent)."""
+
+from .batcher import MicroBatcher
+from .server import AdmissionServer, build_handlers

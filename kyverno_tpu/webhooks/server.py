@@ -1,0 +1,267 @@
+"""Admission HTTPS server (pkg/webhooks/server.go equivalent).
+
+Routes: /validate, /mutate, /health/liveness, /health/readiness.
+AdmissionReview v1 decode/encode mirrors handlers/admission.go; the
+validate path micro-batches concurrent requests into one device
+dispatch (see batcher.py); mutate runs the host strategic-merge engine
+and returns an RFC 6902 patch. failurePolicy is honored per request
+path suffix (/validate/ignore vs /validate/fail, server.go:296).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import ssl
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..api.policy import ClusterPolicy
+from ..cluster.policycache import PolicyCache, PolicyType
+from ..cluster.reports import ReportAggregator, ReportResult
+from ..cluster.snapshot import ClusterSnapshot, resource_uid
+from ..engine.engine import Engine as ScalarEngine
+from ..engine.match import RequestInfo
+from ..tpu.engine import TpuEngine, VERDICT_NAMES, build_scan_context
+from ..tpu.evaluator import ERROR, FAIL, NOT_MATCHED
+from ..utils.jsonpatch import diff as jsonpatch_diff
+from .batcher import MicroBatcher
+
+
+class AdmissionPayload:
+    __slots__ = ("resource", "operation", "info", "namespace", "old")
+
+    def __init__(self, resource, operation, info, namespace, old=None):
+        self.resource = resource
+        self.operation = operation
+        self.info = info
+        self.namespace = namespace
+        self.old = old
+
+
+class Handlers:
+    """Validate/mutate admission logic shared by server and tests."""
+
+    def __init__(
+        self,
+        cache: PolicyCache,
+        snapshot: Optional[ClusterSnapshot] = None,
+        aggregator: Optional[ReportAggregator] = None,
+        max_batch: int = 256,
+        max_wait_ms: float = 2.0,
+    ) -> None:
+        self.cache = cache
+        self.snapshot = snapshot
+        self.aggregator = aggregator
+        self.scalar = ScalarEngine()
+        self._engines: Dict[int, TpuEngine] = {}
+        self._lock = threading.Lock()
+        self.batcher = MicroBatcher(self._evaluate_batch, max_batch, max_wait_ms)
+
+    # -- engine cache keyed by policy revision (compile-cache churn control)
+
+    def _engine(self) -> Tuple[int, TpuEngine]:
+        rev, policies = self.cache.snapshot()
+        with self._lock:
+            eng = self._engines.get(rev)
+            if eng is None:
+                eng = TpuEngine(policies)
+                self._engines.clear()  # single live revision
+                self._engines[rev] = eng
+        return rev, eng
+
+    def _evaluate_batch(self, payloads: List[AdmissionPayload]):
+        _, eng = self._engine()
+        resources = [
+            p.old if (p.operation == "DELETE" and p.old) else p.resource
+            for p in payloads
+        ]
+        ns_labels = self.snapshot.namespace_labels() if self.snapshot else {}
+        result = eng.scan(
+            resources,
+            ns_labels,
+            operations=[p.operation for p in payloads],
+            admission_infos=[p.info for p in payloads],
+        )
+        return [
+            [(result.rules[row], int(result.verdicts[row, ci]))
+             for row in range(len(result.rules))]
+            for ci in range(len(payloads))
+        ]
+
+    # -- public handlers
+
+    def validate(self, review: Dict[str, Any], failure_policy: str = "fail") -> Dict[str, Any]:
+        req = review.get("request") or {}
+        payload = _payload_from_request(req)
+        try:
+            verdicts = self.batcher.submit(payload)
+        except Exception as e:
+            allowed = failure_policy == "ignore"
+            return _response(req, allowed, f"evaluation error: {e}")
+        _, eng = self._engine()
+        enforce = {
+            p.name for p in eng.cps.policies
+            if (p.spec.validation_failure_action or "Audit").lower().startswith("enforce")
+        }
+        # DELETE requests carry the object in oldObject (object is null)
+        evaluated = payload.old if (payload.operation == "DELETE" and payload.old) \
+            else payload.resource
+        block_msgs: List[str] = []
+        audit_results: List[ReportResult] = []
+        for (pname, rname), code in verdicts:
+            if code in (NOT_MATCHED,):
+                continue
+            if code in (FAIL, ERROR) and pname in enforce:
+                block_msgs.append(f"{pname}/{rname}: {VERDICT_NAMES.get(code, 'fail')}")
+            if self.aggregator is not None:
+                meta = evaluated.get("metadata") or {}
+                audit_results.append(ReportResult(
+                    policy=pname, rule=rname,
+                    result=VERDICT_NAMES.get(code, "error"),
+                    resource_kind=evaluated.get("kind", ""),
+                    resource_name=meta.get("name", ""),
+                    resource_namespace=meta.get("namespace", ""),
+                ))
+        if self.aggregator is not None and audit_results:
+            if payload.operation == "DELETE":
+                self.aggregator.drop(resource_uid(evaluated))
+            else:
+                self.aggregator.put(resource_uid(evaluated), audit_results)
+        if block_msgs:
+            return _response(req, False, "; ".join(block_msgs))
+        return _response(req, True, "")
+
+    def mutate(self, review: Dict[str, Any], failure_policy: str = "fail") -> Dict[str, Any]:
+        req = review.get("request") or {}
+        payload = _payload_from_request(req)
+        resource = payload.resource
+        patched = resource
+        ns_labels = self.snapshot.namespace_labels() if self.snapshot else {}
+        try:
+            for policy in self.cache.get_policies(
+                PolicyType.MUTATE, kind=resource.get("kind"), namespace=payload.namespace
+            ):
+                pctx = build_scan_context(
+                    policy, patched, ns_labels.get(payload.namespace, {}),
+                    payload.operation, payload.info,
+                )
+                response = self.scalar.mutate(pctx)
+                if response.patched_resource is not None:
+                    patched = response.patched_resource
+        except Exception as e:
+            allowed = failure_policy == "ignore"
+            return _response(req, allowed, f"mutation error: {e}")
+        out = _response(req, True, "")
+        ops = jsonpatch_diff(resource, patched)
+        if ops:
+            out["response"]["patchType"] = "JSONPatch"
+            out["response"]["patch"] = base64.b64encode(
+                json.dumps(ops).encode()).decode()
+        return out
+
+
+def _payload_from_request(req: Dict[str, Any]) -> AdmissionPayload:
+    user = req.get("userInfo") or {}
+    info = RequestInfo(
+        username=user.get("username", ""),
+        uid=user.get("uid", ""),
+        groups=list(user.get("groups") or []),
+    )
+    return AdmissionPayload(
+        resource=req.get("object") or {},
+        operation=req.get("operation", "CREATE"),
+        info=info,
+        namespace=req.get("namespace", ""),
+        old=req.get("oldObject"),
+    )
+
+
+def _response(req: Dict[str, Any], allowed: bool, message: str) -> Dict[str, Any]:
+    out: Dict[str, Any] = {
+        "apiVersion": "admission.k8s.io/v1",
+        "kind": "AdmissionReview",
+        "response": {"uid": req.get("uid", ""), "allowed": allowed},
+    }
+    if message:
+        out["response"]["status"] = {"message": message}
+    return out
+
+
+def build_handlers(cache: PolicyCache, snapshot=None, aggregator=None, **kw) -> Handlers:
+    return Handlers(cache, snapshot, aggregator, **kw)
+
+
+class AdmissionServer:
+    """ThreadingHTTPServer wrapper with optional TLS."""
+
+    def __init__(
+        self,
+        handlers: Handlers,
+        host: str = "127.0.0.1",
+        port: int = 9443,
+        certfile: Optional[str] = None,
+        keyfile: Optional[str] = None,
+    ) -> None:
+        self.handlers = handlers
+        outer = self
+
+        class _Req(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def do_GET(self):
+                if self.path in ("/health/liveness", "/health/readiness"):
+                    self.send_response(200)
+                    self.end_headers()
+                    self.wfile.write(b"ok")
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length)
+                try:
+                    review = json.loads(body)
+                except ValueError:
+                    self.send_response(400)
+                    self.end_headers()
+                    return
+                path = self.path.rstrip("/")
+                failure_policy = "ignore" if path.endswith("/ignore") else "fail"
+                base = path.split("/")[1] if len(path) > 1 else ""
+                if base == "validate":
+                    out = outer.handlers.validate(review, failure_policy)
+                elif base == "mutate":
+                    out = outer.handlers.mutate(review, failure_policy)
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                data = json.dumps(out).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        self._httpd = ThreadingHTTPServer((host, port), _Req)
+        if certfile:
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(certfile, keyfile)
+            self._httpd.socket = ctx.wrap_socket(self._httpd.socket, server_side=True)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self.handlers.batcher.stop()

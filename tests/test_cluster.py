@@ -1,0 +1,119 @@
+"""Policy cache, snapshot, incremental scan service, report pipeline."""
+
+from kyverno_tpu.api.policy import ClusterPolicy
+from kyverno_tpu.cluster import (
+    BackgroundScanService,
+    ClusterSnapshot,
+    PolicyCache,
+    PolicyType,
+    ReportAggregator,
+)
+from kyverno_tpu.parallel import make_mesh
+
+
+def make_policy(name, action="Audit"):
+    return ClusterPolicy.from_dict({
+        "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+        "metadata": {"name": name},
+        "spec": {
+            "validationFailureAction": action,
+            "rules": [{
+                "name": "no-privileged",
+                "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+                "validate": {
+                    "message": "privileged forbidden",
+                    "pattern": {"spec": {"containers": [
+                        {"=(securityContext)": {"=(privileged)": "false"}}]}},
+                },
+            }],
+        },
+    })
+
+
+def pod(name, priv, ns="default"):
+    sc = {"securityContext": {"privileged": priv}} if priv is not None else {}
+    return {"apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": name, "namespace": ns},
+            "spec": {"containers": [{"name": "c", "image": "nginx", **sc}]}}
+
+
+def test_policy_cache_typed_index():
+    cache = PolicyCache()
+    cache.set(make_policy("audit-pol", "Audit"))
+    cache.set(make_policy("enforce-pol", "Enforce"))
+    audit = cache.get_policies(PolicyType.VALIDATE_AUDIT, kind="Pod")
+    enforce = cache.get_policies(PolicyType.VALIDATE_ENFORCE, kind="Pod")
+    assert [p.name for p in audit] == ["audit-pol"]
+    assert [p.name for p in enforce] == ["enforce-pol"]
+    # autogen expanded kinds are indexed too
+    assert cache.get_policies(PolicyType.VALIDATE_AUDIT, kind="Deployment")
+    assert not cache.get_policies(PolicyType.VALIDATE_AUDIT, kind="Service")
+    rev = cache.revision
+    cache.unset("audit-pol")
+    assert cache.revision == rev + 1
+    assert not cache.get_policies(PolicyType.VALIDATE_AUDIT, kind="Pod")
+
+
+def test_incremental_scan_and_reports():
+    snap = ClusterSnapshot()
+    cache = PolicyCache()
+    cache.set(make_policy("p1"))
+    svc = BackgroundScanService(snap, cache, mesh=make_mesh())
+
+    snap.upsert(pod("a", True))
+    snap.upsert(pod("b", None, ns="prod"))
+    assert svc.scan_once() == 2
+    summary = svc.aggregator.summary()
+    assert summary["fail"] == 1 and summary["pass"] == 1
+
+    # clean rescan: nothing to do
+    assert svc.scan_once() == 0
+
+    # touching one resource rescans only it
+    snap.upsert(pod("a", False))
+    assert svc.scan_once() == 1
+    assert svc.aggregator.summary()["fail"] == 0
+
+    # policy change invalidates everything
+    cache.set(make_policy("p2"))
+    assert svc.scan_once() == 2
+
+    # deletion drops its report
+    snap.delete(pod("a", False))
+    reports = svc.aggregator.aggregate()
+    assert "default" not in reports
+    assert reports["prod"].summary()["pass"] == 2  # both policies pass
+
+    report_doc = reports["prod"].to_dict()
+    assert report_doc["kind"] == "PolicyReport"
+    assert report_doc["summary"]["pass"] == 2
+
+
+def test_namespace_label_change_invalidates_members():
+    snap = ClusterSnapshot()
+    cache = PolicyCache()
+    pol = ClusterPolicy.from_dict({
+        "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+        "metadata": {"name": "ns-gated"},
+        "spec": {"rules": [{
+            "name": "gate",
+            "match": {"any": [{"resources": {
+                "kinds": ["Pod"],
+                "namespaceSelector": {"matchLabels": {"env": "prod"}}}}]},
+            "validate": {"message": "no privileged",
+                         "pattern": {"spec": {"containers": [
+                             {"=(securityContext)": {"=(privileged)": "false"}}]}}},
+        }]},
+    })
+    cache.set(pol)
+    svc = BackgroundScanService(snap, cache, mesh=make_mesh())
+    snap.upsert({"apiVersion": "v1", "kind": "Namespace",
+                 "metadata": {"name": "default", "labels": {"env": "dev"}}})
+    snap.upsert(pod("a", True))
+    svc.scan_once()
+    assert svc.aggregator.summary()["fail"] == 0  # selector does not match
+    # relabel the namespace: member pods must rescan and now fail
+    snap.upsert({"apiVersion": "v1", "kind": "Namespace",
+                 "metadata": {"name": "default", "labels": {"env": "prod"}}})
+    assert svc.scan_once() >= 1
+    assert svc.aggregator.summary()["fail"] == 1

@@ -1,0 +1,72 @@
+"""Events, metrics exposition, tracing spans, dynamic config."""
+
+import time
+
+from kyverno_tpu.config import Configuration, Toggles, parse_resource_filters
+from kyverno_tpu.observability import Event, EventGenerator, MetricsRegistry
+from kyverno_tpu.observability.tracing import Tracer
+
+
+def test_event_generator_drains_and_omits():
+    seen = []
+    gen = EventGenerator(sink=seen.append, omit_reasons=["PolicySkipped"])
+    gen.add(Event(reason="PolicyViolation", message="m1"),
+            Event(reason="PolicySkipped", message="m2"),
+            Event(reason="PolicyApplied", message="m3"))
+    gen.flush()
+    time.sleep(0.05)
+    assert sorted(e.message for e in seen) == ["m1", "m3"]
+    assert gen.dropped == 0
+
+
+def test_metrics_exposition():
+    reg = MetricsRegistry()
+    reg.policy_results.inc({"policy": "p", "status": "fail"})
+    reg.policy_results.inc({"policy": "p", "status": "fail"})
+    reg.admission_duration.observe(0.003)
+    text = reg.exposition()
+    assert 'kyverno_policy_results_total{policy="p",status="fail"} 2.0' in text
+    assert "kyverno_admission_review_duration_seconds_bucket" in text
+    assert "kyverno_admission_review_duration_seconds_count 1" in text
+
+
+def test_tracer_spans_nest():
+    tr = Tracer()
+    with tr.span("scan", resources=10):
+        with tr.span("encode"):
+            pass
+        with tr.span("dispatch"):
+            pass
+    spans = tr.finished()
+    names = [s.name for s in spans]
+    assert names == ["encode", "dispatch", "scan"]
+    assert spans[0].parent == "scan"
+    assert spans[2].parent is None
+
+
+def test_resource_filters_and_exclusions():
+    cfg = Configuration()
+    changes = []
+    cfg.on_changed(lambda: changes.append(1))
+    cfg.load({
+        "resourceFilters": "[Event,*,*][*,kube-system,*][Pod,test-*,secret*]",
+        "excludeUsernames": "system:kube-scheduler, admin-*",
+        "excludeGroups": "system:nodes",
+    })
+    assert changes == [1]
+    assert cfg.to_filter("Event", "default", "x")
+    assert cfg.to_filter("Pod", "kube-system", "anything")
+    assert cfg.to_filter("Pod", "test-ns", "secret1")
+    assert not cfg.to_filter("Pod", "default", "web")
+    assert cfg.is_excluded("admin-root", [], [])
+    assert cfg.is_excluded("u", ["system:nodes"], [])
+    assert not cfg.is_excluded("alice", ["dev"], [])
+
+
+def test_toggles_env_and_overrides(monkeypatch):
+    t = Toggles()
+    assert t.engine == "tpu"
+    assert t.enable_deferred_loading is True
+    monkeypatch.setenv("KYVERNO_TPU_ENGINE", "scalar")
+    assert Toggles().engine == "scalar"
+    assert Toggles(engine="tpu").engine == "tpu"

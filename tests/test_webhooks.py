@@ -1,0 +1,126 @@
+"""Admission server: HTTP AdmissionReview round-trips with
+micro-batched validation and mutate patches."""
+
+import base64
+import concurrent.futures
+import http.client
+import json
+
+import pytest
+
+from kyverno_tpu.api.policy import ClusterPolicy
+from kyverno_tpu.cluster import ClusterSnapshot, PolicyCache, ReportAggregator
+from kyverno_tpu.utils.jsonpatch import diff as jsonpatch_diff
+from kyverno_tpu.webhooks import AdmissionServer, build_handlers
+
+VALIDATE_POLICY = {
+    "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+    "metadata": {"name": "no-privileged"},
+    "spec": {
+        "validationFailureAction": "Enforce",
+        "rules": [{
+            "name": "privileged",
+            "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+            "validate": {
+                "message": "privileged is forbidden",
+                "pattern": {"spec": {"containers": [
+                    {"=(securityContext)": {"=(privileged)": "false"}}]}},
+            },
+        }],
+    },
+}
+
+MUTATE_POLICY = {
+    "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+    "metadata": {"name": "add-label"},
+    "spec": {
+        "rules": [{
+            "name": "add-team-label",
+            "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+            "mutate": {"patchStrategicMerge": {
+                "metadata": {"labels": {"+(team)": "core"}}}},
+        }],
+    },
+}
+
+
+def review(resource, uid="u1", operation="CREATE"):
+    return {
+        "apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview",
+        "request": {
+            "uid": uid, "operation": operation,
+            "namespace": (resource.get("metadata") or {}).get("namespace", ""),
+            "object": resource,
+            "userInfo": {"username": "alice", "groups": ["dev"]},
+        },
+    }
+
+
+def pod(name, priv):
+    sc = {"securityContext": {"privileged": priv}} if priv is not None else {}
+    return {"apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": name, "namespace": "default"},
+            "spec": {"containers": [{"name": "c", "image": "nginx", **sc}]}}
+
+
+@pytest.fixture(scope="module")
+def server():
+    cache = PolicyCache()
+    cache.set(ClusterPolicy.from_dict(VALIDATE_POLICY))
+    cache.set(ClusterPolicy.from_dict(MUTATE_POLICY))
+    handlers = build_handlers(cache, ClusterSnapshot(), ReportAggregator(),
+                              max_wait_ms=5.0)
+    srv = AdmissionServer(handlers, port=0)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def _post(srv, path, body):
+    conn = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=60)
+    conn.request("POST", path, json.dumps(body), {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    data = json.loads(resp.read())
+    conn.close()
+    return data
+
+
+def test_validate_blocks_enforce_failure(server):
+    out = _post(server, "/validate", review(pod("bad", True)))
+    assert out["response"]["allowed"] is False
+    assert "no-privileged" in out["response"]["status"]["message"]
+    out = _post(server, "/validate", review(pod("ok", False)))
+    assert out["response"]["allowed"] is True
+
+
+def test_validate_microbatch_concurrent(server):
+    reviews = [review(pod(f"p{i}", i % 2 == 0), uid=f"u{i}") for i in range(16)]
+    with concurrent.futures.ThreadPoolExecutor(max_workers=16) as ex:
+        outs = list(ex.map(lambda r: _post(server, "/validate", r), reviews))
+    for i, out in enumerate(outs):
+        assert out["response"]["uid"] == f"u{i}"
+        assert out["response"]["allowed"] is (i % 2 != 0)
+
+
+def test_mutate_returns_json_patch(server):
+    out = _post(server, "/mutate", review(pod("m", None)))
+    assert out["response"]["allowed"] is True
+    patch = json.loads(base64.b64decode(out["response"]["patch"]))
+    assert {"op": "add", "path": "/metadata/labels",
+            "value": {"team": "core"}} in patch
+
+
+def test_health_endpoints(server):
+    conn = http.client.HTTPConnection("127.0.0.1", server.port, timeout=10)
+    conn.request("GET", "/health/liveness")
+    assert conn.getresponse().status == 200
+    conn.close()
+
+
+def test_jsonpatch_diff_roundtrip():
+    orig = {"a": {"b": 1, "c": [1, 2, 3]}, "d": "x"}
+    new = {"a": {"b": 2, "c": [1, 5]}, "e": True}
+    ops = jsonpatch_diff(orig, new)
+    from kyverno_tpu.engine.mutate import apply_json6902
+
+    assert apply_json6902(orig, ops) == new
