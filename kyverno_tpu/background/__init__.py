@@ -1,0 +1,6 @@
+"""Background processing: UpdateRequests, generate and mutate-existing
+executors (pkg/background equivalent)."""
+
+from .generate import GenerateController
+from .mutate_existing import MutateExistingController
+from .updaterequest import UpdateRequest, UpdateRequestQueue, UR_COMPLETED, UR_FAILED, UR_PENDING

@@ -7,6 +7,7 @@ import sys
 
 from . import apply as apply_cmd
 from . import jp as jp_cmd
+from . import serve as serve_cmd
 from . import test as test_cmd
 
 VERSION = "0.1.0"
@@ -21,6 +22,7 @@ def main(argv=None) -> int:
     apply_cmd.add_parser(sub)
     jp_cmd.add_parser(sub)
     test_cmd.add_parser(sub)
+    serve_cmd.add_parser(sub)
     v = sub.add_parser("version", help="print version")
     v.set_defaults(func=lambda a: (print(f"kyverno-tpu {VERSION}"), 0)[1])
     args = parser.parse_args(argv)

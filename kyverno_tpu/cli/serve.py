@@ -1,0 +1,158 @@
+"""`serve` — run the admission + scan control plane in one process.
+
+The deployment-unit equivalent (cmd/kyverno + cmd/reports-controller):
+loads policies, starts the admission HTTPS server (micro-batched TPU
+validation), the background scan loop over an in-memory snapshot fed
+by /snapshot/upsert, a Prometheus /metrics endpoint, and health probes.
+Offline-first: no kube-apiserver needed; the snapshot API stands in for
+informers, which keeps the whole data plane drivable in CI.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import sys
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict
+
+import yaml
+
+from ..api.policy import ClusterPolicy, is_policy_document
+from ..cluster import BackgroundScanService, ClusterSnapshot, PolicyCache, ReportAggregator
+from ..config import Configuration, Toggles
+from ..observability.metrics import global_registry
+from ..webhooks import AdmissionServer, build_handlers
+
+
+def add_parser(sub: argparse._SubParsersAction) -> None:
+    p = sub.add_parser("serve", help="run admission server + background scanner")
+    p.add_argument("policies", nargs="+", help="policy files or directories")
+    p.add_argument("--port", type=int, default=9443, help="admission port")
+    p.add_argument("--metrics-port", type=int, default=8000)
+    p.add_argument("--scan-interval", type=float, default=30.0)
+    p.add_argument("--cert", default=None, help="TLS certificate file")
+    p.add_argument("--key", default=None, help="TLS key file")
+    p.add_argument("--engine", choices=["tpu", "scalar"], default=None,
+                   help="override the KYVERNO_TPU_ENGINE toggle")
+    p.add_argument("--config", default=None,
+                   help="kyverno ConfigMap-style YAML (resourceFilters etc.)")
+    p.set_defaults(func=run)
+
+
+class ControlPlane:
+    """Everything `serve` wires together; used directly by tests."""
+
+    def __init__(self, policies, port=0, metrics_port=0, cert=None, key=None,
+                 configuration=None, toggles=None):
+        self.cache = PolicyCache()
+        for p in policies:
+            self.cache.set(p)
+        self.snapshot = ClusterSnapshot()
+        self.aggregator = ReportAggregator()
+        self.configuration = configuration or Configuration()
+        self.toggles = toggles or Toggles()
+        self.scan_service = BackgroundScanService(
+            self.snapshot, self.cache, self.aggregator)
+        self.handlers = build_handlers(
+            self.cache, self.snapshot, self.aggregator,
+            configuration=self.configuration, toggles=self.toggles)
+        self.admission = AdmissionServer(
+            self.handlers, port=port, certfile=cert, keyfile=key)
+        self.metrics_server = _metrics_server(self, metrics_port)
+        self._stop = threading.Event()
+        self._scan_thread: threading.Thread | None = None
+
+    def start(self, scan_interval: float = 30.0) -> None:
+        self.admission.start()
+        threading.Thread(
+            target=self.metrics_server.serve_forever, daemon=True).start()
+        self._scan_thread = threading.Thread(
+            target=self.scan_service.run, args=(scan_interval, self._stop), daemon=True)
+        self._scan_thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.admission.stop()
+        self.metrics_server.shutdown()
+
+
+def _metrics_server(cp: "ControlPlane", port: int) -> ThreadingHTTPServer:
+    class _Req(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def _send(self, code: int, body: bytes, ctype: str = "text/plain"):
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/metrics":
+                self._send(200, global_registry.exposition().encode())
+            elif self.path == "/reports":
+                reports = {ns or "_cluster": r.to_dict()
+                           for ns, r in cp.aggregator.aggregate().items()}
+                self._send(200, json.dumps(reports).encode(), "application/json")
+            else:
+                self._send(404, b"")
+
+        def do_POST(self):
+            length = int(self.headers.get("Content-Length", 0))
+            try:
+                doc = json.loads(self.rfile.read(length))
+            except ValueError:
+                self._send(400, b"bad json")
+                return
+            if self.path == "/snapshot/upsert":
+                uid = cp.snapshot.upsert(doc)
+                self._send(200, json.dumps({"uid": uid}).encode(), "application/json")
+            elif self.path == "/snapshot/delete":
+                cp.snapshot.delete(doc)
+                self._send(200, b"{}")
+            elif self.path == "/scan":
+                n = cp.scan_service.scan_once(full=bool(doc.get("full")))
+                self._send(200, json.dumps(
+                    {"scanned": n, "summary": cp.aggregator.summary()}).encode(),
+                    "application/json")
+            else:
+                self._send(404, b"")
+
+    return ThreadingHTTPServer(("127.0.0.1", port), _Req)
+
+
+def _load_policies(paths) -> list:
+    from .apply import _load_docs
+
+    return [ClusterPolicy.from_dict(d) for d in _load_docs(paths)
+            if is_policy_document(d)]
+
+
+def run(args: argparse.Namespace) -> int:
+    policies = _load_policies(args.policies)
+    if not policies:
+        print("no policies found", file=sys.stderr)
+        return 2
+    configuration = Configuration()
+    if args.config:
+        with open(args.config) as f:
+            doc = yaml.safe_load(f) or {}
+        configuration.load(doc.get("data") or doc)
+    toggles = Toggles(engine=args.engine) if args.engine else Toggles()
+    cp = ControlPlane(policies, port=args.port, metrics_port=args.metrics_port,
+                      cert=args.cert, key=args.key,
+                      configuration=configuration, toggles=toggles)
+    cp.start(args.scan_interval)
+    print(f"admission on :{cp.admission.port}, metrics on "
+          f":{cp.metrics_server.server_address[1]}, "
+          f"{len(policies)} policies loaded", file=sys.stderr)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    stop.wait()
+    cp.stop()
+    return 0

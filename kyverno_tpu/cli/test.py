@@ -72,9 +72,8 @@ class TestCase:
         for ns in values.get("namespaces") or []:
             meta = ns.get("metadata") or {}
             self.ns_labels[meta.get("name", "")] = dict(meta.get("labels") or {})
-        self.variables: Dict[str, Any] = {}
-        for gv in values.get("globalValues") or []:
-            self.variables.update(gv if isinstance(gv, dict) else {})
+        # GlobalValues is a map in the reference schema (values.go)
+        self.variables: Dict[str, Any] = dict(values.get("globalValues") or {})
         self.results: List[Dict[str, Any]] = list(self.spec.get("results") or [])
 
     def name(self) -> str:

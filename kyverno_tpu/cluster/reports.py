@@ -8,6 +8,7 @@ skip summaries (pkg/controllers/report/aggregate).
 
 from __future__ import annotations
 
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
@@ -76,11 +77,13 @@ class PolicyReport:
 
 class ReportAggregator:
     """Ephemeral per-resource results -> merged per-namespace reports
-    (aggregate/controller.go:307 reconcile, chunking elided)."""
+    (aggregate/controller.go:307 reconcile, chunking elided). Shared by
+    admission threads, the scan loop, and report readers -> locked."""
 
     def __init__(self) -> None:
         # uid -> results (the EphemeralReport equivalent)
         self._per_resource: Dict[str, List[ReportResult]] = {}
+        self._lock = threading.Lock()
 
     def put(self, uid: str, results: List[ReportResult]) -> None:
         now = time.time()
@@ -88,14 +91,20 @@ class ReportAggregator:
             r.resource_uid = uid
             if not r.timestamp:
                 r.timestamp = now
-        self._per_resource[uid] = list(results)
+        with self._lock:
+            self._per_resource[uid] = list(results)
 
     def drop(self, uid: str) -> None:
-        self._per_resource.pop(uid, None)
+        with self._lock:
+            self._per_resource.pop(uid, None)
+
+    def _snapshot(self) -> List[List[ReportResult]]:
+        with self._lock:
+            return list(self._per_resource.values())
 
     def aggregate(self) -> Dict[str, PolicyReport]:
         reports: Dict[str, PolicyReport] = {}
-        for results in self._per_resource.values():
+        for results in self._snapshot():
             for r in results:
                 ns = r.resource_namespace
                 reports.setdefault(ns, PolicyReport(ns)).results.append(r)
@@ -103,7 +112,7 @@ class ReportAggregator:
 
     def summary(self) -> Dict[str, int]:
         out = {k: 0 for k in RESULT_NAMES}
-        for results in self._per_resource.values():
+        for results in self._snapshot():
             for r in results:
                 if r.result in out:
                     out[r.result] += 1

@@ -16,9 +16,11 @@ needsReconcile / :299 reconcileReport) re-expressed batch-first:
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Any, Dict, List, Optional, Set, Tuple
 
+from ..observability.metrics import MetricsRegistry, global_registry
 from ..tpu.evaluator import ERROR, FAIL, NOT_MATCHED, PASS, SKIP
 from .policycache import PolicyCache
 from .reports import ReportAggregator, ReportResult
@@ -41,9 +43,11 @@ class BackgroundScanService:
         self.aggregator = aggregator or ReportAggregator()
         self.mesh = mesh
         self.batch_size = batch_size
+        self.metrics = global_registry
         # uid -> (resource hash, policy revision) at last scan
         self._scanned: Dict[str, Tuple[str, int]] = {}
         self._dirty: Set[str] = set()
+        self._lock = threading.Lock()
         self._scanner = None
         self._scanner_rev = -1
         self.stats = {"scans": 0, "resources_scanned": 0, "skipped_clean": 0}
@@ -53,20 +57,32 @@ class BackgroundScanService:
 
     def _on_change(self, uid: str, change: str) -> None:
         if change == "delete":
-            self._scanned.pop(uid, None)
-            self._dirty.discard(uid)
+            with self._lock:
+                self._scanned.pop(uid, None)
+                self._dirty.discard(uid)
             self.aggregator.drop(uid)
+            # a deleted Namespace invalidates members too (the uid no
+            # longer resolves, so derive the name from the uid key)
+            if '/Namespace:' in uid:
+                ns_name = uid.rsplit("/", 1)[-1]
+                self._invalidate_namespace(ns_name)
             return
-        self._dirty.add(uid)
+        with self._lock:
+            self._dirty.add(uid)
         # namespace label changes invalidate every resource in that
         # namespace (namespaceSelector results can flip without the
         # member resources changing)
         res = self.snapshot.get(uid)
         if res is not None and res.get("kind") == "Namespace":
-            ns_name = (res.get("metadata") or {}).get("name", "")
-            for member_uid, member, _ in self.snapshot.items():
-                if (member.get("metadata") or {}).get("namespace", "") == ns_name:
-                    self._dirty.add(member_uid)
+            self._invalidate_namespace((res.get("metadata") or {}).get("name", ""))
+
+    def _invalidate_namespace(self, ns_name: str) -> None:
+        if not ns_name:
+            return
+        members = [member_uid for member_uid, member, _ in self.snapshot.items()
+                   if (member.get("metadata") or {}).get("namespace", "") == ns_name]
+        with self._lock:
+            self._dirty.update(members)
 
     def _needs_scan(self, uid: str, h: str, revision: int) -> bool:
         last = self._scanned.get(uid)
@@ -88,14 +104,18 @@ class BackgroundScanService:
         """Scan dirty (or all, when full/revision changed) resources.
         Returns the number of resources evaluated."""
         revision = self.cache.revision
+        # swap the dirty set FIRST: changes arriving during this scan
+        # land in the fresh set and are picked up next pass (no lost
+        # invalidations between items() and processing)
+        with self._lock:
+            dirty, self._dirty = self._dirty, set()
         items = self.snapshot.items()
         todo: List[Tuple[str, Dict[str, Any], str]] = []
         for uid, res, h in items:
-            if full or uid in self._dirty or self._needs_scan(uid, h, revision):
+            if full or uid in dirty or self._needs_scan(uid, h, revision):
                 todo.append((uid, res, h))
             else:
                 self.stats["skipped_clean"] += 1
-        self._dirty.clear()
         if not todo:
             return 0
         scanner = self._get_scanner(revision)
@@ -104,7 +124,11 @@ class BackgroundScanService:
         for start in range(0, len(todo), self.batch_size):
             chunk = todo[start:start + self.batch_size]
             resources = [r for (_, r, _) in chunk]
+            t0 = time.perf_counter()
             result = scanner.scan(resources, ns_labels)
+            self.metrics.device_dispatch.observe(
+                time.perf_counter() - t0, {"engine": "scan"})
+            self.metrics.batch_size.observe(len(chunk))
             for ci, (uid, res, h) in enumerate(chunk):
                 meta = res.get("metadata") or {}
                 results = []
@@ -112,15 +136,19 @@ class BackgroundScanService:
                     code = int(result.verdicts[row, ci])
                     if code == NOT_MATCHED:
                         continue
+                    status = _CODE_TO_RESULT.get(code, "error")
+                    self.metrics.policy_results.inc(
+                        {"policy": pname, "status": status})
                     results.append(ReportResult(
                         policy=pname, rule=rname,
-                        result=_CODE_TO_RESULT.get(code, "error"),
+                        result=status,
                         resource_kind=res.get("kind", ""),
                         resource_name=meta.get("name", ""),
                         resource_namespace=meta.get("namespace", ""),
                     ))
                 self.aggregator.put(uid, results)
-                self._scanned[uid] = (h, revision)
+                with self._lock:
+                    self._scanned[uid] = (h, revision)
             total += len(chunk)
         self.stats["scans"] += 1
         self.stats["resources_scanned"] += total

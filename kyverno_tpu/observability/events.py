@@ -46,6 +46,8 @@ class EventGenerator:
         self._omit = set(omit_reasons or [])
         self.dropped = 0
         self.emitted = 0
+        self._counter_lock = threading.Lock()
+        self._inflight = 0
         self._workers = [
             threading.Thread(target=self._drain, daemon=True) for _ in range(workers)
         ]
@@ -75,19 +77,28 @@ class EventGenerator:
         while True:
             e = self._queue.get()
             if e is None:
+                self._queue.task_done()
                 return
             try:
                 self._sink(e)
-                self.emitted += 1
+                with self._counter_lock:
+                    self.emitted += 1
             except Exception:
                 pass
+            finally:
+                self._queue.task_done()
 
     def flush(self, timeout: float = 5.0) -> None:
+        """Wait until every queued event has been fully processed
+        (task_done accounting covers sink calls in flight)."""
         import time
 
         deadline = time.time() + timeout
-        while not self._queue.empty() and time.time() < deadline:
-            time.sleep(0.01)
+        while time.time() < deadline:
+            with self._queue.all_tasks_done:
+                if self._queue.unfinished_tasks == 0:
+                    return
+            time.sleep(0.005)
 
     def stop(self) -> None:
         for _ in self._workers:

@@ -1,0 +1,153 @@
+"""Policy object validation (pkg/validation/policy/validate.go).
+
+Validates policies at admission/load time: structural rules (unique
+rule names, exactly one rule type, non-empty match), the variable
+whitelist with background-mode safety (background policies may not use
+admission-request variables, background.go), and pattern sanity
+(anchors on scalar leaves, operator spelling). Returns a list of
+error strings; empty means valid. Warnings are returned separately.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Set, Tuple
+
+from ..api.policy import ClusterPolicy
+from ..engine.anchor import parse as parse_anchor
+from ..engine.variables import REGEX_VARIABLES
+
+# allowed_vars (pkg/validation/policy/validate.go ValidateVariables):
+# everything the engine seeds plus rule context entry names
+_ALLOWED_PREFIXES = (
+    "request.", "element", "elementIndex", "@", "images", "image",
+    "serviceAccountName", "serviceAccountNamespace", "target.",
+    "globalContext.",
+)
+# background policies cannot see admission request data (background.go)
+_BACKGROUND_FORBIDDEN = re.compile(
+    r"^request\.(userInfo|roles|clusterRoles)\b")
+
+
+def _iter_variables(tree: Any):
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            yield from _iter_variables(k)
+            yield from _iter_variables(v)
+    elif isinstance(tree, list):
+        for v in tree:
+            yield from _iter_variables(v)
+    elif isinstance(tree, str):
+        for m in REGEX_VARIABLES.finditer(tree):
+            yield m.group(2)[2:-2].strip()
+
+
+def _rule_types(rule: Dict[str, Any]) -> List[str]:
+    out = []
+    for key in ("validate", "mutate", "generate", "verifyImages"):
+        if rule.get(key) is not None:
+            out.append(key)
+    return out
+
+
+def _validate_body_types(v: Dict[str, Any]) -> List[str]:
+    bodies = [k for k in ("pattern", "anyPattern", "deny", "foreach",
+                          "podSecurity", "cel", "manifests") if v.get(k) is not None]
+    errs = []
+    if len(bodies) == 0:
+        errs.append("validate rule requires one of pattern/anyPattern/deny/"
+                    "foreach/podSecurity/cel/manifests")
+    if len(bodies) > 1:
+        errs.append(f"validate rule may declare only one body, found {bodies}")
+    return errs
+
+
+def _check_match_block(rule: Dict[str, Any]) -> List[str]:
+    match = rule.get("match") or {}
+    blocks = []
+    if match.get("any"):
+        blocks = [rf.get("resources") or {} for rf in match["any"]]
+    elif match.get("all"):
+        blocks = [rf.get("resources") or {} for rf in match["all"]]
+    else:
+        blocks = [match.get("resources") or {}]
+    errs = []
+    user_blocks = [match] + list(match.get("any") or []) + list(match.get("all") or [])
+    has_user = any(b.get("subjects") or b.get("roles") or b.get("clusterRoles")
+                   for b in user_blocks)
+    if not has_user and all(not any(b.get(f) for f in (
+            "kinds", "name", "names", "namespaces", "annotations",
+            "selector", "namespaceSelector", "operations")) for b in blocks):
+        errs.append(f"rule {rule.get('name')!r}: match block cannot be empty")
+    return errs
+
+
+def _check_pattern_anchors(pattern: Any, path: str, errs: List[str]) -> None:
+    if isinstance(pattern, dict):
+        for k, v in pattern.items():
+            a = parse_anchor(str(k))
+            if a is not None and a.modifier == "+":
+                errs.append(f"addIfNotPresent anchor +() is a mutate anchor, "
+                            f"not valid in validate patterns (at {path}/{k})")
+            _check_pattern_anchors(v, f"{path}/{k}", errs)
+    elif isinstance(pattern, list):
+        for i, v in enumerate(pattern):
+            _check_pattern_anchors(v, f"{path}/{i}", errs)
+
+
+def validate_policy(policy: ClusterPolicy,
+                    extra_allowed: Tuple[str, ...] = ()) -> Tuple[List[str], List[str]]:
+    """Returns (errors, warnings)."""
+    errors: List[str] = []
+    warnings: List[str] = []
+    raw = policy.raw
+    if not policy.name:
+        errors.append("policy has no name")
+    spec = raw.get("spec") or {}
+    rules = spec.get("rules") or []
+    if not rules:
+        errors.append("policy has no rules")
+    seen: Set[str] = set()
+    background = spec.get("background", True)
+    for rule in rules:
+        name = rule.get("name") or ""
+        if not name:
+            errors.append("rule without a name")
+        if name in seen:
+            errors.append(f"duplicate rule name {name!r}")
+        seen.add(name)
+        if len(name) > 63:
+            errors.append(f"rule name {name!r} exceeds 63 characters")
+        types = _rule_types(rule)
+        if len(types) != 1:
+            errors.append(
+                f"rule {name!r} must define exactly one of validate/mutate/"
+                f"generate/verifyImages, found {types or 'none'}")
+        errors.extend(_check_match_block(rule))
+        v = rule.get("validate")
+        if v is not None:
+            errors.extend(f"rule {name!r}: {e}" for e in _validate_body_types(v))
+            if v.get("pattern") is not None:
+                _check_pattern_anchors(v["pattern"], "pattern", errors)
+            for p in v.get("anyPattern") or []:
+                _check_pattern_anchors(p, "anyPattern", errors)
+        # variable whitelist
+        context_names = tuple(
+            (c.get("name") or "") for c in (rule.get("context") or []))
+        allowed = _ALLOWED_PREFIXES + context_names + extra_allowed
+        for var in set(_iter_variables(rule)):
+            base = var.split("|")[0].strip()
+            if base.startswith("\"") or base.startswith("'"):
+                continue
+            root = re.split(r"[.\[(]", base, 1)[0]
+            if not any(base.startswith(p) or root == p.rstrip(".")
+                       for p in allowed):
+                warnings.append(
+                    f"rule {name!r}: variable {{{{{var}}}}} is not in the "
+                    f"allowed list and will fail policy admission")
+            if background and _BACKGROUND_FORBIDDEN.match(base):
+                errors.append(
+                    f"rule {name!r}: background policies cannot reference "
+                    f"admission request data ({{{{{var}}}}}); set "
+                    f"spec.background=false")
+    return errors, warnings

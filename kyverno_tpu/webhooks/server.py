@@ -17,13 +17,18 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional, Tuple
 
+import time
+
 from ..api.policy import ClusterPolicy
 from ..cluster.policycache import PolicyCache, PolicyType
+from ..config import Configuration, Toggles
+from ..observability.metrics import MetricsRegistry, global_registry
 from ..cluster.reports import ReportAggregator, ReportResult
 from ..cluster.snapshot import ClusterSnapshot, resource_uid
 from ..engine.engine import Engine as ScalarEngine
 from ..engine.match import RequestInfo
-from ..tpu.engine import TpuEngine, VERDICT_NAMES, build_scan_context
+from ..tpu.engine import (TpuEngine, VERDICT_NAMES, _scalar_rule_verdicts,
+                          build_scan_context)
 from ..tpu.evaluator import ERROR, FAIL, NOT_MATCHED
 from ..utils.jsonpatch import diff as jsonpatch_diff
 from .batcher import MicroBatcher
@@ -50,10 +55,16 @@ class Handlers:
         aggregator: Optional[ReportAggregator] = None,
         max_batch: int = 256,
         max_wait_ms: float = 2.0,
+        configuration: Optional[Configuration] = None,
+        toggles: Optional[Toggles] = None,
+        metrics: Optional[MetricsRegistry] = None,
     ) -> None:
         self.cache = cache
         self.snapshot = snapshot
         self.aggregator = aggregator
+        self.configuration = configuration
+        self.toggles = toggles or Toggles()
+        self.metrics = metrics or global_registry
         self.scalar = ScalarEngine()
         self._engines: Dict[int, TpuEngine] = {}
         self._lock = threading.Lock()
@@ -78,12 +89,34 @@ class Handlers:
             for p in payloads
         ]
         ns_labels = self.snapshot.namespace_labels() if self.snapshot else {}
+        t0 = time.perf_counter()
+        if self.toggles.engine == "scalar":
+            # toggle-gated host path (pkg/toggle analogue): same verdict
+            # table, computed by the scalar oracle per (policy, resource)
+            out = []
+            for p, res in zip(payloads, resources):
+                pctx_rows = []
+                for entry in eng.cps.rules:
+                    policy = eng.cps.policies[entry.policy_idx]
+                    pctx = build_scan_context(
+                        policy, res, ns_labels.get(p.namespace, {}),
+                        p.operation, p.info)
+                    verdicts = _scalar_rule_verdicts(self.scalar, policy, pctx)
+                    pctx_rows.append(((entry.policy_name, entry.rule_name),
+                                      verdicts.get(entry.rule_name, NOT_MATCHED)))
+                out.append(pctx_rows)
+            self.metrics.device_dispatch.observe(time.perf_counter() - t0,
+                                                 {"engine": "scalar"})
+            return out
         result = eng.scan(
             resources,
             ns_labels,
             operations=[p.operation for p in payloads],
             admission_infos=[p.info for p in payloads],
         )
+        self.metrics.device_dispatch.observe(time.perf_counter() - t0,
+                                             {"engine": "tpu"})
+        self.metrics.batch_size.observe(len(payloads))
         return [
             [(result.rules[row], int(result.verdicts[row, ci]))
              for row in range(len(result.rules))]
@@ -93,8 +126,13 @@ class Handlers:
     # -- public handlers
 
     def validate(self, review: Dict[str, Any], failure_policy: str = "fail") -> Dict[str, Any]:
+        t0 = time.perf_counter()
         req = review.get("request") or {}
         payload = _payload_from_request(req)
+        self.metrics.admission_requests.inc(
+            {"operation": payload.operation, "path": "validate"})
+        if self._filtered(payload):
+            return _response(req, True, "")
         try:
             verdicts = self.batcher.submit(payload)
         except Exception as e:
@@ -129,13 +167,33 @@ class Handlers:
                 self.aggregator.drop(resource_uid(evaluated))
             else:
                 self.aggregator.put(resource_uid(evaluated), audit_results)
+        self.metrics.admission_duration.observe(time.perf_counter() - t0,
+                                                {"path": "validate"})
         if block_msgs:
             return _response(req, False, "; ".join(block_msgs))
         return _response(req, True, "")
 
+    def _filtered(self, payload: AdmissionPayload) -> bool:
+        """WithFilter middleware: resourceFilters + user exclusions
+        short-circuit processing (handlers/filter.go)."""
+        if self.configuration is None:
+            return False
+        res = payload.old if (payload.operation == "DELETE" and payload.old) \
+            else payload.resource
+        meta = res.get("metadata") or {}
+        if self.configuration.to_filter(
+                res.get("kind", ""), meta.get("namespace", ""), meta.get("name", "")):
+            return True
+        return self.configuration.is_excluded(
+            payload.info.username, payload.info.groups, payload.info.roles)
+
     def mutate(self, review: Dict[str, Any], failure_policy: str = "fail") -> Dict[str, Any]:
         req = review.get("request") or {}
         payload = _payload_from_request(req)
+        self.metrics.admission_requests.inc(
+            {"operation": payload.operation, "path": "mutate"})
+        if self._filtered(payload):
+            return _response(req, True, "")
         resource = payload.resource
         patched = resource
         ns_labels = self.snapshot.namespace_labels() if self.snapshot else {}

@@ -1,0 +1,130 @@
+"""UpdateRequest queue, generate executor, mutate-existing executor."""
+
+from kyverno_tpu.api.policy import ClusterPolicy
+from kyverno_tpu.background import (
+    GenerateController,
+    MutateExistingController,
+    UpdateRequest,
+    UpdateRequestQueue,
+    UR_COMPLETED,
+    UR_FAILED,
+)
+from kyverno_tpu.cluster.snapshot import ClusterSnapshot
+
+GEN_POLICY = ClusterPolicy.from_dict({
+    "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+    "metadata": {"name": "add-networkpolicy"},
+    "spec": {"rules": [{
+        "name": "default-deny",
+        "match": {"any": [{"resources": {"kinds": ["Namespace"]}}]},
+        "generate": {
+            "apiVersion": "networking.k8s.io/v1",
+            "kind": "NetworkPolicy",
+            "name": "default-deny",
+            "namespace": "{{request.object.metadata.name}}",
+            "synchronize": True,
+            "data": {"spec": {"podSelector": {}, "policyTypes": ["Ingress"]}},
+        },
+    }]},
+})
+
+CLONE_POLICY = ClusterPolicy.from_dict({
+    "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+    "metadata": {"name": "clone-secret"},
+    "spec": {"rules": [{
+        "name": "clone-regcred",
+        "match": {"any": [{"resources": {"kinds": ["Namespace"]}}]},
+        "generate": {
+            "apiVersion": "v1", "kind": "Secret",
+            "name": "regcred",
+            "namespace": "{{request.object.metadata.name}}",
+            "synchronize": True,
+            "clone": {"namespace": "default", "name": "regcred"},
+        },
+    }]},
+})
+
+
+def namespace(name):
+    return {"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": name}}
+
+
+def test_generate_data_and_sync_cleanup():
+    snap = ClusterSnapshot()
+    gc = GenerateController(snap, {GEN_POLICY.name: GEN_POLICY})
+    queue = UpdateRequestQueue()
+    trigger = namespace("team-a")
+    queue.add(UpdateRequest(policy="add-networkpolicy", rule_type="generate",
+                            trigger=trigger))
+    assert queue.process(gc.process_ur) == 1
+    netpol = gc._find("NetworkPolicy", "team-a", "default-deny")
+    assert netpol is not None
+    assert netpol["spec"]["policyTypes"] == ["Ingress"]
+    assert netpol["metadata"]["labels"]["generate.kyverno.io/policy-name"] == "add-networkpolicy"
+    # trigger deletion removes the synchronized downstream
+    assert gc.process_trigger_deletion(GEN_POLICY, trigger) == 1
+    assert gc._find("NetworkPolicy", "team-a", "default-deny") is None
+
+
+def test_generate_clone_and_missing_source_retries():
+    snap = ClusterSnapshot()
+    gc = GenerateController(snap, {CLONE_POLICY.name: CLONE_POLICY})
+    queue = UpdateRequestQueue()
+    ur = queue.add(UpdateRequest(policy="clone-secret", rule_type="generate",
+                                 trigger=namespace("team-b")))
+    # source missing -> retry, stays pending
+    assert queue.process(gc.process_ur) == 0
+    assert ur.retries == 1 and ur.status == "Pending"
+    snap.upsert({"apiVersion": "v1", "kind": "Secret",
+                 "metadata": {"name": "regcred", "namespace": "default"},
+                 "data": {"k": "v"}})
+    assert queue.process(gc.process_ur) == 1
+    clone = gc._find("Secret", "team-b", "regcred")
+    assert clone is not None and clone["data"] == {"k": "v"}
+    assert ur.status == UR_COMPLETED
+
+
+def test_ur_max_retries_marks_failed():
+    snap = ClusterSnapshot()
+    gc = GenerateController(snap, {CLONE_POLICY.name: CLONE_POLICY})
+    queue = UpdateRequestQueue()
+    ur = queue.add(UpdateRequest(policy="clone-secret", rule_type="generate",
+                                 trigger=namespace("team-c")))
+    for _ in range(10):
+        queue.process(gc.process_ur)
+    assert ur.status == UR_FAILED
+    assert "not found" in ur.message
+
+
+MUT_POLICY = ClusterPolicy.from_dict({
+    "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+    "metadata": {"name": "label-secrets"},
+    "spec": {"rules": [{
+        "name": "mark-ns-secrets",
+        "match": {"any": [{"resources": {"kinds": ["Namespace"]}}]},
+        "mutate": {
+            "targets": [{"apiVersion": "v1", "kind": "Secret",
+                         "namespace": "{{request.object.metadata.name}}"}],
+            "patchStrategicMerge": {"metadata": {"labels": {"audited": "true"}}},
+        },
+    }]},
+})
+
+
+def test_mutate_existing_targets():
+    snap = ClusterSnapshot()
+    snap.upsert({"apiVersion": "v1", "kind": "Secret",
+                 "metadata": {"name": "s1", "namespace": "team-d"}})
+    snap.upsert({"apiVersion": "v1", "kind": "Secret",
+                 "metadata": {"name": "s2", "namespace": "other"}})
+    mc = MutateExistingController(snap, {MUT_POLICY.name: MUT_POLICY})
+    queue = UpdateRequestQueue()
+    queue.add(UpdateRequest(policy="label-secrets", rule_type="mutate",
+                            trigger=namespace("team-d")))
+    assert queue.process(mc.process_ur) == 1
+    s1 = [r for _, r, _ in snap.items()
+          if (r.get("metadata") or {}).get("name") == "s1"][0]
+    s2 = [r for _, r, _ in snap.items()
+          if (r.get("metadata") or {}).get("name") == "s2"][0]
+    assert (s1["metadata"].get("labels") or {}).get("audited") == "true"
+    assert "labels" not in s2["metadata"]

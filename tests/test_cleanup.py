@@ -1,0 +1,98 @@
+"""Cron engine, cleanup policies, TTL controller."""
+
+import datetime as dt
+
+import pytest
+
+from kyverno_tpu.cluster.cleanup import CleanupController, TtlController
+from kyverno_tpu.cluster.snapshot import ClusterSnapshot
+from kyverno_tpu.utils.cron import Cron, CronError
+
+
+def test_cron_parsing_and_next():
+    c = Cron("*/15 2 * * *")
+    nxt = c.next_after(dt.datetime(2026, 7, 29, 1, 50))
+    assert nxt == dt.datetime(2026, 7, 29, 2, 0)
+    assert c.next_after(nxt) == dt.datetime(2026, 7, 29, 2, 15)
+    assert c.next_after(dt.datetime(2026, 7, 29, 2, 46)) == dt.datetime(2026, 7, 30, 2, 0)
+    # day-of-week; 2026-07-29 is a Wednesday (dow 3)
+    c2 = Cron("0 0 * * 3")
+    assert c2.next_after(dt.datetime(2026, 7, 23, 0, 0)) == dt.datetime(2026, 7, 29, 0, 0)
+    # Vixie OR: dom 1 or Friday
+    c3 = Cron("0 0 1 * 5")
+    assert c3.next_after(dt.datetime(2026, 7, 29, 0, 0)) == dt.datetime(2026, 7, 31, 0, 0)
+    with pytest.raises(CronError):
+        Cron("x * * * *")
+    with pytest.raises(CronError):
+        Cron("* * * *")
+
+
+def test_cleanup_policy_deletes_matching():
+    snap = ClusterSnapshot()
+    snap.upsert({"apiVersion": "v1", "kind": "Pod",
+                 "metadata": {"name": "done-1", "namespace": "jobs",
+                              "labels": {"state": "done"}},
+                 "status": {"phase": "Succeeded"}})
+    snap.upsert({"apiVersion": "v1", "kind": "Pod",
+                 "metadata": {"name": "live-1", "namespace": "jobs",
+                              "labels": {"state": "running"}}})
+    ctl = CleanupController(snap)
+    ctl.set_policy({
+        "apiVersion": "kyverno.io/v2beta1", "kind": "ClusterCleanupPolicy",
+        "metadata": {"name": "sweep-done"},
+        "spec": {
+            "schedule": "*/5 * * * *",
+            "match": {"any": [{"resources": {
+                "kinds": ["Pod"], "selector": {"matchLabels": {"state": "done"}}}}]},
+        },
+    })
+    # due on the next 5-minute boundary relative to last execution
+    assert ctl.run_due(dt.datetime(2026, 7, 29, 12, 5)) == 1
+    names = [(r.get("metadata") or {}).get("name") for _, r, _ in snap.items()]
+    assert names == ["live-1"]
+    # not due again until the next boundary
+    assert ctl.run_due(dt.datetime(2026, 7, 29, 12, 6)) == 0
+
+
+def test_cleanup_conditions_gate():
+    snap = ClusterSnapshot()
+    snap.upsert({"apiVersion": "v1", "kind": "Pod",
+                 "metadata": {"name": "p", "namespace": "x"},
+                 "status": {"phase": "Running"}})
+    ctl = CleanupController(snap)
+    p = ctl.set_policy({
+        "apiVersion": "kyverno.io/v2beta1", "kind": "ClusterCleanupPolicy",
+        "metadata": {"name": "sweep-succeeded"},
+        "spec": {
+            "schedule": "* * * * *",
+            "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+            "conditions": {"all": [{
+                "key": "{{ request.object.status.phase }}",
+                "operator": "Equals", "value": "Succeeded"}]},
+        },
+    })
+    assert ctl.execute(p) == 0
+    snap.upsert({"apiVersion": "v1", "kind": "Pod",
+                 "metadata": {"name": "p", "namespace": "x"},
+                 "status": {"phase": "Succeeded"}})
+    assert ctl.execute(p) == 1
+
+
+def test_ttl_controller():
+    snap = ClusterSnapshot()
+    snap.upsert({"apiVersion": "v1", "kind": "ConfigMap",
+                 "metadata": {"name": "old", "namespace": "d",
+                              "creationTimestamp": "2026-07-29T10:00:00Z",
+                              "labels": {"cleanup.kyverno.io/ttl": "1h"}}})
+    snap.upsert({"apiVersion": "v1", "kind": "ConfigMap",
+                 "metadata": {"name": "fresh", "namespace": "d",
+                              "creationTimestamp": "2026-07-29T10:00:00Z",
+                              "labels": {"cleanup.kyverno.io/ttl": "48h"}}})
+    snap.upsert({"apiVersion": "v1", "kind": "ConfigMap",
+                 "metadata": {"name": "dated", "namespace": "d",
+                              "labels": {"cleanup.kyverno.io/ttl": "2026-07-29T11:00:00Z"}}})
+    ctl = TtlController(snap)
+    now = dt.datetime(2026, 7, 29, 12, 0, tzinfo=dt.timezone.utc)
+    assert ctl.run_once(now) == 2
+    names = sorted((r.get("metadata") or {}).get("name") for _, r, _ in snap.items())
+    assert names == ["fresh"]

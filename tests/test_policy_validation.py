@@ -1,0 +1,88 @@
+"""Policy object validation."""
+
+from kyverno_tpu.api.policy import ClusterPolicy
+from kyverno_tpu.policies import load_pss_policies
+from kyverno_tpu.policy.validation import validate_policy
+
+
+def make(spec_rules, background=True):
+    return ClusterPolicy.from_dict({
+        "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+        "metadata": {"name": "p"},
+        "spec": {"background": background, "rules": spec_rules},
+    })
+
+
+GOOD_RULE = {
+    "name": "r1",
+    "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+    "validate": {"pattern": {"spec": {"x": "y"}}},
+}
+
+
+def test_valid_policy_passes():
+    errs, warns = validate_policy(make([GOOD_RULE]))
+    assert errs == [] and warns == []
+
+
+def test_bundled_pss_policies_validate():
+    for p in load_pss_policies():
+        errs, warns = validate_policy(p)
+        assert errs == [], (p.name, errs)
+        assert warns == [], (p.name, warns)
+
+
+def test_duplicate_and_multi_type_rules():
+    bad = dict(GOOD_RULE)
+    bad2 = dict(GOOD_RULE)
+    bad2["mutate"] = {"patchStrategicMerge": {}}
+    errs, _ = validate_policy(make([bad, bad2]))
+    assert any("duplicate rule name" in e for e in errs)
+    assert any("exactly one of" in e for e in errs)
+
+
+def test_empty_match_and_missing_body():
+    errs, _ = validate_policy(make([{
+        "name": "r", "match": {}, "validate": {}}]))
+    assert any("match block cannot be empty" in e for e in errs)
+    assert any("requires one of" in e for e in errs)
+
+
+def test_background_forbidden_variables():
+    rule = {
+        "name": "r",
+        "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+        "validate": {"deny": {"conditions": {"all": [{
+            "key": "{{ request.userInfo.username }}",
+            "operator": "Equals", "value": "x"}]}}},
+    }
+    errs, _ = validate_policy(make([rule], background=True))
+    assert any("background policies cannot reference" in e for e in errs)
+    errs, _ = validate_policy(make([rule], background=False))
+    assert not any("background" in e for e in errs)
+
+
+def test_unknown_variable_warns():
+    rule = {
+        "name": "r",
+        "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+        "validate": {"pattern": {"spec": {"x": "{{ mystery.var }}"}}},
+    }
+    _, warns = validate_policy(make([rule]))
+    assert any("mystery.var" in w for w in warns)
+    # context entries whitelist their name
+    rule2 = dict(rule)
+    rule2["context"] = [{"name": "mystery", "variable": {"value": 1}}]
+    rule2["validate"] = {"pattern": {"spec": {"x": "{{ mystery.var }}"}}}
+    _, warns = validate_policy(make([rule2]))
+    assert warns == []
+
+
+def test_plus_anchor_rejected_in_validate():
+    rule = {
+        "name": "r",
+        "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+        "validate": {"pattern": {"spec": {"+(x)": "y"}}},
+    }
+    errs, _ = validate_policy(make([rule]))
+    assert any("+()" in e for e in errs)

@@ -1,0 +1,78 @@
+"""ControlPlane (`serve`): admission + snapshot + scan + metrics
+round-trip over HTTP."""
+
+import http.client
+import json
+
+import pytest
+
+from kyverno_tpu.api.policy import ClusterPolicy
+from kyverno_tpu.cli.serve import ControlPlane
+
+POLICY = ClusterPolicy.from_dict({
+    "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+    "metadata": {"name": "no-privileged"},
+    "spec": {
+        "validationFailureAction": "Enforce",
+        "rules": [{
+            "name": "privileged",
+            "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+            "validate": {
+                "message": "privileged is forbidden",
+                "pattern": {"spec": {"containers": [
+                    {"=(securityContext)": {"=(privileged)": "false"}}]}},
+            },
+        }],
+    },
+})
+
+
+def pod(name, priv):
+    return {"apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": name, "namespace": "default"},
+            "spec": {"containers": [{
+                "name": "c", "image": "nginx",
+                "securityContext": {"privileged": priv}}]}}
+
+
+@pytest.fixture(scope="module")
+def cp():
+    plane = ControlPlane([POLICY], port=0, metrics_port=0)
+    plane.start(scan_interval=3600)  # scans driven explicitly below
+    yield plane
+    plane.stop()
+
+
+def _req(port, method, path, body=None):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+    conn.request(method, path, json.dumps(body) if body is not None else None,
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    data = resp.read()
+    conn.close()
+    return resp.status, data
+
+
+def test_snapshot_scan_reports_metrics(cp):
+    mport = cp.metrics_server.server_address[1]
+    for i, priv in enumerate([True, False, False]):
+        status, _ = _req(mport, "POST", "/snapshot/upsert", pod(f"p{i}", priv))
+        assert status == 200
+    status, data = _req(mport, "POST", "/scan", {})
+    out = json.loads(data)
+    assert status == 200 and out["scanned"] == 3
+    assert out["summary"]["fail"] == 1 and out["summary"]["pass"] == 2
+    status, data = _req(mport, "GET", "/reports")
+    reports = json.loads(data)
+    assert reports["default"]["summary"]["fail"] == 1
+    status, data = _req(mport, "GET", "/metrics")
+    assert status == 200 and b"# TYPE" in data
+
+
+def test_admission_alongside_scan(cp):
+    review = {"apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview",
+              "request": {"uid": "u", "operation": "CREATE",
+                          "namespace": "default", "object": pod("adm", True)}}
+    status, data = _req(cp.admission.port, "POST", "/validate", review)
+    out = json.loads(data)
+    assert status == 200 and out["response"]["allowed"] is False

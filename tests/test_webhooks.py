@@ -124,3 +124,30 @@ def test_jsonpatch_diff_roundtrip():
     from kyverno_tpu.engine.mutate import apply_json6902
 
     assert apply_json6902(orig, ops) == new
+
+
+def test_scalar_toggle_and_config_filter():
+    from kyverno_tpu.config import Configuration, Toggles
+
+    cache = PolicyCache()
+    cache.set(ClusterPolicy.from_dict(VALIDATE_POLICY))
+    cfg = Configuration()
+    cfg.load({"resourceFilters": "[Pod,skip-ns,*]",
+              "excludeUsernames": "system:serviceaccount:kyverno:*"})
+    handlers = build_handlers(cache, configuration=cfg,
+                              toggles=Toggles(engine="scalar"))
+    out = handlers.validate(review(pod("bad", True)))
+    assert out["response"]["allowed"] is False  # scalar path blocks too
+    # resourceFilter short-circuits
+    filtered = pod("bad", True)
+    filtered["metadata"]["namespace"] = "skip-ns"
+    r = review(filtered)
+    r["request"]["namespace"] = "skip-ns"
+    out = handlers.validate(r)
+    assert out["response"]["allowed"] is True
+    # excluded service account short-circuits
+    r = review(pod("bad2", True))
+    r["request"]["userInfo"] = {"username": "system:serviceaccount:kyverno:admission"}
+    out = handlers.validate(r)
+    assert out["response"]["allowed"] is True
+    handlers.batcher.stop()
