@@ -75,7 +75,7 @@ _LANES_F32 = ("num_val", "qty_val", "dur_val", "arr_len")
 _LANES_I32 = ("scope1", "scope2", "byte_slot")
 _LANES_U8 = (
     "type_tag", "bool_val", "has_repr", "has_qty", "has_dur", "has_num",
-    "str_goint", "str_gofloat",
+    "str_goint", "str_gofloat", "has_glob",
 )
 
 
@@ -191,6 +191,11 @@ class _ResourceEncoder:
             b.num_hi[i, r], b.num_lo[i, r] = split32(canon_number(value))
         else:
             b.type_tag[i, r] = T_STR
+            # condition membership wildcard-matches in BOTH directions
+            # (_wild_either): a resource value containing */? acts as a
+            # pattern — those cells must resolve on the host
+            if "*" in value or "?" in value:
+                b.has_glob[i, r] = 1
             # int-pattern vs string value requires the *int* grammar,
             # float-pattern the float grammar (pattern.go:71,107); the
             # str_goint / str_gofloat flags keep them distinct on device

@@ -366,6 +366,26 @@ class OpKey:
 
 
 @dataclass
+class LiteralKey:
+    """A non-variable condition key (foreach deny's `key: ALL`)."""
+
+    value: Any
+
+
+@dataclass
+class ElementCollect:
+    """A {{ element... }} expression inside a foreach body: rows are
+    collected RELATIVE to the current array element and joined per
+    instance via the scope index."""
+
+    states: List[PathState]
+    array_roots: List[Tuple[Tuple[str, ...], str]]
+    is_projection: bool
+    default: Optional[Any]          # only None / [] / '' supported
+    keys_error_states: List[PathState] = field(default_factory=list)
+
+
+@dataclass
 class PathCollect:
     """key collects rows of the flattened resource."""
 
@@ -397,6 +417,16 @@ class CondTreeIR:
 
 _VAR_RE = re.compile(r"^\{\{(.*)\}\}$", re.DOTALL)
 
+
+def _mentions_element(ast: Any) -> bool:
+    if isinstance(ast, tuple):
+        if ast == ("field", "element"):
+            return True
+        return any(_mentions_element(x) for x in ast)
+    if isinstance(ast, list):
+        return any(_mentions_element(x) for x in ast)
+    return False
+
 # deprecated In/NotIn have strict invalid-type semantics dependent on
 # runtime key element types (in.go:35-43) -> host only
 _SUPPORTED_OPS = {
@@ -407,8 +437,9 @@ _SUPPORTED_OPS = {
 
 
 class ConditionCompiler:
-    def __init__(self) -> None:
+    def __init__(self, element_mode: bool = False) -> None:
         self._parser = JmesParser()
+        self.element_mode = element_mode
 
     def compile_tree(self, conditions: Any) -> Optional[CondTreeIR]:
         """None/empty conditions -> None (always pass)."""
@@ -443,13 +474,18 @@ class ConditionCompiler:
         op = str(cond.get("operator", "")).lower()
         if op not in _SUPPORTED_OPS:
             raise Unsupported(f"operator {op}")
-        value = cond.get("value")
-        self._check_literal_value(value)
+        value = self._compile_value(cond.get("value"))
         key = cond.get("key")
         if not isinstance(key, str):
+            if self.element_mode and isinstance(key, (int, float, bool)):
+                return CondIR(LiteralKey(key), op, value)
             raise Unsupported("non-string condition key")
         m = _VAR_RE.match(key.strip())
         if not m:
+            if self.element_mode and "{{" not in key:
+                if contains_wildcard(key):
+                    raise Unsupported("glob literal key")
+                return CondIR(LiteralKey(key), op, value)
             # literal string key (no variable): constant-foldable, but
             # rare — keep host
             raise Unsupported("non-variable condition key")
@@ -457,7 +493,10 @@ class ConditionCompiler:
         if "{{" in expr:
             raise Unsupported("nested variables in key")
         ast = self._parser.parse(expr)
-        key_ir = self._compile_key(ast)
+        if self.element_mode and _mentions_element(ast):
+            key_ir = self._compile_element_key(ast)
+        else:
+            key_ir = self._compile_key(ast)
         if op in ("equals", "equal", "notequals", "notequal") and isinstance(value, (list, dict)):
             raise Unsupported("deep-equality condition value")
         if op in ("greaterthan", "greaterthanorequals", "lessthan", "lessthanorequals"):
@@ -471,6 +510,23 @@ class ConditionCompiler:
                 if vd is None and vq is None and vf is None:
                     raise Unsupported("possible semver comparison value")
         return CondIR(key_ir, op, value)
+
+    def _compile_value(self, value: Any) -> Any:
+        """Literal passthrough, or an {{ element... }} ElementCollect in
+        foreach bodies."""
+        if self.element_mode and isinstance(value, str):
+            m = _VAR_RE.match(value.strip())
+            if m is not None:
+                expr = m.group(1).strip()
+                if "{{" in expr:
+                    raise Unsupported("nested variables in value")
+                ast = self._parser.parse(expr)
+                ec = self._compile_element_key(ast)
+                if not isinstance(ec, ElementCollect):
+                    raise Unsupported("non-element variable value")
+                return ec
+        self._check_literal_value(value)
+        return value
 
     def _check_literal_value(self, value: Any) -> None:
         if isinstance(value, str):
@@ -497,6 +553,40 @@ class ConditionCompiler:
         raise Unsupported("unsupported condition value type")
 
     # -- key AST lowering
+
+    def _compile_element_key(self, ast: Tuple) -> "ElementCollect":
+        default: Optional[Any] = None
+        if ast[0] == "or":
+            lhs, rhs = ast[1], ast[2]
+            if rhs[0] != "literal":
+                raise Unsupported("non-literal || default")
+            default = rhs[1]
+            ast = lhs
+        if default not in (None, [], ""):
+            raise Unsupported("foreach default other than []/''")
+        self._keys_error_states = []
+        # rebase: the walk treats `element` as the root
+        states, roots, is_proj = self._walk_element(ast)
+        return ElementCollect(states, roots, is_proj, default,
+                              keys_error_states=self._keys_error_states)
+
+    def _walk_element(self, ast: Tuple):
+        kind = ast[0]
+        if ast == ("field", "element"):
+            return [PathState((), "value")], [], False
+        if kind == "subexpression":
+            states, roots, proj = self._walk_element(ast[1])
+            return self._apply_rhs(ast[2], states, roots, proj)
+        if kind == "projection":
+            flat = ast[1]
+            if flat[0] != "flatten":
+                raise Unsupported("non-flatten projection")
+            states, roots, _ = self._walk_element(flat[1])
+            estates, eroots = self._flatten(states)
+            roots = roots + eroots
+            out_states, out_roots, _ = self._apply_rhs(ast[2], estates, roots, True)
+            return out_states, out_roots, True
+        raise Unsupported(f"element expression construct {kind}")
 
     def _compile_key(self, ast: Tuple) -> Any:
         default: Optional[Any] = None
@@ -595,6 +685,54 @@ class ConditionCompiler:
                                      no_null=True))
                 roots.append((st.segs, "array"))
         return out, roots
+
+
+@dataclass
+class ForeachDeny:
+    """One validate.foreach entry of the deny flavor: per-element
+    condition evaluation over the listed arrays (the capabilities-strict
+    shape). Semantics per validate_resource.go:163-233: any denied
+    element fails the rule; zero applied elements skips it."""
+
+    arrays: List[Tuple[str, ...]]   # absolute array paths (depth-1)
+    tree: CondTreeIR
+
+
+def compile_foreach_list(ast: Tuple) -> List[Tuple[str, ...]]:
+    """Recognize `request.object.<chain>[]` and
+    `request.object.<chain>.[f1, f2, ...][]` foreach lists; returns the
+    element array paths."""
+
+    def chain_fields(node: Tuple) -> Optional[List[str]]:
+        if node == ("subexpression", ("field", "request"), ("field", "object")):
+            return []
+        if node[0] == "subexpression" and node[2][0] == "field":
+            base = chain_fields(node[1])
+            if base is None:
+                return None
+            return base + [node[2][1]]
+        return None
+
+    if ast[0] != "projection" or ast[1][0] != "flatten":
+        raise Unsupported("foreach list must be a [] projection")
+    if ast[2] not in (("identity",), ("current",)):
+        raise Unsupported("foreach list with projected RHS")
+    inner = ast[1][1]
+    # multiselect form: chain . [f1, f2, f3]
+    if inner[0] == "subexpression" and inner[2][0] == "multiselect_list":
+        base = chain_fields(inner[1])
+        if base is None:
+            raise Unsupported("foreach list base not request.object")
+        arrays = []
+        for sub in inner[2][1]:
+            if sub[0] != "field":
+                raise Unsupported("foreach multiselect with non-field entry")
+            arrays.append(tuple(base + [sub[1]]))
+        return arrays
+    fields = chain_fields(inner)
+    if fields is None:
+        raise Unsupported("foreach list base not request.object")
+    return [tuple(fields)]
 
 
 # ---------------------------------------------------------------------------
@@ -733,9 +871,10 @@ class RuleProgram:
     match: Optional[MatchIR]
     exclude: Optional[MatchIR]
     preconditions: Optional[CondTreeIR]
-    kind: str  # pattern | any_pattern | deny
+    kind: str  # pattern | any_pattern | deny | foreach_deny
     patterns: List[Node] = field(default_factory=list)
     deny: Optional[CondTreeIR] = None
+    foreach: List[ForeachDeny] = field(default_factory=list)
     byte_paths: Set[int] = field(default_factory=set)
     message: str = ""
     # set when this rule cannot run on device
@@ -779,4 +918,22 @@ def compile_rule(policy: ClusterPolicy, rule: Rule) -> RuleProgram:
         prog.patterns = [pc.compile(p) for p in v.any_pattern]
         prog.byte_paths = pc.byte_paths
         return prog
-    raise Unsupported("foreach/podSecurity/cel/manifest rule")
+    if v.foreach is not None:
+        prog.kind = "foreach_deny"
+        ecc = ConditionCompiler(element_mode=True)
+        for fe in v.foreach:
+            extra = set(fe.keys()) - {"list", "deny"}
+            if extra:
+                raise Unsupported(f"foreach with {sorted(extra)}")
+            if fe.get("deny") is None:
+                raise Unsupported("foreach without deny")
+            list_expr = fe.get("list", "")
+            if "{{" in list_expr:
+                raise Unsupported("variable foreach list")
+            arrays = compile_foreach_list(ecc._parser.parse(list_expr))
+            tree = ecc.compile_tree((fe["deny"] or {}).get("conditions"))
+            if tree is None:
+                raise Unsupported("foreach deny without conditions")
+            prog.foreach.append(ForeachDeny(arrays, tree))
+        return prog
+    raise Unsupported("podSecurity/cel/manifest rule")
