@@ -56,9 +56,11 @@ from .ir import (
     CondIR,
     CondTreeIR,
     Cmp,
+    ElementCollect,
     ExistenceNode,
     FilterIR,
     LeafNode,
+    LiteralKey,
     MapNode,
     MatchIR,
     Node,
@@ -66,6 +68,7 @@ from .ir import (
     NumLeaf,
     OpKey,
     PathCollect,
+    PathState,
     RuleProgram,
     StrLeaf,
     Unsupported,
@@ -507,32 +510,96 @@ _NUM_OPS = {"greaterthan": "gt", "greaterthanorequals": "ge",
             "lessthan": "lt", "lessthanorequals": "le"}
 
 
-def eval_cond_tree(ctx: Ctx, tree: Optional[CondTreeIR]) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Returns (ok, err), each (N,) bool."""
-    ok = jnp.ones((ctx.N,), dtype=bool)
-    err = jnp.zeros((ctx.N,), dtype=bool)
+def _cond_shape(ctx: Ctx, scope) -> Tuple[int, ...]:
+    return (ctx.N, ctx.I) if isinstance(scope, InstScope) else (ctx.N,)
+
+
+def _expand(ctx: Ctx, scope, r: jnp.ndarray) -> jnp.ndarray:
+    """Broadcast a per-resource (N,) result into the element scope."""
+    if isinstance(scope, InstScope) and r.ndim == 1:
+        return jnp.broadcast_to(r[:, None], (ctx.N, ctx.I))
+    return r
+
+
+def eval_cond_tree(
+    ctx: Ctx, tree: Optional[CondTreeIR], scope=None, prefix: Tuple[str, ...] = ()
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Returns (ok, err) bools — (N,) at resource scope, (N, I) inside a
+    foreach element scope."""
+    scope = scope if scope is not None else Depth0()
+    shape = _cond_shape(ctx, scope)
+    ok = jnp.ones(shape, dtype=bool)
+    err = jnp.zeros(shape, dtype=bool)
     if tree is None:
         return ok, err
     for any_list, all_list in tree.blocks:
         if any_list:
-            acc = jnp.zeros((ctx.N,), dtype=bool)
+            acc = jnp.zeros(shape, dtype=bool)
             for c in any_list:
-                p, e = eval_cond(ctx, c)
+                p, e = eval_cond(ctx, c, scope, prefix)
                 acc = acc | p
                 err = err | e
             ok = ok & acc
         for c in all_list:
-            p, e = eval_cond(ctx, c)
+            p, e = eval_cond(ctx, c, scope, prefix)
             ok = ok & p
             err = err | e
     return ok, err
 
 
-def eval_cond(ctx: Ctx, ir: CondIR) -> Tuple[jnp.ndarray, jnp.ndarray]:
+def eval_cond(
+    ctx: Ctx, ir: CondIR, scope=None, prefix: Tuple[str, ...] = ()
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    scope = scope if scope is not None else Depth0()
+    shape = _cond_shape(ctx, scope)
+    zero_err = jnp.zeros(shape, dtype=bool)
     op = _op_canon(ir.op)
     if isinstance(ir.key, OpKey):
-        return _eval_op_cond(ctx, ir.key, op, ir.value), jnp.zeros((ctx.N,), dtype=bool)
-    return _eval_path_cond(ctx, ir.key, op, ir.value)
+        return _expand(ctx, scope, _eval_op_cond(ctx, ir.key, op, ir.value)), zero_err
+    if isinstance(ir.key, LiteralKey):
+        if isinstance(ir.value, ElementCollect):
+            return _eval_literal_vs_collect(ctx, scope, prefix, ir.key.value, op, ir.value)
+        # literal key + literal value: constant-fold via the scalar oracle
+        from ..engine.conditions import evaluate_condition_values
+
+        const = bool(evaluate_condition_values(ir.key.value, ir.op, ir.value))
+        return jnp.full(shape, const, dtype=bool), zero_err
+    if isinstance(ir.key, ElementCollect):
+        return _eval_path_cond(ctx, ir.key, op, ir.value, scope, prefix)
+    # PathCollect keys always resolve at resource scope
+    res, err = _eval_path_cond(ctx, ir.key, op, ir.value, Depth0(), ())
+    return _expand(ctx, scope, res), _expand(ctx, scope, err)
+
+
+def _eval_literal_vs_collect(
+    ctx: Ctx, scope, prefix: Tuple[str, ...], key_val: Any, op: str, ec: ElementCollect
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """LiteralKey membership against an {{element...}} collected list
+    (the capabilities-strict `key: ALL` shape): hit iff any collected
+    element go_sprint-equals the key (conditions.py _key_exists_in_array
+    list branch). Null / empty / []-default collections all yield
+    hit=False, so no explicit presence gating is needed."""
+    shape = _cond_shape(ctx, scope)
+    err = _keys_errors(ctx, ec.keys_error_states, scope, prefix)
+    mode = _IN_MODES[op]
+    ks = go_sprint(key_val)
+    if ks is None:
+        hit = jnp.zeros(shape, dtype=bool)
+    else:
+        rows = jnp.zeros((ctx.N, ctx.R), dtype=bool)
+        for st in ec.states:
+            if st.mode == "keys":
+                m = ctx.rows_with_parent(prefix + st.segs) & ctx.heq("key", hash_str(ks, tag="k"))
+            else:
+                m = ctx.rows_at(prefix + st.segs)
+                if st.no_arr:
+                    m = m & ~ctx.type_is(T_ARR)
+                if st.no_null:
+                    m = m & ~ctx.type_is(T_NULL)
+                m = m & ctx.heq("sprint", hash_str(ks, tag="s"))
+            rows = rows | m
+        hit = scope.any(rows)
+    return (hit if mode in ("any_in", "all_in") else ~hit), err
 
 
 def _eval_op_cond(ctx: Ctx, key: OpKey, op: str, value: Any) -> jnp.ndarray:
@@ -577,10 +644,13 @@ def _eval_op_cond(ctx: Ctx, key: OpKey, op: str, value: Any) -> jnp.ndarray:
     return jnp.zeros((ctx.N,), dtype=bool)
 
 
-def _collect_masks(ctx: Ctx, pc: PathCollect, literals: List[Any]) -> Tuple[jnp.ndarray, jnp.ndarray]:
+def _collect_masks(
+    ctx: Ctx, pc: PathCollect, literals: List[Any], prefix: Tuple[str, ...] = ()
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """(mask, in_set) over rows for a projection collect; literal
     membership per row uses the sprint lane (value states) or key lane
-    (keys() states)."""
+    (keys() states). Works for ElementCollect too (same field shape)
+    with the element prefix prepended."""
     lits = [go_sprint(v) for v in literals]
     lits = [l for l in lits if l is not None]
     sprint_set = [hash_str(l, tag="s") for l in lits]
@@ -589,10 +659,10 @@ def _collect_masks(ctx: Ctx, pc: PathCollect, literals: List[Any]) -> Tuple[jnp.
     in_set = jnp.zeros((ctx.N, ctx.R), dtype=bool)
     for st in pc.states:
         if st.mode == "keys":
-            m = ctx.rows_with_parent(st.segs)
+            m = ctx.rows_with_parent(prefix + st.segs)
             s = ctx.hset("key", key_set)
         else:
-            m = ctx.rows_at(st.segs)
+            m = ctx.rows_at(prefix + st.segs)
             if st.no_arr:
                 m = m & ~ctx.type_is(T_ARR)
             if st.no_null:
@@ -603,42 +673,44 @@ def _collect_masks(ctx: Ctx, pc: PathCollect, literals: List[Any]) -> Tuple[jnp.
     return mask, in_set
 
 
-def _list_exists(ctx: Ctx, pc: PathCollect) -> jnp.ndarray:
+def _list_exists(ctx: Ctx, pc: PathCollect, scope, prefix: Tuple[str, ...] = ()) -> jnp.ndarray:
     """Projection result is a list (vs null) when any root produces one."""
-    ex = jnp.zeros((ctx.N,), dtype=bool)
+    ex = jnp.zeros(_cond_shape(ctx, scope), dtype=bool)
     for segs, kind in pc.array_roots:
-        m = ctx.rows_at(segs)
+        m = ctx.rows_at(prefix + segs)
         if kind == "array":
-            ex = ex | (m & ctx.type_is(T_ARR)).any(axis=-1)
+            ex = ex | scope.any(m & ctx.type_is(T_ARR))
         else:  # mselect: any non-null input yields a literal list
-            ex = ex | (m & ~ctx.type_is(T_NULL)).any(axis=-1)
+            ex = ex | scope.any(m & ~ctx.type_is(T_NULL))
     return ex
 
 
-def _keys_errors(ctx: Ctx, pc: PathCollect) -> jnp.ndarray:
+def _keys_errors(
+    ctx: Ctx, states: List[PathState], scope, prefix: Tuple[str, ...] = ()
+) -> jnp.ndarray:
     """keys(@) on a non-object element is a JMESPath error -> rule ERROR."""
-    err = jnp.zeros((ctx.N,), dtype=bool)
-    for st in pc.keys_error_states:
-        m = ctx.rows_at(st.segs)
+    err = jnp.zeros(_cond_shape(ctx, scope), dtype=bool)
+    for st in states:
+        m = ctx.rows_at(prefix + st.segs)
         bad = ctx.type_is(T_BOOL) | ctx.type_is(T_NUM) | ctx.type_is(T_STR)
         if not st.no_arr:
             bad = bad | ctx.type_is(T_ARR)
         if not st.no_null:
             bad = bad | ctx.type_is(T_NULL)
-        err = err | (m & bad).any(axis=-1)
+        err = err | scope.any(m & bad)
     return err
 
 
-def _scalar_falsy(ctx: Ctx, mask: jnp.ndarray) -> jnp.ndarray:
+def _scalar_falsy(ctx: Ctx, mask: jnp.ndarray, scope) -> jnp.ndarray:
     """JMESPath falsy for a scalar path value: missing/null/''/false/
     empty map/empty list."""
-    exists = mask.any(axis=-1)
-    null = (mask & ctx.type_is(T_NULL)).any(axis=-1)
-    empty_str = (mask & ctx.type_is(T_STR) & ctx.heq("repr", hash_str("", tag="s"))).any(axis=-1)
-    false_b = (mask & ctx.type_is(T_BOOL) & (ctx.b["bool_val"] == 0)).any(axis=-1)
-    empty_cont = (
+    exists = scope.any(mask)
+    null = scope.any(mask & ctx.type_is(T_NULL))
+    empty_str = scope.any(mask & ctx.type_is(T_STR) & ctx.heq("repr", hash_str("", tag="s")))
+    false_b = scope.any(mask & ctx.type_is(T_BOOL) & (ctx.b["bool_val"] == 0))
+    empty_cont = scope.any(
         mask & (ctx.type_is(T_MAP) | ctx.type_is(T_ARR)) & (ctx.b["arr_len"] == 0)
-    ).any(axis=-1)
+    )
     return (~exists) | null | empty_str | false_b | empty_cont
 
 
@@ -650,17 +722,21 @@ def _scalar_membership_const(default: Any, literals: List[Any], mode: str) -> bo
     return _membership(default, literals, mode)
 
 
-def _eval_path_cond(ctx: Ctx, pc: PathCollect, op: str, value: Any) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    err = _keys_errors(ctx, pc)
+def _eval_path_cond(
+    ctx: Ctx, pc: PathCollect, op: str, value: Any, scope=None, prefix: Tuple[str, ...] = ()
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    scope = scope if scope is not None else Depth0()
+    shape = _cond_shape(ctx, scope)
+    err = _keys_errors(ctx, pc.keys_error_states, scope, prefix)
     if op in _IN_MODES:
         mode = _IN_MODES[op]
         literals = value if isinstance(value, list) else [value]
         if pc.is_projection:
-            mask, in_set = _collect_masks(ctx, pc, literals)
-            count = mask.sum(axis=-1)
-            present = _list_exists(ctx, pc)
-            any_in = (in_set).any(axis=-1)
-            any_not_in = (mask & ~in_set).any(axis=-1)
+            mask, in_set = _collect_masks(ctx, pc, literals, prefix)
+            count = scope.count(mask)
+            present = _list_exists(ctx, pc, scope, prefix)
+            any_in = scope.any(in_set)
+            any_not_in = scope.any(mask & ~in_set)
             res = {
                 "any_in": any_in,
                 "all_in": ~any_not_in,
@@ -675,16 +751,16 @@ def _eval_path_cond(ctx: Ctx, pc: PathCollect, op: str, value: Any) -> Tuple[jnp
             return res, err
         # scalar chain key
         st = pc.states[0]
-        mask = ctx.rows_at(st.segs)
+        mask = ctx.rows_at(prefix + st.segs)
         lits = [go_sprint(v) for v in (value if isinstance(value, list) else [value])]
         sset = [hash_str(l, tag="s") for l in lits if l is not None]
         in_set = ctx.hset("sprint", sset)
-        is_scalar = (mask & (ctx.type_is(T_STR) | ctx.type_is(T_NUM) | ctx.type_is(T_BOOL))).any(-1)
-        is_arr = (mask & ctx.type_is(T_ARR)).any(-1)
-        hit = (mask & in_set).any(-1)
-        em = ctx.rows_at(st.segs + (ARRAY_SEG,))
-        e_any_in = (em & in_set).any(-1)
-        e_any_not = (em & ~in_set).any(-1)
+        is_scalar = scope.any(mask & (ctx.type_is(T_STR) | ctx.type_is(T_NUM) | ctx.type_is(T_BOOL)))
+        is_arr = scope.any(mask & ctx.type_is(T_ARR))
+        hit = scope.any(mask & in_set)
+        em = ctx.rows_at(prefix + st.segs + (ARRAY_SEG,))
+        e_any_in = scope.any(em & in_set)
+        e_any_not = scope.any(em & ~in_set)
         res = {
             "any_in": jnp.where(is_arr, e_any_in, is_scalar & hit),
             "all_in": jnp.where(is_arr, ~e_any_not, is_scalar & hit),
@@ -692,7 +768,7 @@ def _eval_path_cond(ctx: Ctx, pc: PathCollect, op: str, value: Any) -> Tuple[jnp
             "all_not_in": jnp.where(is_arr, ~e_any_in, is_scalar & ~hit),
         }[mode]
         if pc.default is not None:
-            falsy = _scalar_falsy(ctx, mask)
+            falsy = _scalar_falsy(ctx, mask, scope)
             const = _scalar_membership_const(pc.default, value if isinstance(value, list) else [value], mode)
             res = jnp.where(falsy, const, res)
         return res, err
@@ -700,15 +776,15 @@ def _eval_path_cond(ctx: Ctx, pc: PathCollect, op: str, value: Any) -> Tuple[jnp
         if pc.is_projection:
             # list-vs-literal deep equality: lists never equal scalars;
             # only list literals could match — unsupported at compile
-            res = jnp.zeros((ctx.N,), dtype=bool)
+            res = jnp.zeros(shape, dtype=bool)
         else:
-            res = _eval_scalar_equals(ctx, pc, value)
+            res = _eval_scalar_equals(ctx, pc, value, scope, prefix)
         return (~res if op == "notequals" else res), err
     if op in _NUM_OPS:
         if pc.is_projection:
-            return jnp.zeros((ctx.N,), dtype=bool), err
-        return _eval_scalar_numeric(ctx, pc, _NUM_OPS[op], value), err
-    return jnp.zeros((ctx.N,), dtype=bool), err
+            return jnp.zeros(shape, dtype=bool), err
+        return _eval_scalar_numeric(ctx, pc, _NUM_OPS[op], value, scope, prefix), err
+    return jnp.zeros(shape, dtype=bool), err
 
 
 def _jcmp(kind: str, val, const: float, canon_eq) -> jnp.ndarray:
@@ -722,9 +798,13 @@ def _jcmp(kind: str, val, const: float, canon_eq) -> jnp.ndarray:
     return (val < c) & ~canon_eq
 
 
-def _eval_scalar_equals(ctx: Ctx, pc: PathCollect, v: Any) -> jnp.ndarray:
+def _eval_scalar_equals(
+    ctx: Ctx, pc: PathCollect, v: Any, scope=None, prefix: Tuple[str, ...] = ()
+) -> jnp.ndarray:
+    scope = scope if scope is not None else Depth0()
+    shape = _cond_shape(ctx, scope)
     st = pc.states[0]
-    mask = ctx.rows_at(st.segs)
+    mask = ctx.rows_at(prefix + st.segs)
     b = ctx.b
     t_str = mask & ctx.type_is(T_STR)
     t_num = mask & ctx.type_is(T_NUM)
@@ -733,12 +813,12 @@ def _eval_scalar_equals(ctx: Ctx, pc: PathCollect, v: Any) -> jnp.ndarray:
     key_d_valid = t_str & (b["has_dur"] == 1) & ~zero_repr
 
     if isinstance(v, bool):
-        return (t_bool & (b["bool_val"] == (1 if v else 0))).any(-1)
+        return scope.any(t_bool & (b["bool_val"] == (1 if v else 0)))
     if v is None:
-        return jnp.zeros((ctx.N,), dtype=bool)
+        return jnp.zeros(shape, dtype=bool)
     if isinstance(v, (int, float)):
-        num_eq = (t_num & ctx.heq("num", canon_number(v))).any(-1)
-        dur_eq = (key_d_valid & ctx.heq("dur", canon_duration(int(float(v) * 1e9)))).any(-1)
+        num_eq = scope.any(t_num & ctx.heq("num", canon_number(v)))
+        dur_eq = scope.any(key_d_valid & ctx.heq("dur", canon_duration(int(float(v) * 1e9))))
         return num_eq | dur_eq
     if isinstance(v, str):
         vd = parse_duration(v) if v != "0" else None
@@ -767,13 +847,17 @@ def _eval_scalar_equals(ctx: Ctx, pc: PathCollect, v: Any) -> jnp.ndarray:
             num_eq = t_num & ctx.heq("num", canon_number(float(vf)))
         else:
             num_eq = jnp.zeros_like(mask)
-        return ((t_str & str_eq) | num_eq).any(-1)
-    return jnp.zeros((ctx.N,), dtype=bool)
+        return scope.any((t_str & str_eq) | num_eq)
+    return jnp.zeros(shape, dtype=bool)
 
 
-def _eval_scalar_numeric(ctx: Ctx, pc: PathCollect, kind: str, v: Any) -> jnp.ndarray:
+def _eval_scalar_numeric(
+    ctx: Ctx, pc: PathCollect, kind: str, v: Any, scope=None, prefix: Tuple[str, ...] = ()
+) -> jnp.ndarray:
+    scope = scope if scope is not None else Depth0()
+    shape = _cond_shape(ctx, scope)
     st = pc.states[0]
-    mask = ctx.rows_at(st.segs)
+    mask = ctx.rows_at(prefix + st.segs)
     b = ctx.b
     t_str = mask & ctx.type_is(T_STR)
     t_num = mask & ctx.type_is(T_NUM)
@@ -782,7 +866,7 @@ def _eval_scalar_numeric(ctx: Ctx, pc: PathCollect, kind: str, v: Any) -> jnp.nd
     z = jnp.zeros_like(mask)
 
     if isinstance(v, bool) or v is None or isinstance(v, (list, dict)):
-        return jnp.zeros((ctx.N,), dtype=bool)
+        return jnp.zeros(shape, dtype=bool)
     if isinstance(v, (int, float)):
         num_cmp = t_num & _jcmp(kind, b["num_val"], float(v), ctx.heq("num", canon_number(v)))
         dur_cmp = key_d_valid & _jcmp(
@@ -790,7 +874,7 @@ def _eval_scalar_numeric(ctx: Ctx, pc: PathCollect, kind: str, v: Any) -> jnp.nd
         lane_num = t_str & (b["has_num"] == 1) & _jcmp(
             kind, b["num_val"], float(v), ctx.heq("num", canon_number(v)))
         str_cmp = jnp.where(key_d_valid, dur_cmp, lane_num)
-        return (num_cmp | (t_str & str_cmp)).any(-1)
+        return scope.any(num_cmp | (t_str & str_cmp))
     # v is str
     vd = parse_duration(v) if v != "0" else None
     vq = parse_quantity(v)
@@ -819,7 +903,7 @@ def _eval_scalar_numeric(ctx: Ctx, pc: PathCollect, kind: str, v: Any) -> jnp.nd
     dur_proc = key_d_valid if vd is not None else z
     qty_proc = (t_str & (b["has_qty"] == 1)) if vq is not None else z
     str_key = jnp.where(dur_proc, dur_b, jnp.where(qty_proc, qty_b, flt_b))
-    return (num_key | str_key).any(-1)
+    return scope.any(num_key | str_key)
 
 
 # ---------------------------------------------------------------------------
@@ -1018,9 +1102,103 @@ def eval_match(ctx: Ctx, match: MatchIR, exclude: MatchIR, policy_ns: str) -> jn
 # rule & policy-set assembly
 
 
+def _membership_glob_states(prog: RuleProgram) -> List[Tuple[Tuple[str, ...], PathState]]:
+    """All (prefix, state) value/key cells that membership operators
+    compare against literal sets. Resource strings containing */? at
+    those cells wildcard-match in BOTH directions (conditions.py
+    _wild_either) — hash equality cannot reproduce that, so such
+    resources take the host path."""
+    out: List[Tuple[Tuple[str, ...], PathState]] = []
+
+    def add(collect, prefixes) -> None:
+        for pfx in prefixes:
+            for st in collect.states:
+                out.append((pfx, st))
+                if not collect.is_projection and st.mode == "value":
+                    # scalar-chain keys also compare array elements
+                    out.append((pfx, PathState(st.segs + (ARRAY_SEG,), "value")))
+
+    def from_tree(tree: Optional[CondTreeIR], eprefixes) -> None:
+        if tree is None:
+            return
+        for any_list, all_list in tree.blocks:
+            for c in any_list + all_list:
+                if _op_canon(c.op) not in _IN_MODES:
+                    continue
+                for side in (c.key, c.value):
+                    if isinstance(side, ElementCollect):
+                        add(side, eprefixes)
+                    elif isinstance(side, PathCollect):
+                        add(side, ((),))
+
+    from_tree(prog.preconditions, ((),))
+    from_tree(prog.deny, ((),))
+    for fe in prog.foreach:
+        prefixes = tuple(arr + (ARRAY_SEG,) for arr in fe.arrays)
+        from_tree(fe.tree, prefixes)
+    return out
+
+
+def _glob_fallback(ctx: Ctx, prog: RuleProgram) -> jnp.ndarray:
+    acc = jnp.zeros((ctx.N,), dtype=bool)
+    for pfx, st in _membership_glob_states(prog):
+        if st.mode == "keys":
+            m = ctx.rows_with_parent(pfx + st.segs) & (ctx.b["key_glob"] == 1)
+        else:
+            m = ctx.rows_at(pfx + st.segs) & (ctx.b["has_glob"] == 1)
+        acc = acc | m.any(axis=-1)
+    return acc
+
+
+def _eval_foreach_deny(
+    ctx: Ctx, prog: RuleProgram
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """validate.foreach with deny bodies (validate_resource.go:163-233):
+    elements iterate in list order; the first denied element fails the
+    rule, a condition error errors it, zero applied (non-null) elements
+    skips it. Returns (cls, err, host) each (N,)."""
+    inst = InstScope(ctx)
+    decided = jnp.zeros((ctx.N,), dtype=bool)
+    failed = jnp.zeros((ctx.N,), dtype=bool)
+    errored = jnp.zeros((ctx.N,), dtype=bool)
+    applied = jnp.zeros((ctx.N,), dtype=bool)
+    host = jnp.zeros((ctx.N,), dtype=bool)
+    for fe in prog.foreach:
+        for arr in fe.arrays:
+            arr_rows = ctx.rows_at(arr)
+            elem_rows = ctx.rows_at(arr + (ARRAY_SEG,))
+            # scalar iterates dict lists as {key,value} pairs and errors
+            # on scalar lists; flatten would splice array elements — all
+            # shapes the row encoding can't see, so those go host
+            bad = arr_rows & ~ctx.type_is(T_ARR) & ~ctx.type_is(T_NULL)
+            bad = bad | (elem_rows & ctx.type_is(T_ARR))
+            host = host | bad.any(axis=-1)
+            nonnull_i = inst.any(elem_rows & ~ctx.type_is(T_NULL))  # (N, I)
+            den_i, err_i = eval_cond_tree(ctx, fe.tree, inst, arr + (ARRAY_SEG,))
+            den_i = den_i & nonnull_i
+            err_i = err_i & nonnull_i
+            # first-event ordering along the element axis: an error at
+            # element j <= i pre-empts a deny at i (evaluate_conditions
+            # raises before returning); a deny at j < i pre-empts an
+            # error at i (the loop returned already)
+            err_int = err_i.astype(jnp.int32)
+            den_int = den_i.astype(jnp.int32)
+            err_incl = jnp.cumsum(err_int, axis=-1) > 0
+            den_excl = (jnp.cumsum(den_int, axis=-1) - den_int) > 0
+            deny_event = (den_i & ~err_incl).any(axis=-1)
+            err_event = (err_i & ~den_excl).any(axis=-1)
+            failed = failed | (~decided & deny_event)
+            errored = errored | (~decided & err_event)
+            decided = decided | deny_event | err_event
+            applied = applied | nonnull_i.any(axis=-1)
+    cls = jnp.where(failed, FAIL, jnp.where(applied, PASS, SKIP))
+    return cls, errored, host
+
+
 def eval_rule(ctx: Ctx, prog: RuleProgram) -> jnp.ndarray:
     matched = eval_match(ctx, prog.match, prog.exclude, prog.policy_namespace)
     pre_ok, pre_err = eval_cond_tree(ctx, prog.preconditions)
+    host_extra = jnp.zeros((ctx.N,), dtype=bool)
     if prog.kind == "deny":
         denied, deny_err = eval_cond_tree(ctx, prog.deny)
         cls = jnp.where(denied, FAIL, PASS)
@@ -1028,6 +1206,8 @@ def eval_rule(ctx: Ctx, prog: RuleProgram) -> jnp.ndarray:
     elif prog.kind == "pattern":
         cls = eval_node(ctx, Depth0(), prog.patterns[0])
         err = jnp.zeros((ctx.N,), dtype=bool)
+    elif prog.kind == "foreach_deny":
+        cls, err, host_extra = _eval_foreach_deny(ctx, prog)
     else:  # any_pattern (validate_resource.go:382)
         classes = [eval_node(ctx, Depth0(), p) for p in prog.patterns]
         any_pass = functools.reduce(jnp.logical_or, [c == PASS for c in classes])
@@ -1039,6 +1219,7 @@ def eval_rule(ctx: Ctx, prog: RuleProgram) -> jnp.ndarray:
     verdict = jnp.where(pre_err, ERROR, jnp.where(pre_ok, verdict, SKIP))
     verdict = jnp.where(matched, verdict, NOT_MATCHED)
     fallback = (ctx.b["fallback"] == 1) | (ctx.b["meta_fallback"] == 1)
+    fallback = fallback | host_extra | _glob_fallback(ctx, prog)
     return jnp.where(fallback, HOST, verdict)
 
 

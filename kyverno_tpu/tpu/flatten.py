@@ -75,7 +75,7 @@ _LANES_F32 = ("num_val", "qty_val", "dur_val", "arr_len")
 _LANES_I32 = ("scope1", "scope2", "byte_slot")
 _LANES_U8 = (
     "type_tag", "bool_val", "has_repr", "has_qty", "has_dur", "has_num",
-    "str_goint", "str_gofloat", "has_glob",
+    "str_goint", "str_gofloat", "has_glob", "key_glob",
 )
 
 
@@ -172,6 +172,10 @@ class _ResourceEncoder:
         b.norm_hi[i, r], b.norm_lo[i, r] = split32(norm)
         b.parent_hi[i, r], b.parent_lo[i, r] = split32(parent)
         b.key_hi[i, r], b.key_lo[i, r] = split32(key)
+        # map keys containing glob metachars wildcard-match in membership
+        # operators (conditions _wild_either) — flag for host fallback
+        if segs and segs[-1] != ARRAY_SEG and ("*" in segs[-1] or "?" in segs[-1]):
+            b.key_glob[i, r] = 1
         b.scope1[i, r] = scope1
         b.scope2[i, r] = scope2
         b.valid[i, r] = 1

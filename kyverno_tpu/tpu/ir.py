@@ -470,6 +470,17 @@ class ConditionCompiler:
         all_list = [self.compile_condition(c) for c in (block.get("all") or [])]
         return any_list, all_list
 
+    @staticmethod
+    def _guard_literal_key_value(op: str, value: Any) -> None:
+        """LiteralKey + {{element}} value is only lowered for membership
+        operators over projection collects (the `key: ALL` shape);
+        equals/numeric against a dynamic list would need deep-equality."""
+        if isinstance(value, ElementCollect):
+            if op not in ("anyin", "allin", "anynotin", "allnotin"):
+                raise Unsupported("element value with non-membership operator")
+            if not value.is_projection:
+                raise Unsupported("non-projection element value")
+
     def compile_condition(self, cond: Dict[str, Any]) -> CondIR:
         op = str(cond.get("operator", "")).lower()
         if op not in _SUPPORTED_OPS:
@@ -478,6 +489,7 @@ class ConditionCompiler:
         key = cond.get("key")
         if not isinstance(key, str):
             if self.element_mode and isinstance(key, (int, float, bool)):
+                self._guard_literal_key_value(op, value)
                 return CondIR(LiteralKey(key), op, value)
             raise Unsupported("non-string condition key")
         m = _VAR_RE.match(key.strip())
@@ -485,6 +497,7 @@ class ConditionCompiler:
             if self.element_mode and "{{" not in key:
                 if contains_wildcard(key):
                     raise Unsupported("glob literal key")
+                self._guard_literal_key_value(op, value)
                 return CondIR(LiteralKey(key), op, value)
             # literal string key (no variable): constant-foldable, but
             # rare — keep host
@@ -509,6 +522,8 @@ class ConditionCompiler:
                     vf = None
                 if vd is None and vq is None and vf is None:
                     raise Unsupported("possible semver comparison value")
+        if isinstance(value, ElementCollect):
+            raise Unsupported("element value with non-literal key")
         return CondIR(key_ir, op, value)
 
     def _compile_value(self, value: Any) -> Any:
@@ -581,8 +596,8 @@ class ConditionCompiler:
             flat = ast[1]
             if flat[0] != "flatten":
                 raise Unsupported("non-flatten projection")
-            states, roots, _ = self._walk_element(flat[1])
-            estates, eroots = self._flatten(states)
+            states, roots, lhs_proj = self._walk_element(flat[1])
+            estates, eroots = self._flatten(states, lhs_proj)
             roots = roots + eroots
             out_states, out_roots, _ = self._apply_rhs(ast[2], estates, roots, True)
             return out_states, out_roots, True
@@ -614,8 +629,8 @@ class ConditionCompiler:
             flat = ast[1]
             if flat[0] != "flatten":
                 raise Unsupported("non-flatten projection")
-            states, roots, _ = self._walk_lhs(flat[1])
-            estates, eroots = self._flatten(states)
+            states, roots, lhs_proj = self._walk_lhs(flat[1])
+            estates, eroots = self._flatten(states, lhs_proj)
             roots = roots + eroots
             out_states, out_roots, _ = self._apply_rhs(ast[2], estates, roots, True)
             return out_states, out_roots, True
@@ -666,18 +681,24 @@ class ConditionCompiler:
             return [PathState(s.segs, "keys") for s in states], roots, proj
         raise Unsupported(f"jmespath construct {kind}")
 
-    def _flatten(self, states: List[PathState]):
+    def _flatten(self, states: List[PathState], proj: bool = False):
         """[] applied to the value(s): arrays are spliced one level,
-        non-array elements (maps, scalars, nulls) stay as elements."""
+        non-array elements (maps, scalars, nulls) stay as elements.
+        ``proj``: the input states are already a projection's per-element
+        values — flatten then operates on the projected LIST (each value
+        is an element; array values splice), not on the values as
+        arrays."""
         out: List[PathState] = []
         roots: List[Tuple[Tuple[str, ...], str]] = []
         for st in states:
             if st.mode == "keys":
                 out.append(st)  # already a flat string list
-            elif st.mode == "mselect":
-                # element is the sub-value itself; arrays splice
+            elif st.mode == "mselect" or proj:
+                # element is the sub-value itself; arrays splice — but a
+                # state already marked no_arr holds no arrays to splice
                 out.append(PathState(st.segs, "value", no_arr=True, no_null=True))
-                out.append(PathState(st.segs + (ARRAY_SEG,), "value", no_null=True))
+                if not st.no_arr:
+                    out.append(PathState(st.segs + (ARRAY_SEG,), "value", no_null=True))
             else:
                 out.append(PathState(st.segs + (ARRAY_SEG,), "value",
                                      no_arr=True, no_null=True))

@@ -28,7 +28,8 @@ def _parse_field(expr: str, lo: int, hi: int, name: str) -> Set[int]:
     for part in expr.split(","):
         part = part.strip()
         step = 1
-        if "/" in part:
+        has_step = "/" in part
+        if has_step:
             part, step_s = part.split("/", 1)
             try:
                 step = int(step_s)
@@ -36,6 +37,8 @@ def _parse_field(expr: str, lo: int, hi: int, name: str) -> Set[int]:
                 raise CronError(f"invalid step in {name}: {step_s!r}")
             if step <= 0:
                 raise CronError(f"invalid step in {name}: {step}")
+        # dow accepts 7 as Sunday (gronx/Vixie); normalize after stepping
+        field_hi = 7 if name == "dow" else hi
         if part in ("*", ""):
             rng = range(lo, hi + 1)
         elif "-" in part:
@@ -44,7 +47,7 @@ def _parse_field(expr: str, lo: int, hi: int, name: str) -> Set[int]:
                 a_i, b_i = int(a), int(b)
             except ValueError:
                 raise CronError(f"invalid range in {name}: {part!r}")
-            if not (lo <= a_i <= hi and lo <= b_i <= hi and a_i <= b_i):
+            if not (lo <= a_i <= field_hi and lo <= b_i <= field_hi and a_i <= b_i):
                 raise CronError(f"range out of bounds in {name}: {part!r}")
             rng = range(a_i, b_i + 1)
         else:
@@ -52,12 +55,14 @@ def _parse_field(expr: str, lo: int, hi: int, name: str) -> Set[int]:
                 v = int(part)
             except ValueError:
                 raise CronError(f"invalid value in {name}: {part!r}")
-            if name == "dow" and v == 7:
-                v = 0  # 7 == Sunday
-            if not (lo <= v <= hi):
+            if not (lo <= v <= field_hi):
                 raise CronError(f"value out of bounds in {name}: {v}")
-            rng = range(v, v + 1)
-        out.update(x for i, x in enumerate(rng) if i % step == 0)
+            # Vixie/gronx: "v/step" means range(v, hi+1, step), not {v}
+            rng = range(v, field_hi + 1) if has_step else range(v, v + 1)
+        vals = [x for i, x in enumerate(rng) if i % step == 0]
+        if name == "dow":
+            vals = [0 if x == 7 else x for x in vals]
+        out.update(vals)
     if not out:
         raise CronError(f"empty {name} field")
     return out

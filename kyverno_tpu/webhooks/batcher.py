@@ -43,11 +43,15 @@ class MicroBatcher:
         self._stopped = False
 
     def submit(self, payload: Any, timeout: float = 30.0) -> Any:
-        if self._stopped:
-            raise RuntimeError("batcher is stopped")
         p = _Pending(payload)
         flush_now = False
+        # _stopped is checked under the same lock that stop()'s final
+        # flush drains the queue under, so a submit racing stop() either
+        # fails fast here or is drained by that flush — never stranded
+        # until the wait timeout
         with self._lock:
+            if self._stopped:
+                raise RuntimeError("batcher is stopped")
             self._queue.append(p)
             if len(self._queue) >= self.max_batch:
                 flush_now = True
@@ -85,5 +89,6 @@ class MicroBatcher:
             p.event.set()
 
     def stop(self) -> None:
-        self._stopped = True
+        with self._lock:
+            self._stopped = True
         self._flush()

@@ -27,6 +27,26 @@ def test_cron_parsing_and_next():
         Cron("* * * *")
 
 
+def test_cron_value_step_and_dow_seven():
+    """Vixie/gronx semantics: 'v/step' runs from v to field max; dow 7
+    is Sunday, including inside ranges (ADVICE round-1 fix)."""
+    c = Cron("5/20 * * * *")
+    assert c.minute == {5, 25, 45}
+    # dow range ending at 7 wraps Sunday in
+    c2 = Cron("0 0 * * 5-7")
+    assert c2.dow == {5, 6, 0}
+    assert Cron("0 0 * * 7").dow == {0}
+    assert Cron("0 0 * * 0-7").dow == {0, 1, 2, 3, 4, 5, 6}
+    assert Cron("0 0 * * 3-7/3").dow == {3, 6}
+    assert Cron("0 0 * * 1-7/2").dow == {1, 3, 5, 0}
+    # steps over ranges unchanged
+    assert Cron("0 0 * * 1-5/2").dow == {1, 3, 5}
+    with pytest.raises(CronError):
+        Cron("0 0 * * 8")
+    with pytest.raises(CronError):
+        Cron("61/2 * * * *")
+
+
 def test_cleanup_policy_deletes_matching():
     snap = ClusterSnapshot()
     snap.upsert({"apiVersion": "v1", "kind": "Pod",

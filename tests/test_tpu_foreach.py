@@ -1,0 +1,229 @@
+"""Device foreach_deny evaluation + membership glob fallback parity.
+
+Covers the VERDICT round-1 regression (foreach rules compiled but not
+evaluable) and the ADVICE has_glob bypass (resource values containing
+*/? wildcard-match in membership operators on the scalar path; the
+device must route those resources to host instead of silently passing).
+"""
+
+import numpy as np
+
+from kyverno_tpu.policies import load_pss_policies
+from kyverno_tpu.policy.autogen import expand_policy
+from kyverno_tpu.tpu.compiler import compile_policy_set
+
+from test_tpu_parity import check_parity, make_policy, pod
+
+
+CAP_STRICT_FOREACH = {
+    "name": "require-drop-all",
+    "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+    "validate": {
+        "message": "Containers must drop ALL capabilities.",
+        "foreach": [
+            {
+                "list": "request.object.spec.[ephemeralContainers, initContainers, containers][]",
+                "deny": {
+                    "conditions": {
+                        "all": [
+                            {
+                                "key": "ALL",
+                                "operator": "AnyNotIn",
+                                "value": "{{ element.securityContext.capabilities.drop[] || `[]` }}",
+                            }
+                        ]
+                    }
+                },
+            }
+        ],
+    },
+}
+
+ADD_CAPS_FOREACH = {
+    "name": "adding-capabilities-strict",
+    "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+    "validate": {
+        "message": "Only NET_BIND_SERVICE may be added.",
+        "foreach": [
+            {
+                "list": "request.object.spec.[ephemeralContainers, initContainers, containers][]",
+                "deny": {
+                    "conditions": {
+                        "all": [
+                            {
+                                "key": "{{ element.securityContext.capabilities.add[] || `[]` }}",
+                                "operator": "AnyNotIn",
+                                "value": ["NET_BIND_SERVICE"],
+                            }
+                        ]
+                    }
+                },
+            }
+        ],
+    },
+}
+
+
+def ctr(name, drop=None, add=None, sc=False):
+    c = {"name": name, "image": "nginx"}
+    caps = {}
+    if drop is not None:
+        caps["drop"] = drop
+    if add is not None:
+        caps["add"] = add
+    if caps or sc:
+        c["securityContext"] = {"capabilities": caps} if caps else {}
+    return c
+
+
+def test_foreach_deny_compiles_to_device():
+    policies = [make_policy("cap-strict", [CAP_STRICT_FOREACH, ADD_CAPS_FOREACH])]
+    cps = compile_policy_set(policies)
+    assert cps.coverage() == (2, 2), [e.fallback_reason for e in cps.rules]
+
+
+def test_foreach_deny_parity():
+    policies = [make_policy("cap-strict", [CAP_STRICT_FOREACH, ADD_CAPS_FOREACH])]
+    resources = [
+        # compliant: drops ALL, adds nothing
+        pod("ok", spec={"containers": [ctr("a", drop=["ALL"])]}),
+        # violates require-drop-all: drops only NET_RAW
+        pod("bad-drop", spec={"containers": [ctr("a", drop=["NET_RAW"])]}),
+        # violates: no securityContext at all (default [] => denied)
+        pod("no-sc", spec={"containers": [ctr("a")]}),
+        # empty capabilities map => drop missing => denied
+        pod("empty-caps", spec={"containers": [ctr("a", sc=True)]}),
+        # adds an extra capability => second rule fails
+        pod("bad-add", spec={"containers": [ctr("a", drop=["ALL"], add=["SYS_ADMIN"])]}),
+        # allowed add
+        pod("ok-add", spec={"containers": [ctr("a", drop=["ALL"], add=["NET_BIND_SERVICE"])]}),
+        # multiselect across init + main containers; one bad initContainer
+        pod("init-bad", spec={
+            "containers": [ctr("a", drop=["ALL"])],
+            "initContainers": [ctr("i", drop=["CHOWN"])],
+        }),
+        # no containers at all: zero applied elements => skip
+        pod("empty", spec={}),
+        # non-Pod kind: not matched
+        pod("svc", kind="Service", spec={}),
+    ]
+    check_parity(policies, resources)
+
+
+def test_foreach_mixed_drop_lists_parity():
+    policies = [make_policy("cap-strict", [CAP_STRICT_FOREACH])]
+    resources = [
+        # ALL present among others
+        pod("multi", spec={"containers": [ctr("a", drop=["CHOWN", "ALL"])]}),
+        # case-sensitive: "all" is not "ALL"
+        pod("case", spec={"containers": [ctr("a", drop=["all"])]}),
+        # two containers, second bad
+        pod("two", spec={"containers": [ctr("a", drop=["ALL"]), ctr("b", drop=[])]}),
+    ]
+    check_parity(policies, resources)
+
+
+def test_pss_bundle_foreach_rules_on_device():
+    policies = [expand_policy(p) for p in load_pss_policies()]
+    cps = compile_policy_set(policies)
+    host = {e.policy_name for e in cps.rules if e.device_row is None}
+    assert "disallow-capabilities-strict" not in host
+
+
+GLOB_DENY_RULE = {
+    "name": "deny-secret-volumes",
+    "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+    "validate": {
+        "message": "secret volumes are denied",
+        "deny": {
+            "conditions": {
+                "any": [
+                    {
+                        "key": "{{ request.object.spec.volumes[].kind[] }}",
+                        "operator": "AnyIn",
+                        "value": ["secret"],
+                    }
+                ]
+            }
+        },
+    },
+}
+
+
+def test_membership_glob_resource_value_falls_back_to_host():
+    """ADVICE high: a resource value of '*' wildcard-matches any literal
+    in scalar membership (conditions _wild_either); the device cannot
+    reproduce that with hash equality and must yield the scalar verdict
+    via host fallback instead of silently passing."""
+    policies = [make_policy("glob-deny", [GLOB_DENY_RULE])]
+    resources = [
+        pod("wild", spec={"volumes": [{"kind": "*"}]}),      # scalar: denied
+        pod("plain", spec={"volumes": [{"kind": "secret"}]}),  # denied
+        pod("clean", spec={"volumes": [{"kind": "emptyDir"}]}),  # pass
+        pod("question", spec={"volumes": [{"kind": "secre?"}]}),  # scalar: denied
+    ]
+    check_parity(policies, resources)
+
+
+def test_double_flatten_nested_arrays_parity():
+    """a[][] flattens the projected list: depth-1 arrays splice, their
+    already-spliced children do not re-splice (code-review regression)."""
+    rule = {
+        "name": "nested",
+        "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+        "preconditions": {
+            "all": [
+                {
+                    "key": "{{ request.object.spec.a[][] }}",
+                    "operator": "AllIn",
+                    "value": [1, 2],
+                }
+            ]
+        },
+        "validate": {
+            "message": "x",
+            "deny": {"conditions": {"any": []}},
+        },
+    }
+    policies = [make_policy("flat2", [rule])]
+    resources = [
+        pod("deep", spec={"a": [[[1, 2]]]}),     # a[][] -> [1,2] (list stays)
+        pod("mixed", spec={"a": [[1], 2, [[3]]]}),  # -> [1, 2, 3]
+        pod("scalar", spec={"a": [5]}),          # -> [5]
+        pod("none", spec={}),
+    ]
+    check_parity(policies, resources)
+
+
+def test_scalar_chain_glob_value_falls_back():
+    policies = [
+        make_policy(
+            "glob-eq",
+            [
+                {
+                    "name": "deny-host",
+                    "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+                    "validate": {
+                        "message": "x",
+                        "deny": {
+                            "conditions": {
+                                "any": [
+                                    {
+                                        "key": "{{ request.object.spec.nodeName }}",
+                                        "operator": "AnyIn",
+                                        "value": ["master"],
+                                    }
+                                ]
+                            }
+                        },
+                    },
+                }
+            ],
+        )
+    ]
+    resources = [
+        pod("wild", spec={"nodeName": "*"}),
+        pod("hit", spec={"nodeName": "master"}),
+        pod("miss", spec={"nodeName": "worker"}),
+    ]
+    check_parity(policies, resources)
