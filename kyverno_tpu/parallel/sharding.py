@@ -93,7 +93,8 @@ class ShardedScanner:
         padded = self.pad(max(n, 1))
         res = list(resources) + [{} for _ in range(padded - n)]
         ops = (list(operations) + [""] * (padded - n)) if operations else None
-        rows = encode_resources(res, self.cps.encode_cfg, self.cps.byte_paths)
+        rows = encode_resources(res, self.cps.encode_cfg, self.cps.byte_paths,
+                                self.cps.key_byte_paths)
         meta = encode_metadata(res, namespace_labels, ops)
         return batch_to_device(rows, meta), n
 

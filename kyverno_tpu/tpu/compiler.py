@@ -40,6 +40,7 @@ class CompiledPolicySet:
     rules: List[RuleEntry]
     device_programs: List[RuleProgram]
     byte_paths: Set[int]
+    key_byte_paths: Set[int]
     encode_cfg: EncodeConfig
     meta_cfg: MetaConfig
     _fn: Optional[Callable] = field(default=None, repr=False)
@@ -72,6 +73,7 @@ def compile_policy_set(
     entries: List[RuleEntry] = []
     programs: List[RuleProgram] = []
     byte_paths: Set[int] = set()
+    key_byte_paths: Set[int] = set()
     for pi, policy in enumerate(policies):
         for rule in policy.get_rules():
             if not rule.has_validate():
@@ -81,6 +83,7 @@ def compile_policy_set(
                 row = len(programs)
                 programs.append(prog)
                 byte_paths |= prog.byte_paths
+                key_byte_paths |= prog.key_byte_paths
                 entries.append(RuleEntry(pi, policy.name, rule.name, row, None))
             except Unsupported as e:
                 entries.append(RuleEntry(pi, policy.name, rule.name, None, str(e)))
@@ -89,6 +92,7 @@ def compile_policy_set(
         rules=entries,
         device_programs=programs,
         byte_paths=byte_paths,
+        key_byte_paths=key_byte_paths,
         encode_cfg=encode_cfg,
         meta_cfg=meta_cfg,
     )

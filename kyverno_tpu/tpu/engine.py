@@ -122,7 +122,8 @@ class TpuEngine:
         operations: Optional[Sequence[str]] = None,
         admission_infos: Optional[Sequence[Optional[RequestInfo]]] = None,
     ):
-        rows = encode_resources(resources, self.cps.encode_cfg, self.cps.byte_paths)
+        rows = encode_resources(resources, self.cps.encode_cfg, self.cps.byte_paths,
+                                self.cps.key_byte_paths)
         meta = encode_metadata(resources, namespace_labels, operations,
                                admission_infos, self.cps.meta_cfg)
         return batch_to_device(rows, meta), rows, meta

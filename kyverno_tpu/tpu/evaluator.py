@@ -102,7 +102,11 @@ class Ctx:
         self._row_masks: Dict[Tuple[int, str], jnp.ndarray] = {}
         self._glob_cache: Dict[Tuple[str, str], jnp.ndarray] = {}
         self._oh: Optional[jnp.ndarray] = None
+        self._oh2: Optional[jnp.ndarray] = None
         self._valid = batch["valid"].astype(bool)
+        # per-rule host-fallback masks appended during trace (nested
+        # instance-join overflow); eval_rule drains them
+        self.host_acc: List[jnp.ndarray] = []
 
     # -- row masks
 
@@ -149,6 +153,15 @@ class Ctx:
             self._oh = (oh & self._valid[:, :, None]).astype(jnp.float32)
         return self._oh
 
+    @property
+    def onehot2(self) -> jnp.ndarray:
+        """(N, R, I) f32 one-hot of scope2 (second-level array index)."""
+        if self._oh2 is None:
+            s2 = self.b["scope2"]
+            oh = (s2[:, :, None] == jnp.arange(self.I, dtype=np.int32)[None, None, :])
+            self._oh2 = (oh & self._valid[:, :, None]).astype(jnp.float32)
+        return self._oh2
+
     # -- glob NFA over pool bytes; returns (N, K) accepts per pool slot
 
     def glob_pool(self, pattern: str) -> jnp.ndarray:
@@ -166,14 +179,18 @@ class Ctx:
             )
         return self._glob_cache[key]
 
-    def glob_rows(self, pattern: str) -> jnp.ndarray:
+    def glob_rows(self, pattern: str, lane: str = "byte_slot") -> jnp.ndarray:
         """(N, R) glob accept per row via its byte-pool slot (False when
         the row has no slot)."""
         acc = self.glob_pool(pattern)  # (N, K)
-        slot = self.b["byte_slot"]
+        slot = self.b[lane]
         safe = jnp.clip(slot, 0, acc.shape[1] - 1)
         got = jnp.take_along_axis(acc, safe.reshape(self.N, -1), axis=1).reshape(slot.shape)
         return got & (slot >= 0)
+
+    def glob_key_rows(self, pattern: str) -> jnp.ndarray:
+        """(N, R) glob accept of each row's map KEY bytes."""
+        return self.glob_rows(pattern, "key_byte_slot")
 
 
 def glob_match(pattern: str, bytes_: jnp.ndarray, lens: jnp.ndarray) -> jnp.ndarray:
@@ -246,6 +263,24 @@ class InstScope:
 
     def count(self, rowpred: jnp.ndarray) -> jnp.ndarray:
         return jnp.einsum("nr,nri->ni", rowpred.astype(jnp.float32), self.ctx.onehot)
+
+
+class Inst2Scope:
+    """Second-level instance scope: joins rows by (scope1, scope2) pairs
+    for nested arrays-of-maps (containers[].ports[]); reductions land in
+    (N, I, J). The double one-hot contraction is a batched matmul."""
+
+    def __init__(self, ctx: Ctx):
+        self.ctx = ctx
+
+    def any(self, rowpred: jnp.ndarray) -> jnp.ndarray:
+        return self.count(rowpred) > 0.5
+
+    def count(self, rowpred: jnp.ndarray) -> jnp.ndarray:
+        return jnp.einsum(
+            "nr,nri,nrj->nij",
+            rowpred.astype(jnp.float32), self.ctx.onehot, self.ctx.onehot2,
+        )
 
 
 # ---------------------------------------------------------------------------
@@ -400,6 +435,32 @@ def _eval_leaf(ctx: Ctx, scope, node: LeafNode) -> jnp.ndarray:
     return jnp.where(exists, cls, jnp.full_like(cls, missing))
 
 
+def _eval_wildcard_anchor(ctx: Ctx, wc, kind: str, literal_cls: jnp.ndarray) -> jnp.ndarray:
+    """ExpandInMetadata select (wildcards.go:62, via engine/wildcards.py):
+    when the labels/annotations map exists with all-string values and a
+    resource key matches the glob, the anchor applies to the FIRST
+    matching key's value (oracle dict order = row order); otherwise the
+    literal glob-key behavior stands. Depth-0 only (compile-enforced)."""
+    P = wc.map_path
+    map_rows = ctx.rows_at(P)
+    children = ctx.rows_with_parent(P)
+    is_map = (map_rows & ctx.type_is(T_MAP)).any(axis=-1)
+    nonstring = (children & ~ctx.type_is(T_STR)).any(axis=-1)
+    accept = children & ctx.glob_key_rows(wc.glob)
+    has = accept.any(axis=-1)
+    idx = jnp.argmax(accept, axis=-1)  # first matching row
+    pred = leaf_row_pred(ctx, wc.leaf)
+    val_ok = jnp.take_along_axis(pred, idx[:, None], axis=-1)[:, 0]
+    if kind == "condition":
+        m_cls = jnp.where(val_ok, PASS, SKIP)
+    elif kind == "negation":  # expanded key exists -> negation fails
+        m_cls = jnp.full(val_ok.shape, FAIL, dtype=jnp.int32)
+    else:  # equality / plain: key exists, value must match the leaf
+        m_cls = jnp.where(val_ok, PASS, FAIL)
+    use = is_map & ~nonstring & has
+    return jnp.where(use, m_cls, literal_cls)
+
+
 def _eval_map(ctx: Ctx, scope, node: MapNode) -> jnp.ndarray:
     mask = ctx.rows_at(node.path)
     exists = scope.any(mask)
@@ -419,6 +480,8 @@ def _eval_map(ctx: Ctx, scope, node: MapNode) -> jnp.ndarray:
             cls = jnp.where(cexists, ch, PASS)
         else:  # existence
             cls = _eval_existence(ctx, scope, a.child, cexists)
+        if a.wildcard is not None:
+            cls = _eval_wildcard_anchor(ctx, a.wildcard, a.kind, cls)
         anchor_cls.append(cls)
 
     shape = exists.shape
@@ -443,6 +506,8 @@ def _eval_map(ctx: Ctx, scope, node: MapNode) -> jnp.ndarray:
             cls = jnp.where(cexists, jnp.where(ch == PASS, PASS, SKIP), PASS)
         else:
             cls = eval_node(ctx, scope, c.child)
+        if c.wildcard is not None:
+            cls = _eval_wildcard_anchor(ctx, c.wildcard, "plain", cls)
         p2_cls.append(cls)
 
     phase2 = _first_nonpass(p2_cls, shape)
@@ -466,14 +531,28 @@ def _eval_existence(ctx: Ctx, scope, node: ExistenceNode, cexists: jnp.ndarray) 
 
 
 def _eval_array_maps(ctx: Ctx, scope, node: ArrayMapsNode) -> jnp.ndarray:
-    if not isinstance(scope, Depth0):
-        raise Unsupported("array-of-maps in array scope")
-    mask = ctx.rows_at(node.path)
-    exists = mask.any(axis=-1)
-    is_arr = (mask & ctx.type_is(T_ARR)).any(axis=-1)
-    inst = InstScope(ctx)
-    valid_i = inst.any(ctx.rows_at(node.path + (ARRAY_SEG,)))
-    elem = eval_node(ctx, inst, node.element)  # (N, I)
+    if isinstance(scope, Depth0):
+        mask = ctx.rows_at(node.path)
+        exists = mask.any(axis=-1)
+        is_arr = (mask & ctx.type_is(T_ARR)).any(axis=-1)
+        inst = InstScope(ctx)
+        valid_i = inst.any(ctx.rows_at(node.path + (ARRAY_SEG,)))
+        elem = eval_node(ctx, inst, node.element)  # (N, I)
+    elif isinstance(scope, InstScope):
+        # nested array-of-maps (containers[].ports[]): join elements by
+        # (scope1, scope2); classes land in (N, I, J), reduced over J
+        mask = ctx.rows_at(node.path)
+        exists = scope.any(mask)
+        is_arr = scope.any(mask & ctx.type_is(T_ARR))
+        inst2 = Inst2Scope(ctx)
+        valid_i = inst2.any(ctx.rows_at(node.path + (ARRAY_SEG,)))  # (N, I, J)
+        elem = eval_node(ctx, inst2, node.element)
+        # second-level joins cap at max_instances; overflowing arrays
+        # route the resource to host for this rule
+        over = (mask & (ctx.b["s2_overflow"] == 1)).any(axis=-1)
+        ctx.host_acc.append(over)
+    else:
+        raise Unsupported("array-of-maps nested beyond two levels")
     any_fail = (valid_i & (elem == FAIL)).any(axis=-1)
     any_pass = (valid_i & (elem == PASS)).any(axis=-1)
     nonempty = valid_i.any(axis=-1)
@@ -1196,6 +1275,7 @@ def _eval_foreach_deny(
 
 
 def eval_rule(ctx: Ctx, prog: RuleProgram) -> jnp.ndarray:
+    ctx.host_acc = []
     matched = eval_match(ctx, prog.match, prog.exclude, prog.policy_namespace)
     pre_ok, pre_err = eval_cond_tree(ctx, prog.preconditions)
     host_extra = jnp.zeros((ctx.N,), dtype=bool)
@@ -1220,6 +1300,8 @@ def eval_rule(ctx: Ctx, prog: RuleProgram) -> jnp.ndarray:
     verdict = jnp.where(matched, verdict, NOT_MATCHED)
     fallback = (ctx.b["fallback"] == 1) | (ctx.b["meta_fallback"] == 1)
     fallback = fallback | host_extra | _glob_fallback(ctx, prog)
+    for h in ctx.host_acc:
+        fallback = fallback | h
     return jnp.where(fallback, HOST, verdict)
 
 

@@ -57,7 +57,7 @@ class EncodeConfig:
         self,
         max_rows: int = 256,
         max_instances: int = 16,
-        byte_pool_slots: int = 16,
+        byte_pool_slots: int = 32,
         byte_pool_width: int = 96,
     ):
         self.max_rows = max_rows
@@ -72,10 +72,10 @@ _LANES_U32 = (
     "num_hi", "num_lo", "sprint_hi", "sprint_lo",
 )
 _LANES_F32 = ("num_val", "qty_val", "dur_val", "arr_len")
-_LANES_I32 = ("scope1", "scope2", "byte_slot")
+_LANES_I32 = ("scope1", "scope2", "byte_slot", "key_byte_slot")
 _LANES_U8 = (
     "type_tag", "bool_val", "has_repr", "has_qty", "has_dur", "has_num",
-    "str_goint", "str_gofloat", "has_glob", "key_glob",
+    "str_goint", "str_gofloat", "has_glob", "key_glob", "s2_overflow",
 )
 
 
@@ -151,10 +151,14 @@ def _number_string(value: Any) -> Optional[str]:
 
 
 class _ResourceEncoder:
-    def __init__(self, batch: RowBatch, res_idx: int, byte_paths: Set[int]):
+    def __init__(self, batch: RowBatch, res_idx: int, byte_paths: Set[int],
+                 key_byte_paths: Set[int]):
         self.b = batch
         self.i = res_idx
         self.byte_paths = byte_paths
+        # map paths whose CHILD KEYS the policy set glob-matches
+        # (wildcard metadata keys, wildcards.go:62 ExpandInMetadata)
+        self.key_byte_paths = key_byte_paths
         self.row = 0
         self.pool_used = 0
         self.ok = True
@@ -242,7 +246,7 @@ class _ResourceEncoder:
                 b.dur_val[i, r] = np.float32(d / 1e9)
                 b.dur_hi[i, r], b.dur_lo[i, r] = split32(canon_duration(d))
 
-    def _assign_pool(self, r: int, s: str) -> None:
+    def _assign_pool(self, r: int, s: str, lane: str = "byte_slot") -> None:
         b, i = self.b, self.i
         data = s.encode("utf-8")
         if len(data) > b.cfg.byte_pool_width or self.pool_used >= b.cfg.byte_pool_slots:
@@ -252,7 +256,7 @@ class _ResourceEncoder:
         self.pool_used += 1
         b.pool[i, slot, : len(data)] = np.frombuffer(data, dtype=np.uint8)
         b.pool_len[i, slot] = len(data)
-        b.byte_slot[i, r] = slot
+        getattr(b, lane)[i, r] = slot
 
     def walk(self, node: Any, segs: Tuple[str, ...], scope1: int, scope2: int, depth: int) -> None:
         r = self._emit(segs, scope1, scope2)
@@ -262,8 +266,15 @@ class _ResourceEncoder:
         if isinstance(node, dict):
             b.type_tag[i, r] = T_MAP
             b.arr_len[i, r] = len(node)
+            pool_keys = hash_path(segs) in self.key_byte_paths
             for k, v in node.items():
-                self.walk(v, segs + (str(k),), scope1, scope2, depth)
+                child = self.walk(v, segs + (str(k),), scope1, scope2, depth)
+                if pool_keys and child is not None and child >= 0:
+                    self._assign_pool(child, str(k), "key_byte_slot")
+                    # wildcard-matched keys' VALUES glob-compare against
+                    # policy operands (e.g. "localhost/*"); pool them too
+                    if isinstance(v, str) and b.byte_slot[i, child] < 0:
+                        self._assign_pool(child, v)
         elif isinstance(node, list):
             b.type_tag[i, r] = T_ARR
             b.arr_len[i, r] = len(node)
@@ -272,6 +283,12 @@ class _ResourceEncoder:
                 # so only flag when the policy set does instance joins —
                 # handled conservatively: flag always (cheap, rare)
                 self.ok = False
+            if len(node) > b.cfg.max_instances and depth == 1:
+                # second-level instance joins (nested array-of-maps
+                # patterns) cap out; depth-1 arrays are common (env,
+                # ports) so flag the ROW, and only rules that join at
+                # this path fall back (evaluator _eval_array_maps)
+                b.s2_overflow[i, r] = 1
             for idx, v in enumerate(node):
                 s1, s2 = scope1, scope2
                 if depth == 0:
@@ -281,23 +298,28 @@ class _ResourceEncoder:
                 self.walk(v, segs + (ARRAY_SEG,), s1, s2, depth + 1)
         else:
             self._fill_scalar(r, hash_path(segs), node)
+        return r
 
 
 def encode_resources(
     resources: Sequence[Dict[str, Any]],
     cfg: Optional[EncodeConfig] = None,
     byte_paths: Optional[Iterable[int]] = None,
+    key_byte_paths: Optional[Iterable[int]] = None,
 ) -> RowBatch:
     """Encode a list of resource dicts into a padded RowBatch.
 
     ``byte_paths``: normalized-path hashes whose string values must be
     available as raw bytes (compiled policy set's glob operand paths).
+    ``key_byte_paths``: map-path hashes whose child KEYS must be
+    available as raw bytes (wildcard metadata pattern keys).
     """
     cfg = cfg or EncodeConfig()
     bp = set(byte_paths or ())
+    kbp = set(key_byte_paths or ())
     batch = RowBatch(len(resources), cfg)
     for i, res in enumerate(resources):
-        enc = _ResourceEncoder(batch, i, bp)
+        enc = _ResourceEncoder(batch, i, bp, kbp)
         enc.walk(res, (), -1, -1, 0)
         batch.n_rows[i] = enc.row
         batch.fallback[i] = 0 if enc.ok else 1

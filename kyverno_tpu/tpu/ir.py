@@ -165,11 +165,25 @@ class MapEmptyNode(Node):
 
 
 @dataclass
+class WildcardKeyInfo:
+    """A wildcard key inside a metadata labels/annotations pattern map
+    (wildcards.go:62 ExpandInMetadata): at evaluation time the glob key
+    expands to the FIRST resource key matching it (map insertion order —
+    the scalar oracle's dict order); if the resource map is absent, has
+    non-string values, or nothing matches, the key stays literal."""
+
+    glob: str                     # the glob key (modifier stripped)
+    map_path: Tuple[str, ...]     # the annotations/labels map path
+    leaf: Any                     # compiled string leaf of the value
+
+
+@dataclass
 class AnchorChild:
     kind: str          # condition | equality | negation | existence
     key: str
     raw_key: str       # with modifier, phase-1 iterates sorted raw keys
     child: Optional["Node"]
+    wildcard: Optional[WildcardKeyInfo] = None
 
 
 @dataclass
@@ -178,6 +192,7 @@ class Phase2Child:
     is_global: bool
     is_star: bool      # pattern literal "*" under a plain key
     child: Optional["Node"]
+    wildcard: Optional[WildcardKeyInfo] = None
 
 
 @dataclass
@@ -210,6 +225,8 @@ _GLOBBY_KEY = re.compile(r"[*?]")
 class PatternCompiler:
     def __init__(self) -> None:
         self.byte_paths: Set[int] = set()
+        self.key_byte_paths: Set[int] = set()
+        self._arr_depth = 0
 
     def compile(self, pattern: Any) -> Node:
         if not isinstance(pattern, dict):
@@ -260,6 +277,17 @@ class PatternCompiler:
         phase2: List[Phase2Child] = []
         anchor_keys: Dict[str, Any] = {}
         resource_keys: Dict[str, Any] = {}
+        # ExpandInMetadata (wildcards.go:62) rewrites wildcard keys of
+        # metadata labels/annotations string maps against the resource's
+        # keys; everywhere else pattern keys are literal strings
+        in_meta_map = (
+            len(path) >= 2
+            and path[-2] == "metadata"
+            and path[-1] in ("annotations", "labels")
+            and all(isinstance(v, str) for v in pattern.values())
+        )
+        expandable = scope is None and in_meta_map
+        wildcards: Dict[str, WildcardKeyInfo] = {}
         for key, value in pattern.items():
             key = str(key)
             a = anchorpkg.parse(key)
@@ -270,7 +298,18 @@ class PatternCompiler:
                 resource_keys[key] = (a, value)
             inner = a.key if a is not None else key
             if _GLOBBY_KEY.search(inner):
-                raise Unsupported("wildcard pattern key (ExpandInMetadata)")
+                if in_meta_map and scope is not None:
+                    # the reference expands per array element; the row
+                    # encoding cannot express that join -> host
+                    raise Unsupported("wildcard metadata key in array scope")
+                if not expandable:
+                    continue  # literal key outside expandable metadata maps
+                if anchorpkg.is_existence(a) or anchorpkg.is_global(a):
+                    raise Unsupported("wildcard key under existence/global anchor")
+                if wildcards:
+                    raise Unsupported("multiple wildcard pattern keys in one map")
+                wildcards[key] = WildcardKeyInfo(inner, path, compile_leaf(value))
+                self.key_byte_paths.add(hash_path(path))
 
         for raw_key in sorted(anchor_keys.keys()):
             a, value = anchor_keys[raw_key]
@@ -287,7 +326,8 @@ class PatternCompiler:
                 child = self._existence(value, path + (a.key,), scope)
             else:
                 child = self._element(value, path + (a.key,), scope)
-            anchors.append(AnchorChild(kind, a.key, raw_key, child))
+            anchors.append(AnchorChild(kind, a.key, raw_key, child,
+                                       wildcards.get(raw_key)))
 
         # phase-2 order: getSortedNestedAnchorResource — stable sorted
         # keys, then keys that are global anchors or contain nested
@@ -306,7 +346,8 @@ class PatternCompiler:
             inner = a.key if is_global else k
             is_star = value == "*"
             child = self._element(value, path + (inner,), scope)
-            phase2.append(Phase2Child(inner, is_global, is_star, child))
+            phase2.append(Phase2Child(inner, is_global, is_star, child,
+                                      wildcards.get(k)))
         return MapNode(path, scope, anchors, phase2)
 
     @staticmethod
@@ -321,9 +362,13 @@ class PatternCompiler:
             raise Unsupported("empty pattern array")  # constant FAIL; rare
         first = pattern[0]
         if isinstance(first, dict):
-            if scope is not None:
-                raise Unsupported("array-of-maps nested beyond one level")
-            element = self._map(first, path + (ARRAY_SEG,), path)
+            if self._arr_depth >= 2:
+                raise Unsupported("array-of-maps nested beyond two levels")
+            self._arr_depth += 1
+            try:
+                element = self._map(first, path + (ARRAY_SEG,), path)
+            finally:
+                self._arr_depth -= 1
             return ArrayMapsNode(path, scope, element)
         if isinstance(first, list):
             raise Unsupported("positional array-of-arrays pattern")
@@ -338,6 +383,16 @@ class PatternCompiler:
         if not isinstance(value, list):
             # non-list pattern under ^() is a constant error (handlers.go:243)
             raise Unsupported("existence anchor with non-list pattern")
+        # element patterns evaluate per-instance (InstScope): they consume
+        # the first instance level, so arrays inside may nest once more
+        self._arr_depth += 1
+        try:
+            return self._existence_elements(value, path, scope)
+        finally:
+            self._arr_depth -= 1
+
+    def _existence_elements(self, value: List[Any], path: Tuple[str, ...],
+                            scope: Optional[Tuple[str, ...]]) -> ExistenceNode:
         elements: List[Node] = []
         for pm in value:
             if not isinstance(pm, dict):
@@ -897,6 +952,7 @@ class RuleProgram:
     deny: Optional[CondTreeIR] = None
     foreach: List[ForeachDeny] = field(default_factory=list)
     byte_paths: Set[int] = field(default_factory=set)
+    key_byte_paths: Set[int] = field(default_factory=set)
     message: str = ""
     # set when this rule cannot run on device
     fallback_reason: Optional[str] = None
@@ -932,12 +988,14 @@ def compile_rule(policy: ClusterPolicy, rule: Rule) -> RuleProgram:
         prog.kind = "pattern"
         prog.patterns = [pc.compile(v.pattern)]
         prog.byte_paths = pc.byte_paths
+        prog.key_byte_paths = pc.key_byte_paths
         return prog
     if v.any_pattern is not None:
         pc = PatternCompiler()
         prog.kind = "any_pattern"
         prog.patterns = [pc.compile(p) for p in v.any_pattern]
         prog.byte_paths = pc.byte_paths
+        prog.key_byte_paths = pc.key_byte_paths
         return prog
     if v.foreach is not None:
         prog.kind = "foreach_deny"

@@ -1,0 +1,211 @@
+"""Device parity for depth-2 array-of-maps patterns and wildcard
+metadata keys (the last two PSS host-fallback classes, VERDICT #2)."""
+
+from kyverno_tpu.policies import load_pss_policies
+from kyverno_tpu.policy.autogen import expand_policy
+from kyverno_tpu.tpu.compiler import compile_policy_set
+
+from test_tpu_parity import check_parity, make_policy, pod
+
+
+HOST_PORTS_RULE = {
+    "name": "host-ports",
+    "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+    "validate": {
+        "message": "host ports are disallowed",
+        "pattern": {
+            "spec": {
+                "=(ephemeralContainers)": [{"=(ports)": [{"=(hostPort)": 0}]}],
+                "=(initContainers)": [{"=(ports)": [{"=(hostPort)": 0}]}],
+                "containers": [{"=(ports)": [{"=(hostPort)": 0}]}],
+            }
+        },
+    },
+}
+
+APPARMOR_RULE = {
+    "name": "app-armor",
+    "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+    "validate": {
+        "message": "apparmor profiles restricted",
+        "pattern": {
+            "=(metadata)": {
+                "=(annotations)": {
+                    "=(container.apparmor.security.beta.kubernetes.io/*)":
+                        "runtime/default | localhost/*",
+                }
+            }
+        },
+    },
+}
+
+
+def ctr(name, ports=None):
+    c = {"name": name, "image": "nginx"}
+    if ports is not None:
+        c["ports"] = ports
+    return c
+
+
+def test_pss_full_device_coverage():
+    """VERDICT #2 done-criterion: every bundled PSS rule on device."""
+    policies = [expand_policy(p) for p in load_pss_policies()]
+    cps = compile_policy_set(policies)
+    assert cps.coverage() == (66, 66), [
+        (e.policy_name, e.rule_name, e.fallback_reason)
+        for e in cps.rules if e.device_row is None
+    ]
+
+
+def test_nested_array_of_maps_parity():
+    policies = [make_policy("host-ports", [HOST_PORTS_RULE])]
+    resources = [
+        # no ports at all
+        pod("none", spec={"containers": [ctr("a")]}),
+        # containerPort only (hostPort absent => equality anchor passes)
+        pod("cport", spec={"containers": [ctr("a", [{"containerPort": 80}])]}),
+        # hostPort 0 is allowed
+        pod("zero", spec={"containers": [ctr("a", [{"containerPort": 80, "hostPort": 0}])]}),
+        # hostPort violation
+        pod("bad", spec={"containers": [ctr("a", [{"containerPort": 80, "hostPort": 8080}])]}),
+        # violation in second port of second container
+        pod("deep", spec={"containers": [
+            ctr("a", [{"containerPort": 80}]),
+            ctr("b", [{"containerPort": 81}, {"hostPort": 9090}]),
+        ]}),
+        # initContainers violation while main containers clean
+        pod("init", spec={
+            "containers": [ctr("a")],
+            "initContainers": [ctr("i", [{"hostPort": 1}])],
+        }),
+        # empty ports array
+        pod("empty-ports", spec={"containers": [ctr("a", [])]}),
+        # ports not an array (schema violation -> pattern fail both paths)
+        pod("scalar-ports", spec={"containers": [{"name": "a", "ports": "x"}]}),
+    ]
+    check_parity(policies, resources)
+
+
+def _apod(name, annotations=None, labels=None):
+    meta = {"name": name, "namespace": "default"}
+    if annotations is not None:
+        meta["annotations"] = annotations
+    if labels is not None:
+        meta["labels"] = labels
+    return {"apiVersion": "v1", "kind": "Pod", "metadata": meta,
+            "spec": {"containers": [{"name": "a", "image": "nginx"}]}}
+
+
+def test_wildcard_metadata_key_parity():
+    policies = [make_policy("apparmor", [APPARMOR_RULE])]
+    resources = [
+        # no annotations at all
+        _apod("plain"),
+        # unrelated annotation
+        _apod("other", {"foo": "bar"}),
+        # matching key, allowed value
+        _apod("ok", {"container.apparmor.security.beta.kubernetes.io/app": "runtime/default"}),
+        # matching key, localhost glob value
+        _apod("lh", {"container.apparmor.security.beta.kubernetes.io/app": "localhost/prof-1"}),
+        # matching key, denied value
+        _apod("bad", {"container.apparmor.security.beta.kubernetes.io/app": "unconfined"}),
+        # first matching key decides (oracle dict order)
+        _apod("two", {
+            "container.apparmor.security.beta.kubernetes.io/a": "unconfined",
+            "container.apparmor.security.beta.kubernetes.io/b": "runtime/default",
+        }),
+        _apod("two-rev", {
+            "container.apparmor.security.beta.kubernetes.io/a": "runtime/default",
+            "container.apparmor.security.beta.kubernetes.io/b": "unconfined",
+        }),
+        # non-string annotation value disables expansion entirely
+        _apod("nonstr", {"container.apparmor.security.beta.kubernetes.io/a": "unconfined",
+                         "weird": 3}),
+    ]
+    check_parity(policies, resources)
+
+
+def test_existence_anchor_depth_accounting():
+    """An array-of-maps two levels below an existence anchor must fall
+    back at COMPILE time, not crash the batch program at trace time
+    (code-review finding #1); one level below works on device."""
+    deep_rule = {
+        "name": "deep",
+        "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+        "validate": {
+            "message": "x",
+            "pattern": {"spec": {"^(containers)": [
+                {"volumeMounts": [{"ports": [{"=(hostPort)": 0}]}]}
+            ]}},
+        },
+    }
+    cps = compile_policy_set([make_policy("deep", [deep_rule])])
+    assert cps.coverage() == (0, 1)  # host fallback, not a trace crash
+
+    ok_rule = {
+        "name": "one-level",
+        "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+        "validate": {
+            "message": "x",
+            "pattern": {"spec": {"^(containers)": [
+                {"ports": [{"=(hostPort)": 0}]}
+            ]}},
+        },
+    }
+    policies = [make_policy("exist-nested", [ok_rule])]
+    resources = [
+        pod("ok", spec={"containers": [ctr("a", [{"hostPort": 0}])]}),
+        pod("bad", spec={"containers": [ctr("a", [{"hostPort": 9}])]}),
+        pod("none", spec={}),
+    ]
+    check_parity(policies, resources)
+
+
+def test_wildcard_metadata_key_in_array_scope_falls_back():
+    """The reference expands metadata wildcards at every map level,
+    including array elements; the device cannot join that, so such
+    rules must take the host path (code-review finding #2)."""
+    rule = {
+        "name": "vct-labels",
+        "match": {"any": [{"resources": {"kinds": ["StatefulSet"]}}]},
+        "validate": {
+            "message": "x",
+            "pattern": {"spec": {"volumeClaimTemplates": [
+                {"metadata": {"labels": {"team.*": "eng"}}}
+            ]}},
+        },
+    }
+    cps = compile_policy_set([make_policy("vct", [rule])])
+    assert cps.coverage() == (0, 1)
+    policies = [make_policy("vct", [rule])]
+    resources = [
+        {"apiVersion": "apps/v1", "kind": "StatefulSet",
+         "metadata": {"name": "s", "namespace": "default"},
+         "spec": {"volumeClaimTemplates": [
+             {"metadata": {"labels": {"team.core": "eng"}}}]}},
+        {"apiVersion": "apps/v1", "kind": "StatefulSet",
+         "metadata": {"name": "s2", "namespace": "default"},
+         "spec": {"volumeClaimTemplates": [
+             {"metadata": {"labels": {"team.core": "sales"}}}]}},
+    ]
+    check_parity(policies, resources)
+
+
+def test_wildcard_key_in_labels_parity():
+    rule = {
+        "name": "team-label",
+        "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+        "validate": {
+            "message": "team labels must be kyverno-managed",
+            "pattern": {"metadata": {"labels": {"team.*": "eng-?"}}},
+        },
+    }
+    policies = [make_policy("labels", [rule])]
+    resources = [
+        _apod("hit", labels={"team.core": "eng-1"}),
+        _apod("miss-val", labels={"team.core": "sales"}),
+        # no label matches the glob: plain key stays literal & missing
+        _apod("nolabel", labels={"app": "x"}),
+        _apod("none"),
+    ]
+    check_parity(policies, resources)
