@@ -64,3 +64,16 @@ def test_sharded_scan_resolves_host_verdicts():
     assert len(result.rules) == len(scanner.cps.rules)  # host rules included
     row = [i for i, (p, r) in enumerate(result.rules) if r == "privileged-containers"][0]
     assert result.verdicts[row, 4] == 2  # big pod fails via scalar completion
+
+
+def test_scan_stream_tiled_matches_scan():
+    """Tiled streaming scan (bench config #2's e2e path) must agree with
+    the one-shot scan and the scalar-complete TpuEngine result."""
+    policies = [expand_policy(p) for p in load_pss_policies(subset="disallow")]
+    scanner = ShardedScanner(policies)
+    resources = pods(41)
+    result, stats = scanner.scan_stream(resources, tile=16)
+    assert stats["tiles"] == 3 and result.verdicts.shape[1] == 41
+    whole = TpuEngine.from_compiled(scanner.cps).scan(resources)
+    np.testing.assert_array_equal(result.verdicts, whole.verdicts)
+    assert result.rules == whole.rules
