@@ -35,9 +35,13 @@ from ..utils.duration import parse_duration
 from ..utils.quantity import parse_quantity
 from .hashing import (
     ARRAY_SEG,
+    PATH_SEP,
+    _FNV_PRIME,
+    _MASK,
     canon_duration,
     canon_number,
     canon_quantity,
+    fnv1a64,
     hash_path,
     hash_str,
     split32,
@@ -301,6 +305,351 @@ class _ResourceEncoder:
         return r
 
 
+def encode_resources_reference(
+    resources: Sequence[Dict[str, Any]],
+    cfg: Optional[EncodeConfig] = None,
+    byte_paths: Optional[Iterable[int]] = None,
+    key_byte_paths: Optional[Iterable[int]] = None,
+) -> RowBatch:
+    """Reference (slow, obviously-correct) encoder — the parity oracle
+    for the memoized fast path below and the native C encoder."""
+    cfg = cfg or EncodeConfig()
+    bp = set(byte_paths or ())
+    kbp = set(key_byte_paths or ())
+    batch = RowBatch(len(resources), cfg)
+    for i, res in enumerate(resources):
+        enc = _ResourceEncoder(batch, i, bp, kbp)
+        enc.walk(res, (), -1, -1, 0)
+        batch.n_rows[i] = enc.row
+        batch.fallback[i] = 0 if enc.ok else 1
+    return batch
+
+
+# ---------------------------------------------------------------------------
+# Fast path: memoized rolling-hash walk + columnar assembly.
+#
+# The naive encoder above re-hashes the full path string at every node
+# (O(depth * bytes) FNV per row, pure Python) and re-runs the scalar
+# value analysis (Go number grammar, quantity/duration trials) for
+# every occurrence of every value. Cluster snapshots are massively
+# repetitive — resources of one kind share their entire path vocabulary
+# and most scalar values — so both are memoized:
+#
+# - path memo: (parent FNV state, segment) -> child path record. FNV-1a
+#   is a streaming hash, so a child's full-path hash continues from the
+#   parent's 64-bit state; each distinct (parent, seg) edge is hashed
+#   once per process, not once per row.
+# - scalar memo: (type, value) -> the full lane tuple (type tag, repr /
+#   sprint / quantity / duration / number hashes and floats, grammar
+#   flags) computed by the same helpers the reference encoder uses.
+#
+# Rows are accumulated as Python tuples and written into the RowBatch
+# with one vectorized scatter per lane at the end (zip(*rows) columnar
+# transpose), replacing ~20 numpy scalar stores per row.
+
+_FNV_ROOT_STATE = fnv1a64(b"p")  # state after hashing the path tag
+
+_SEP_BYTES = PATH_SEP.encode("utf-8")
+
+
+def _fnv_continue(state: int, data: bytes) -> int:
+    h = state
+    for b in data:
+        h = ((h ^ b) * _FNV_PRIME) & _MASK
+    return h
+
+
+class _PathMemo:
+    """(parent_state, seg) -> (state, norm, norm_hi, norm_lo, key_hi,
+    key_lo, key_glob). Bounded: cleared wholesale if it ever exceeds
+    the cap (path vocabularies are tiny; this is a leak guard)."""
+
+    __slots__ = ("memo",)
+    CAP = 1 << 20
+
+    def __init__(self):
+        self.memo: Dict[Tuple[int, str], Tuple[int, int, int, int, int, int, int]] = {}
+
+    def child(self, parent_state: int, seg: str) -> Tuple[int, int, int, int, int, int, int]:
+        key = (parent_state, seg)
+        rec = self.memo.get(key)
+        if rec is None:
+            data = seg.encode("utf-8")
+            if parent_state != _FNV_ROOT_STATE:
+                data = _SEP_BYTES + data
+            state = _fnv_continue(parent_state, data)
+            norm = state
+            khash = hash_str(seg, tag="k")
+            glob = 1 if (seg != ARRAY_SEG and ("*" in seg or "?" in seg)) else 0
+            rec = (state, norm, (norm >> 32) & 0xFFFFFFFF, norm & 0xFFFFFFFF,
+                   (khash >> 32) & 0xFFFFFFFF, khash & 0xFFFFFFFF, glob)
+            if len(self.memo) >= self.CAP:
+                self.memo.clear()
+            self.memo[key] = rec
+        return rec
+
+
+def _scalar_rec(value: Any) -> tuple:
+    """All scalar lanes for one value, as a tuple in _NODE_FIELDS order
+    (see below); computed with the exact helpers the reference encoder
+    uses so the two paths cannot diverge."""
+    type_tag = T_NULL
+    bool_val = 0
+    has_num = num_hi = num_lo = 0
+    num_val = 0.0
+    str_goint = str_gofloat = has_glob = 0
+    if value is None:
+        pass
+    elif isinstance(value, bool):
+        type_tag = T_BOOL
+        bool_val = 1 if value else 0
+    elif isinstance(value, (int, float)):
+        type_tag = T_NUM
+        num_val = float(np.float32(value))
+        has_num = 1
+        num_hi, num_lo = split32(canon_number(value))
+    else:
+        type_tag = T_STR
+        if "*" in value or "?" in value:
+            has_glob = 1
+        g_int = go_parse_int(value)
+        g_float = go_parse_float(value)
+        if g_int is not None:
+            str_goint = 1
+        if g_float is not None:
+            str_gofloat = 1
+        num = g_int if g_int is not None else g_float
+        if num is not None:
+            has_num = 1
+            num_val = float(np.float32(num))
+            num_hi, num_lo = split32(canon_number(num))
+
+    has_repr = repr_hi = repr_lo = 0
+    rep = _go_repr(value)
+    if rep is not None:
+        has_repr = 1
+        repr_hi, repr_lo = split32(hash_str(rep, tag="s"))
+    sprint_hi = sprint_lo = 0
+    sp = go_sprint(value)
+    if sp is not None:
+        sprint_hi, sprint_lo = split32(hash_str(sp, tag="s"))
+    has_qty = qty_hi = qty_lo = 0
+    qty_val = 0.0
+    has_dur = dur_hi = dur_lo = 0
+    dur_val = 0.0
+    ns = _number_string(value)
+    if ns is not None:
+        q = parse_quantity(ns)
+        if q is not None:
+            has_qty = 1
+            qty_val = float(np.float32(q))
+            qty_hi, qty_lo = split32(canon_quantity(q))
+        d = parse_duration(ns)
+        if d is not None:
+            has_dur = 1
+            dur_val = float(np.float32(d / 1e9))
+            dur_hi, dur_lo = split32(canon_duration(d))
+    return (type_tag, bool_val, 0.0,
+            has_repr, repr_hi, repr_lo, sprint_hi, sprint_lo,
+            has_num, num_hi, num_lo, num_val,
+            has_qty, qty_hi, qty_lo, qty_val,
+            has_dur, dur_hi, dur_lo, dur_val,
+            str_goint, str_gofloat, has_glob, rep)
+
+
+# node-record field order (last element, repr string, is stripped before
+# columnar assembly)
+_NODE_FIELDS = (
+    "type_tag", "bool_val", "arr_len",
+    "has_repr", "repr_hi", "repr_lo", "sprint_hi", "sprint_lo",
+    "has_num", "num_hi", "num_lo", "num_val",
+    "has_qty", "qty_hi", "qty_lo", "qty_val",
+    "has_dur", "dur_hi", "dur_lo", "dur_val",
+    "str_goint", "str_gofloat", "has_glob",
+)
+
+_NODE_DTYPES = {
+    "type_tag": np.uint8, "bool_val": np.uint8, "arr_len": np.float32,
+    "has_repr": np.uint8, "repr_hi": np.uint32, "repr_lo": np.uint32,
+    "sprint_hi": np.uint32, "sprint_lo": np.uint32,
+    "has_num": np.uint8, "num_hi": np.uint32, "num_lo": np.uint32,
+    "num_val": np.float32,
+    "has_qty": np.uint8, "qty_hi": np.uint32, "qty_lo": np.uint32,
+    "qty_val": np.float32,
+    "has_dur": np.uint8, "dur_hi": np.uint32, "dur_lo": np.uint32,
+    "dur_val": np.float32,
+    "str_goint": np.uint8, "str_gofloat": np.uint8, "has_glob": np.uint8,
+}
+
+_PATH_MEMO = _PathMemo()
+_SCALAR_MEMO: Dict[Tuple[type, Any], tuple] = {}
+_SCALAR_MEMO_CAP = 1 << 20
+
+_ROOT_REC = (_FNV_ROOT_STATE, ROOT_HASH,
+             (ROOT_HASH >> 32) & 0xFFFFFFFF, ROOT_HASH & 0xFFFFFFFF, 0, 0, 0)
+
+# prebuilt container records keyed by (type_tag, length)
+_CONTAINER_MEMO: Dict[Tuple[int, int], tuple] = {}
+
+
+def _container_rec(tag: int, length: int) -> tuple:
+    rec = _CONTAINER_MEMO.get((tag, length))
+    if rec is None:
+        rec = (tag, 0, float(length)) + (0,) * 17 + (0, 0, 0, None)
+        _CONTAINER_MEMO[(tag, length)] = rec
+    return rec
+
+
+class _FastEncoder:
+    """One batch-level accumulation; per-resource state is only the
+    byte-pool cursor."""
+
+    def __init__(self, batch: RowBatch, byte_paths: Set[int], key_byte_paths: Set[int]):
+        self.b = batch
+        self.byte_paths = byte_paths
+        self.key_byte_paths = key_byte_paths
+        self.max_rows = batch.cfg.max_rows
+        self.max_instances = batch.cfg.max_instances
+        # columnar accumulators (whole batch)
+        self.flat: List[int] = []
+        self.paths: List[tuple] = []   # (norm_hi,norm_lo,par_hi,par_lo,key_hi,key_lo,key_glob)
+        self.nodes: List[tuple] = []   # _NODE_FIELDS order + trailing repr str
+        self.scope1: List[int] = []
+        self.scope2: List[int] = []
+        self.s2_over: List[int] = []
+        self.byte_slots: List[Tuple[int, int]] = []      # (flat_idx, slot)
+        self.key_byte_slots: List[Tuple[int, int]] = []  # (flat_idx, slot)
+        # per-resource state
+        self.i = 0
+        self.base = 0
+        self.row = 0
+        self.pool_used = 0
+        self.ok = True
+
+    def begin(self, i: int) -> None:
+        self.i = i
+        self.base = i * self.max_rows
+        self.row = 0
+        self.pool_used = 0
+        self.ok = True
+
+    def _assign_pool(self, flat_idx: int, s: str, key_lane: bool) -> Optional[int]:
+        b = self.b
+        data = s.encode("utf-8")
+        if len(data) > b.cfg.byte_pool_width or self.pool_used >= b.cfg.byte_pool_slots:
+            self.ok = False
+            return None
+        slot = self.pool_used
+        self.pool_used += 1
+        b.pool[self.i, slot, : len(data)] = np.frombuffer(data, dtype=np.uint8)
+        b.pool_len[self.i, slot] = len(data)
+        (self.key_byte_slots if key_lane else self.byte_slots).append((flat_idx, slot))
+        return slot
+
+    def walk(self, node: Any, prec: tuple, par_hi: int, par_lo: int,
+             scope1: int, scope2: int, depth: int):
+        if self.row >= self.max_rows:
+            self.ok = False
+            return None
+        r = self.row
+        self.row += 1
+        flat = self.base + r
+        state, norm, norm_hi, norm_lo, key_hi, key_lo, key_glob = prec
+        self.flat.append(flat)
+        self.paths.append((norm_hi, norm_lo, par_hi, par_lo, key_hi, key_lo, key_glob))
+        self.scope1.append(scope1)
+        self.scope2.append(scope2)
+
+        if isinstance(node, dict):
+            self.s2_over.append(0)
+            self.nodes.append(_container_rec(T_MAP, len(node)))
+            pool_keys = norm in self.key_byte_paths
+            child = _PATH_MEMO.child
+            for k, v in node.items():
+                ks = k if type(k) is str else str(k)
+                crec = child(state, ks)
+                cr = self.walk(v, crec, norm_hi, norm_lo, scope1, scope2, depth)
+                if pool_keys and cr is not None and cr >= 0:
+                    cflat = self.base + cr
+                    self._assign_pool(cflat, ks, key_lane=True)
+                    if isinstance(v, str) and not self._has_byte_slot(cflat):
+                        self._assign_pool(cflat, v, key_lane=False)
+        elif isinstance(node, list):
+            over = 0
+            if len(node) > self.max_instances:
+                if depth == 0:
+                    self.ok = False
+                elif depth == 1:
+                    over = 1
+            self.s2_over.append(over)
+            self.nodes.append(_container_rec(T_ARR, len(node)))
+            crec = _PATH_MEMO.child(state, ARRAY_SEG)
+            for idx, v in enumerate(node):
+                s1, s2 = scope1, scope2
+                if depth == 0:
+                    s1 = idx
+                elif depth == 1:
+                    s2 = idx
+                self.walk(v, crec, norm_hi, norm_lo, s1, s2, depth + 1)
+        else:
+            self.s2_over.append(0)
+            key = (node.__class__, node)
+            try:
+                rec = _SCALAR_MEMO.get(key)
+            except TypeError:  # unhashable exotic scalar — not JSON, but be safe
+                rec = _scalar_rec(node)
+                key = None
+            if rec is None:
+                rec = _scalar_rec(node)
+                if key is not None:
+                    if len(_SCALAR_MEMO) >= _SCALAR_MEMO_CAP:
+                        _SCALAR_MEMO.clear()
+                    _SCALAR_MEMO[key] = rec
+            self.nodes.append(rec)
+            if rec[3] and norm in self.byte_paths:  # has_repr
+                self._assign_pool(flat, rec[-1], key_lane=False)
+        return r
+
+    def _has_byte_slot(self, flat_idx: int) -> bool:
+        # only consulted for just-emitted children of pool_keys maps —
+        # scan the (short) tail of byte_slots for this resource
+        for fi, _ in reversed(self.byte_slots):
+            if fi < self.base:
+                return False
+            if fi == flat_idx:
+                return True
+        return False
+
+    def finish_batch(self) -> None:
+        """Columnar scatter of the accumulated rows into the RowBatch."""
+        b = self.b
+        if not self.flat:
+            return
+        fa = np.asarray(self.flat, dtype=np.int64)
+        b.valid.ravel()[fa] = 1
+        # paths record: (norm_hi, norm_lo, par_hi, par_lo, key_hi, key_lo, glob)
+        pcols = tuple(zip(*self.paths))
+        b.norm_hi.ravel()[fa] = np.asarray(pcols[0], dtype=np.uint32)
+        b.norm_lo.ravel()[fa] = np.asarray(pcols[1], dtype=np.uint32)
+        b.parent_hi.ravel()[fa] = np.asarray(pcols[2], dtype=np.uint32)
+        b.parent_lo.ravel()[fa] = np.asarray(pcols[3], dtype=np.uint32)
+        b.key_hi.ravel()[fa] = np.asarray(pcols[4], dtype=np.uint32)
+        b.key_lo.ravel()[fa] = np.asarray(pcols[5], dtype=np.uint32)
+        b.key_glob.ravel()[fa] = np.asarray(pcols[6], dtype=np.uint8)
+        b.scope1.ravel()[fa] = np.asarray(self.scope1, dtype=np.int32)
+        b.scope2.ravel()[fa] = np.asarray(self.scope2, dtype=np.int32)
+        b.s2_overflow.ravel()[fa] = np.asarray(self.s2_over, dtype=np.uint8)
+        ncols = tuple(zip(*self.nodes))
+        for idx, name in enumerate(_NODE_FIELDS):
+            getattr(b, name).ravel()[fa] = np.asarray(ncols[idx], dtype=_NODE_DTYPES[name])
+        if self.byte_slots:
+            idxs, slots = zip(*self.byte_slots)
+            b.byte_slot.ravel()[np.asarray(idxs, dtype=np.int64)] = np.asarray(slots, dtype=np.int32)
+        if self.key_byte_slots:
+            idxs, slots = zip(*self.key_byte_slots)
+            b.key_byte_slot.ravel()[np.asarray(idxs, dtype=np.int64)] = np.asarray(slots, dtype=np.int32)
+
+
 def encode_resources(
     resources: Sequence[Dict[str, Any]],
     cfg: Optional[EncodeConfig] = None,
@@ -318,9 +667,11 @@ def encode_resources(
     bp = set(byte_paths or ())
     kbp = set(key_byte_paths or ())
     batch = RowBatch(len(resources), cfg)
+    enc = _FastEncoder(batch, bp, kbp)
     for i, res in enumerate(resources):
-        enc = _ResourceEncoder(batch, i, bp, kbp)
-        enc.walk(res, (), -1, -1, 0)
+        enc.begin(i)
+        enc.walk(res, _ROOT_REC, 0, 0, -1, -1, 0)
         batch.n_rows[i] = enc.row
         batch.fallback[i] = 0 if enc.ok else 1
+    enc.finish_batch()
     return batch

@@ -107,3 +107,33 @@ def test_metadata_encoding():
     m2 = encode_metadata([ns], namespace_labels={"prod": {"env": "prod"}})
     assert m2.is_namespace_kind[0] == 1
     assert m2.nsl_n[0] == 1  # Namespace resources join their own labels
+
+
+def test_fast_encoder_matches_reference():
+    """The memoized fast encoder must be lane-for-lane identical to the
+    reference (slow) encoder over structurally diverse resources."""
+    import numpy as np
+
+    from kyverno_tpu.tpu.flatten import encode_resources_reference
+    from kyverno_tpu.tpu.hashing import hash_path
+
+    cases = [
+        {}, {"a": None}, {"a": [1, 2.5, "3", True, None]},
+        {"m": {"x*": "glob?", "q": "100Mi", "d": "1.5h", "n": "-42",
+               "f": "1e3", "s": "word"}},
+        {"deep": {"a": {"b": {"c": {"d": [[{"e": 1}]]}}}}},
+        {"arr": [[{"k": i} for i in range(20)]]},   # depth-1 instance overflow
+        {"big": [{"k": i} for i in range(20)]},     # depth-0 overflow -> fallback
+        {"metadata": {"labels": {"app": "x", "tier*": "backend"}}},
+        {"v": 2.0}, {"v": 0.001}, {"v": -0.0}, {"v": True},
+        {"v": 10**25}, {"v": "0"}, {"v": ""},
+        POD,
+    ]
+    bp = {hash_path(("spec", "containers", "[]", "image")),
+          hash_path(("m", "q")), hash_path(("v",))}
+    kbp = {hash_path(("metadata", "labels")), hash_path(("m",))}
+    cfg = EncodeConfig()
+    fast = encode_resources(cases, cfg, bp, kbp).arrays()
+    ref = encode_resources_reference(cases, cfg, bp, kbp).arrays()
+    for lane, got in fast.items():
+        assert np.array_equal(got, ref[lane]), f"lane {lane} diverged"
