@@ -136,8 +136,10 @@ def bench_scan():
         "e2e_resources_per_sec": round(n_resources / e2e, 1),
         "e2e_seconds": round(e2e, 2),
         "encode_seconds": round(stats["encode_s"], 2),
+        # denominator = real resources (padding excluded), so this rate
+        # composes with e2e_resources_per_sec
         "encode_resources_per_sec": round(
-            stats["tiles"] * stats["tile"] / max(stats["encode_s"], 1e-9), 1),
+            n_resources / max(stats["encode_s"], 1e-9), 1),
         "device_seconds": round(stats["device_s"], 2),
         "host_completion_seconds": round(stats["host_s"], 2),
         "host_cells": stats["host_cells"],

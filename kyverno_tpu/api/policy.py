@@ -164,6 +164,8 @@ class Rule:
     generation: Optional[Dict[str, Any]] = None
     verify_images: Optional[List[Dict[str, Any]]] = None
     cel_preconditions: Optional[List[Dict[str, Any]]] = None
+    # kind -> [{path, value, key, name, jmesPath}] (rule_types.go ImageExtractors)
+    image_extractors: Optional[Dict[str, List[Dict[str, Any]]]] = None
     raw: Dict[str, Any] = field(default_factory=dict)
 
     @classmethod
@@ -179,6 +181,7 @@ class Rule:
             generation=d.get("generate"),
             verify_images=d.get("verifyImages"),
             cel_preconditions=d.get("celPreconditions"),
+            image_extractors=d.get("imageExtractors"),
             raw=d,
         )
 
@@ -232,6 +235,9 @@ class ClusterPolicy:
     spec: Spec = field(default_factory=Spec)
     annotations: Dict[str, str] = field(default_factory=dict)
     labels: Dict[str, str] = field(default_factory=dict)
+    # metadata.resourceVersion — cache-invalidation key for compiled
+    # programs and image-verify results (imageverifycache key layout)
+    resource_version: str = ""
     raw: Dict[str, Any] = field(default_factory=dict)
 
     @classmethod
@@ -244,6 +250,7 @@ class ClusterPolicy:
             spec=Spec.from_dict(d.get("spec")),
             annotations=dict(meta.get("annotations") or {}),
             labels=dict(meta.get("labels") or {}),
+            resource_version=str(meta.get("resourceVersion") or ""),
             raw=d,
         )
 

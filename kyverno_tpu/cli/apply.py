@@ -68,6 +68,10 @@ def add_parser(sub: argparse._SubParsersAction) -> None:
                    help="treat Audit-mode failures as warnings for the exit code")
     p.add_argument("--detailed-results", action="store_true",
                    help="print one line per rule result")
+    p.add_argument("--registry-fixture", default=None,
+                   help="YAML/JSON file seeding the offline image "
+                        "registry (image -> digest/signers/attestations) "
+                        "for verifyImages rules")
     p.add_argument("--output-json", action="store_true",
                    help="machine-readable summary on stdout")
     p.set_defaults(func=run)
@@ -122,23 +126,25 @@ def _res_id(res: Dict[str, Any]) -> str:
     return f"{ns + '/' if ns else ''}{kind}/{name}"
 
 
-def _apply_mutations(policies, resources) -> Tuple[List[Dict[str, Any]], List[Tuple]]:
-    """Mutate stage (policy_processor.go:109): sequentially apply every
-    policy's mutate rules per resource; validation then runs on the
-    patched resources."""
+def _apply_stage(policies, resources, has_rule, invoke
+                 ) -> Tuple[List[Dict[str, Any]], List[Tuple]]:
+    """One patching stage of ApplyPoliciesOnResource
+    (policy_processor.go:59): sequentially apply each policy whose
+    rules match ``has_rule`` to every resource via ``invoke(engine,
+    pctx)``; later stages run on the patched resources."""
     from ..tpu.engine import build_scan_context
 
     eng = ScalarEngine()
-    mutating = [p for p in policies if any(r.has_mutate() for r in p.get_rules())]
-    if not mutating:
+    active = [p for p in policies if any(has_rule(r) for r in p.get_rules())]
+    if not active:
         return list(resources), []
     patched_resources: List[Dict[str, Any]] = []
     results: List[Tuple] = []
     for ci, res in enumerate(resources):
         current = res
-        for policy in mutating:
+        for policy in active:
             pctx = build_scan_context(policy, current, None)
-            response = eng.mutate(pctx)
+            response = invoke(eng, pctx)
             for rr in response.policy_response.rules:
                 results.append((policy, rr.name, ci, rr.status, rr.message))
             if response.patched_resource is not None:
@@ -147,21 +153,87 @@ def _apply_mutations(policies, resources) -> Tuple[List[Dict[str, Any]], List[Tu
     return patched_resources, results
 
 
+def _apply_mutations(policies, resources) -> Tuple[List[Dict[str, Any]], List[Tuple]]:
+    """Mutate stage (policy_processor.go:109)."""
+    return _apply_stage(policies, resources,
+                        lambda r: r.has_mutate(),
+                        lambda eng, pctx: eng.mutate(pctx))
+
+
+def _apply_image_verification(policies, resources, registry_client=None
+                              ) -> Tuple[List[Dict[str, Any]], List[Tuple]]:
+    """verifyImages stage (policy_processor.go:126): digest patches and
+    the verify-images annotation land on the resources the validate
+    stage sees. Without a configured registry, lookups raise
+    RegistryError which surfaces as rule ERRORs — same shape as the
+    reference offline."""
+    return _apply_stage(
+        policies, resources,
+        lambda r: r.has_verify_images(),
+        lambda eng, pctx: eng.verify_and_patch_images(
+            pctx, registry_client=registry_client))
+
+
+class _VapShim:
+    """Gives VAP result rows the .name/.spec surface the output loop
+    expects from ClusterPolicy."""
+
+    def __init__(self, name: str):
+        self.name = name
+
+
+def _vap_rows(vap_docs, resources):
+    """Evaluate ValidatingAdmissionPolicy objects in-process
+    (commands/apply/command.go:213 -> validatingadmissionpolicy
+    Validate)."""
+    from ..vap import validate_vap
+
+    rows = []
+    for doc in vap_docs:
+        shim = _VapShim((doc.get("metadata") or {}).get("name", "vap"))
+        for ci, res in enumerate(resources):
+            results = validate_vap(doc, res)
+            if results is None:
+                continue
+            for r in results:
+                rows.append((shim, f"validation[{r.index}]" if r.index >= 0
+                             else "validation", ci, r.status, r.message))
+    return rows
+
+
 def run(args: argparse.Namespace) -> int:
-    policy_docs = [d for d in _load_docs(args.policies) if is_policy_document(d)]
-    if not policy_docs:
+    from ..vap.policy import is_vap_document
+
+    loaded = _load_docs(args.policies)
+    policy_docs = [d for d in loaded if is_policy_document(d)]
+    vap_docs = [d for d in loaded if is_vap_document(d)]
+    if not policy_docs and not vap_docs:
         print("no policies found", file=sys.stderr)
         return 2
-    resource_docs = [d for d in _load_docs(args.resource) if not is_policy_document(d)]
+    resource_docs = [d for d in _load_docs(args.resource)
+                     if not is_policy_document(d) and not is_vap_document(d)]
     if not resource_docs:
         print("no resources found", file=sys.stderr)
         return 2
     policies = [expand_policy(ClusterPolicy.from_dict(d)) for d in policy_docs]
     enforce = {p.name: (p.spec.validation_failure_action or "Audit").lower()
                for p in policies}
+    # VAP failures deny at admission; treat them as enforce here
+    for d in vap_docs:
+        enforce[(d.get("metadata") or {}).get("name", "vap")] = "enforce"
 
     resource_docs, mutate_rows = _apply_mutations(policies, resource_docs)
-    rows = mutate_rows + _verdict_rows(policies, resource_docs, None, args.engine)
+    registry_client = None
+    if getattr(args, "registry_fixture", None):
+        from ..images import StaticRegistry
+        with open(args.registry_fixture) as f:
+            registry_client = StaticRegistry(yaml.safe_load(f) or {})
+    resource_docs, vi_rows = _apply_image_verification(
+        policies, resource_docs, registry_client)
+    rows = (mutate_rows + vi_rows
+            + (_verdict_rows(policies, resource_docs, None, args.engine)
+               if policies else [])
+            + _vap_rows(vap_docs, resource_docs))
 
     counts = {"pass": 0, "fail": 0, "warn": 0, "error": 0, "skip": 0}
     failures: List[Tuple[str, str, str, str]] = []

@@ -19,6 +19,7 @@ import copy
 from typing import Any, Dict, List, Optional
 
 from ..api.policy import ClusterPolicy, Rule
+
 from . import mutate as mutatepkg
 from . import validate as validatepkg
 from .conditions import evaluate_conditions
@@ -27,6 +28,7 @@ from .contextloaders import ContextLoaderError, DataSources, load_context_entrie
 from .match import matches_resource_description
 from .policycontext import PolicyContext
 from .response import (
+    RULE_TYPE_IMAGE_VERIFY,
     RULE_TYPE_MUTATION,
     RULE_TYPE_VALIDATION,
     EngineResponse,
@@ -90,7 +92,80 @@ class Engine:
         admission info (engine.go ApplyBackgroundChecks)."""
         return self.validate(pctx)
 
+    def verify_and_patch_images(
+        self,
+        pctx: PolicyContext,
+        registry_client=None,
+        iv_cache=None,
+    ) -> EngineResponse:
+        """engine.go:137 VerifyAndPatchImages: run verifyImages rules,
+        apply digest patches + the verify-images annotation patch to the
+        resource. The ImageVerificationMetadata rides on the response as
+        ``image_verification_metadata``."""
+        from ..images import (
+            BadImageError,
+            ImageVerificationMetadata,
+            Verifier,
+            extract_images,
+        )
+        from ..images.verify import image_references, matches_references
+        from .mutate import apply_json6902
+
+        response = EngineResponse(
+            policy=pctx.policy,
+            resource=pctx.new_resource,
+            namespace_labels=pctx.namespace_labels,
+        )
+        patched = copy.deepcopy(pctx.new_resource)
+        ivm = ImageVerificationMetadata()
+        for rule in pctx.policy.get_rules():
+            if not rule.has_verify_images():
+                continue
+
+            def handler(p, r, _ivm=ivm, _registry=registry_client, _cache=iv_cache):
+                nonlocal patched
+                try:
+                    extracted = extract_images(
+                        patched, r.image_extractors)
+                except BadImageError as e:
+                    return [RuleResponse.rule_error(
+                        r.name, RULE_TYPE_IMAGE_VERIFY, str(e))]
+                images = [info for group in extracted.values()
+                          for info in group.values()]
+                out: List[RuleResponse] = []
+                verifier = Verifier(
+                    policy=p.policy, rule_name=r.name,
+                    registry_client=_registry, cache=_cache, ivm=_ivm,
+                    context=p.json_context, old_resource=p.old_resource)
+                for iv in r.verify_images or []:
+                    refs = image_references(iv)
+                    matched = [i for i in images
+                               if matches_references(refs, str(i))]
+                    patches, rrs = verifier.verify(iv, matched, patched)
+                    if patches:
+                        patched = apply_json6902(patched, patches)
+                    out.extend(rrs)
+                return out
+
+            rr = self._invoke_rule(pctx, rule, handler)
+            if rr is not None:
+                response.policy_response.add(*rr)
+        ann_patch = ivm.annotation_patch(patched)
+        if ann_patch is not None and response.policy_response.rules_applied_count() > 0:
+            patched = apply_json6902(patched, [ann_patch])
+        response.patched_resource = patched
+        response.image_verification_metadata = ivm
+        return response
+
     # -- rule plumbing
+
+    @staticmethod
+    def _rule_type(rule: Rule) -> str:
+        if rule.has_validate():
+            return RULE_TYPE_VALIDATION
+        if rule.has_verify_images():
+            return RULE_TYPE_IMAGE_VERIFY
+        return RULE_TYPE_MUTATION
 
     def _invoke_rule(self, pctx: PolicyContext, rule: Rule, handler) -> Optional[List[RuleResponse]]:
         # match/exclude gate (engine.go:190)
@@ -109,7 +184,7 @@ class Engine:
         matched_exceptions = self._matching_exceptions(pctx, rule)
         if matched_exceptions:
             names = ", ".join(matched_exceptions)
-            rtype = RULE_TYPE_VALIDATION if rule.has_validate() else RULE_TYPE_MUTATION
+            rtype = self._rule_type(rule)
             return [
                 RuleResponse.rule_skip(
                     rule.name, rtype, f"rule is skipped due to policy exception {names}",
@@ -120,7 +195,7 @@ class Engine:
         ctx = pctx.json_context
         ctx.checkpoint()
         try:
-            rtype = RULE_TYPE_VALIDATION if rule.has_validate() else RULE_TYPE_MUTATION
+            rtype = self._rule_type(rule)
             try:
                 load_context_entries(ctx, rule.context, self.data_sources)
             except ContextLoaderError as e:
@@ -133,7 +208,7 @@ class Engine:
                 return [RuleResponse.rule_error(rule.name, rtype, f"preconditions error: {e}")]
             return handler(pctx, rule)
         except ContextEntryError as e:
-            rtype = RULE_TYPE_VALIDATION if rule.has_validate() else RULE_TYPE_MUTATION
+            rtype = self._rule_type(rule)
             return [RuleResponse.rule_error(rule.name, rtype, str(e))]
         finally:
             ctx.restore()
@@ -179,12 +254,61 @@ class Engine:
 
             return [validate_pod_security(name, v, pctx.new_resource)]
         if v.cel is not None:
-            return [
-                RuleResponse.rule_error(
-                    name, RULE_TYPE_VALIDATION, "CEL validation requires the VAP subsystem"
-                )
-            ]
+            return [self._validate_cel(pctx, name, rule)]
         return [RuleResponse.rule_error(name, RULE_TYPE_VALIDATION, "invalid validation rule")]
+
+    def _validate_cel(self, pctx: PolicyContext, name: str, rule: Rule) -> RuleResponse:
+        """validate.cel handler (validate_cel.go:40 Process): CEL
+        expressions + composited variables + audit annotations, gated
+        by celPreconditions (matchConditions)."""
+        from ..vap import CelValidator
+
+        if pctx.operation == "DELETE" and not pctx.new_resource:
+            return RuleResponse.rule_skip(
+                name, RULE_TYPE_VALIDATION, "skipped CEL validation on deleted resource")
+        cel_spec = rule.validation.cel or {}
+        validator = CelValidator(
+            validations=cel_spec.get("expressions") or [],
+            match_conditions=rule.cel_preconditions or [],
+            variables=cel_spec.get("variables") or [],
+            audit_annotations=cel_spec.get("auditAnnotations") or [],
+            default_message=rule.validation.message or "",
+        )
+        meta = pctx.new_resource.get("metadata") or {}
+        request = {
+            "operation": pctx.operation,
+            "name": meta.get("name", ""),
+            "namespace": meta.get("namespace", ""),
+            "kind": {"kind": pctx.new_resource.get("kind", "")},
+            "userInfo": {
+                "username": pctx.admission_info.username,
+                "uid": pctx.admission_info.uid,
+                "groups": list(pctx.admission_info.groups),
+            },
+        }
+        ns_object = None
+        ns_name = meta.get("namespace", "")
+        if ns_name and pctx.namespace_labels:
+            ns_object = {"metadata": {"name": ns_name,
+                                      "labels": dict(pctx.namespace_labels)}}
+        results = validator.validate(
+            object=pctx.new_resource,
+            old_object=pctx.old_resource or None,
+            request=request,
+            namespace_object=ns_object,
+        )
+        errors = [r for r in results if r.status == "error"]
+        if errors:
+            return RuleResponse.rule_error(
+                name, RULE_TYPE_VALIDATION, "; ".join(r.message for r in errors))
+        fails = [r for r in results if r.status == "fail"]
+        if fails:
+            return RuleResponse.rule_fail(
+                name, RULE_TYPE_VALIDATION, "; ".join(r.message for r in fails))
+        if results and all(r.status == "skip" for r in results):
+            return RuleResponse.rule_skip(
+                name, RULE_TYPE_VALIDATION, results[0].message)
+        return RuleResponse.rule_pass(name, RULE_TYPE_VALIDATION, "")
 
     def _message(self, ctx: Context, rule: Rule, default: str = "") -> str:
         msg = rule.validation.message if rule.validation else ""

@@ -80,6 +80,8 @@ class EngineResponse:
     patched_resource: Optional[Dict[str, Any]] = None
     namespace_labels: Dict[str, str] = field(default_factory=dict)
     timestamp: float = field(default_factory=time.time)
+    # populated by Engine.verify_and_patch_images (engine.go:137)
+    image_verification_metadata: Optional[Any] = None
 
     def is_successful(self) -> bool:
         return not any(

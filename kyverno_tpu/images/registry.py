@@ -1,0 +1,156 @@
+"""Signature/attestation verifier backends.
+
+The reference fans out to cosign (pkg/cosign/cosign.go) and notary
+(pkg/notary/notary.go) over the network; the verification *flow*
+(attestor sets, required counts, predicate-type statement matching,
+digest resolution) lives above the backend in imageverifier.go. This
+module defines that backend seam plus an offline static backend:
+
+- ``ImageVerifier`` protocol: ``verify_signature(opts)`` /
+  ``fetch_attestations(opts)`` returning ``Response(digest,
+  statements)`` — the same split as images.ImageVerifier in
+  pkg/images/client.go;
+- ``StaticRegistry``: a deterministic in-memory registry (image ->
+  digest, signers, attestations) used by tests, the CLI's offline mode
+  and air-gapped deployments. Real cosign/notary crypto plugs in by
+  implementing the same protocol; the engine flow above is unchanged.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from ..utils.wildcard import match as wildcard_match
+
+
+@dataclass
+class VerifyOptions:
+    image: str = ""
+    type: str = "Cosign"           # Cosign | Notary
+    key: str = ""                  # PEM public key (static key attestor)
+    cert: str = ""                 # certificate attestor
+    cert_chain: str = ""
+    subject: str = ""              # keyless attestor
+    issuer: str = ""
+    roots: str = ""
+    repository: str = ""
+    annotations: Dict[str, str] = field(default_factory=dict)
+    predicate_type: str = ""       # for attestation fetches
+
+
+@dataclass
+class Response:
+    digest: str = ""
+    statements: List[Dict[str, Any]] = field(default_factory=list)
+
+
+class RegistryError(Exception):
+    """Network/registry-layer failure — maps to a rule ERROR, not FAIL
+    (imageverifier.go:397 handleRegistryErrors)."""
+
+
+class VerificationFailed(Exception):
+    """Signature did not verify — maps to attestor failure."""
+
+
+class StaticRegistry:
+    """Offline registry fixture. Content:
+
+    images: {image_ref_without_tag_or_with: {
+        "digest": "sha256:...",
+        "signers": [{"key": pem or "subject"/"issuer" pair,
+                     "annotations": {...}, "type": "Cosign"|"Notary"}],
+        "attestations": [{"type": predicateType,
+                          "predicate": {...}, "signers": [...]}],
+    }}
+    Lookup matches the exact reference first, then the tag-stripped
+    repository path.
+    """
+
+    def __init__(self, images: Optional[Dict[str, Dict[str, Any]]] = None):
+        self.images = dict(images or {})
+
+    # -- registration helpers (test/CLI fixture building)
+
+    def add_image(self, ref: str, digest: str) -> None:
+        self.images.setdefault(ref, {})["digest"] = digest
+
+    def sign(self, ref: str, key: str = "", subject: str = "", issuer: str = "",
+             annotations: Optional[Dict[str, str]] = None, sig_type: str = "Cosign") -> None:
+        entry = self.images.setdefault(ref, {})
+        entry.setdefault("signers", []).append({
+            "key": key, "subject": subject, "issuer": issuer,
+            "annotations": annotations or {}, "type": sig_type,
+        })
+
+    def attest(self, ref: str, predicate_type: str, predicate: Dict[str, Any],
+               key: str = "", subject: str = "", issuer: str = "") -> None:
+        entry = self.images.setdefault(ref, {})
+        entry.setdefault("attestations", []).append({
+            "type": predicate_type, "predicate": predicate,
+            "signers": [{"key": key, "subject": subject, "issuer": issuer}],
+        })
+
+    # -- lookup
+
+    def _entry(self, image: str) -> Dict[str, Any]:
+        if image in self.images:
+            return self.images[image]
+        base = image.split("@", 1)[0]
+        if base in self.images:
+            return self.images[base]
+        repo = base.rsplit(":", 1)[0] if ":" in base.rsplit("/", 1)[-1] else base
+        if repo in self.images:
+            return self.images[repo]
+        raise RegistryError(f"image not found in registry: {image}")
+
+    @staticmethod
+    def _signer_matches(signer: Dict[str, Any], opts: VerifyOptions) -> bool:
+        if opts.key:
+            if signer.get("key", "").strip() != opts.key.strip():
+                return False
+        if opts.subject:
+            if not wildcard_match(opts.subject, signer.get("subject", "")):
+                return False
+        if opts.issuer:
+            if signer.get("issuer", "") != opts.issuer:
+                return False
+        for k, v in (opts.annotations or {}).items():
+            if signer.get("annotations", {}).get(k) != v:
+                return False
+        return True
+
+    # -- ImageVerifier protocol
+
+    def fetch_digest(self, image: str) -> str:
+        """Digest-only resolution (mutateDigest on unverified images,
+        imageverifier.go:300 handleMutateDigest -> fetchImageDigest)."""
+        return self._entry(image).get("digest", "")
+
+    def verify_signature(self, opts: VerifyOptions) -> Response:
+        entry = self._entry(opts.image)
+        digest = entry.get("digest", "")
+        for signer in entry.get("signers", []):
+            if signer.get("type", "Cosign") != opts.type:
+                continue
+            if self._signer_matches(signer, opts):
+                return Response(digest=digest)
+        raise VerificationFailed(
+            f"no matching signature for image {opts.image}")
+
+    def fetch_attestations(self, opts: VerifyOptions) -> Response:
+        entry = self._entry(opts.image)
+        digest = entry.get("digest", "")
+        statements = []
+        for att in entry.get("attestations", []):
+            signers = att.get("signers", [{}])
+            if (opts.key or opts.subject or opts.issuer) and not any(
+                    self._signer_matches(s, opts) for s in signers):
+                continue
+            statements.append({"type": att.get("type", ""),
+                               "predicate": att.get("predicate", {})})
+        if not statements and not entry.get("attestations"):
+            raise VerificationFailed(
+                f"no attestations found for image {opts.image}")
+        return Response(digest=digest, statements=statements)

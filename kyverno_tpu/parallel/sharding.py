@@ -131,14 +131,16 @@ class ShardedScanner:
         namespace_labels=None,
         operations=None,
         complete_host: bool = True,
+        in_flight: int = 3,
     ):
         """Tiled streaming scan for snapshots larger than one device
         batch (BASELINE config #2 at 100k resources). Every tile is
         padded to the same shape so the jitted step compiles once; JAX
-        async dispatch overlaps tile i's device work with tile i+1's
-        host encode. Returns (ScanResult, stats) where stats carries the
-        honest cost split: encode seconds, device wall seconds, host
-        completion seconds, and host-resolved cell count.
+        async dispatch overlaps device work on up to ``in_flight`` tiles
+        with the host's encode of the next tiles. Returns (ScanResult,
+        stats) where stats carries the honest cost split: encode
+        seconds, device wall seconds, host completion seconds, and
+        host-resolved cell count.
         """
         import time
 
@@ -185,7 +187,7 @@ class ShardedScanner:
             verdicts, _ = self._step(batch)  # async dispatch
             pending.append((verdicts, sl, nv))
             stats["tiles"] += 1
-            if len(pending) > 1:  # keep one tile in flight
+            while len(pending) > max(in_flight, 1):
                 drain()
         while pending:
             drain()

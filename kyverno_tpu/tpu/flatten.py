@@ -593,7 +593,12 @@ class _FastEncoder:
                 self.walk(v, crec, norm_hi, norm_lo, s1, s2, depth + 1)
         else:
             self.s2_over.append(0)
-            key = (node.__class__, node)
+            # floats need the sign bit in the key: 0.0 == -0.0 as dict
+            # keys but their Go reprs differ (0E+00 vs -0E+00)
+            if node.__class__ is float and node == 0.0:
+                key = (float, node, str(node))
+            else:
+                key = (node.__class__, node)
             try:
                 rec = _SCALAR_MEMO.get(key)
             except TypeError:  # unhashable exotic scalar — not JSON, but be safe

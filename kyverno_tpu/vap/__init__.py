@@ -1,0 +1,18 @@
+"""CEL validation + ValidatingAdmissionPolicy evaluation.
+
+Two consumers share this module (mirroring the reference, where both
+go through k8s.io/apiserver's cel/validatingadmissionpolicy stack):
+
+- the engine's ``validate.cel`` handler
+  (pkg/engine/handlers/validation/validate_cel.go:34) — kyverno rules
+  carrying expressions/auditAnnotations/variables + celPreconditions;
+- in-process evaluation of ValidatingAdmissionPolicy objects for CLI
+  apply and background scans
+  (pkg/validatingadmissionpolicy/validate.go:66).
+"""
+
+from .validator import CelValidator, ValidationResult
+from .policy import match_constraints_match, validate_vap
+
+__all__ = ["CelValidator", "ValidationResult", "validate_vap",
+           "match_constraints_match"]

@@ -58,6 +58,8 @@ class Handlers:
         configuration: Optional[Configuration] = None,
         toggles: Optional[Toggles] = None,
         metrics: Optional[MetricsRegistry] = None,
+        registry_client=None,
+        iv_cache=None,
     ) -> None:
         self.cache = cache
         self.snapshot = snapshot
@@ -65,6 +67,11 @@ class Handlers:
         self.configuration = configuration
         self.toggles = toggles or Toggles()
         self.metrics = metrics or global_registry
+        self.registry_client = registry_client
+        if iv_cache is None:
+            from ..images import ImageVerifyCache
+            iv_cache = ImageVerifyCache()
+        self.iv_cache = iv_cache
         self.scalar = ScalarEngine()
         self._engines: Dict[int, TpuEngine] = {}
         self._lock = threading.Lock()
@@ -208,6 +215,44 @@ class Handlers:
                 response = self.scalar.mutate(pctx)
                 if response.patched_resource is not None:
                     patched = response.patched_resource
+            # image verification runs after mutation on the patched
+            # resource (resource/handlers.go:139-177: mutate policies
+            # then verify-image policies, patches joined)
+            for policy in self.cache.get_policies(
+                PolicyType.VERIFY_IMAGES_MUTATE, kind=resource.get("kind"),
+                namespace=payload.namespace,
+            ):
+                pctx = build_scan_context(
+                    policy, patched, ns_labels.get(payload.namespace, {}),
+                    payload.operation, payload.info,
+                )
+                pctx.old_resource = payload.old or {}
+                response = self.scalar.verify_and_patch_images(
+                    pctx, registry_client=self.registry_client,
+                    iv_cache=self.iv_cache)
+                if response.patched_resource is not None:
+                    patched = response.patched_resource
+                # all verifyImages results land in reports, mirroring
+                # the validate path's audit plumbing
+                if self.aggregator is not None and response.policy_response.rules:
+                    meta = patched.get("metadata") or {}
+                    self.aggregator.put(resource_uid(patched), [
+                        ReportResult(
+                            policy=policy.name, rule=rr.name,
+                            result=rr.status,
+                            resource_kind=patched.get("kind", ""),
+                            resource_name=meta.get("name", ""),
+                            resource_namespace=meta.get("namespace", ""),
+                        ) for rr in response.policy_response.rules])
+                # only Enforce policies block; Audit failures surface
+                # via the report path above (utils/block.go semantics)
+                enforce = (policy.spec.validation_failure_action
+                           or "Audit").lower().startswith("enforce")
+                if enforce and not response.is_successful():
+                    failed = ", ".join(response.get_failed_rules())
+                    return _response(
+                        req, False,
+                        f"image verification failed: {policy.name}: {failed}")
         except Exception as e:
             allowed = failure_policy == "ignore"
             return _response(req, allowed, f"mutation error: {e}")

@@ -1,0 +1,224 @@
+"""CEL engine semantics (cel-spec langdef.md, k8s configuration:
+cross-type numeric comparisons, heterogeneous equality, optionals)."""
+
+import pytest
+
+from kyverno_tpu.cel import CelError, CelSyntaxError, compile, eval_expression
+
+
+def ev(src, **vars):
+    return eval_expression(src, vars)
+
+
+# -- literals, arithmetic, comparisons
+
+@pytest.mark.parametrize("src,expected", [
+    ("1 + 2 * 3", 7),
+    ("(1 + 2) * 3", 9),
+    ("10 / 3", 3),
+    ("-10 / 3", -3),          # Go truncation toward zero
+    ("10 % 3", 1),
+    ("-10 % 3", -1),          # truncated, not floored
+    ("7.0 / 2.0", 3.5),
+    ("1.5e2", 150.0),
+    ("0x10", 16),
+    ("2u + 3u", 5),
+    ('"a" + "b"', "ab"),
+    ("[1, 2] + [3]", [1, 2, 3]),
+    ("b'ab' + b'c'", b"abc"),
+    ("1 < 2", True),
+    ("2 <= 2", True),
+    ("1 < 1.5", True),        # cross-type numeric compare
+    ("2.0 == 2", True),
+    ('"abc" < "abd"', True),
+    ("1 == 1", True),
+    ('1 == "1"', False),      # heterogeneous equality -> false
+    ("true == 1", False),
+    ("null == null", True),
+    ("[1, 2] == [1, 2.0]", True),
+    ('{"a": 1} == {"a": 1.0}', True),
+    ("!false", True),
+    ("-(-3)", 3),
+    ('true ? "y" : "n"', "y"),
+])
+def test_basics(src, expected):
+    assert ev(src) == expected
+
+
+def test_division_and_modulus_by_zero():
+    with pytest.raises(CelError):
+        ev("1 / 0")
+    with pytest.raises(CelError):
+        ev("1 % 0")
+    assert ev("1.0 / 0.0") == float("inf")
+
+
+def test_int_overflow_errors():
+    with pytest.raises(CelError):
+        ev("9223372036854775807 + 1")
+
+
+# -- logic: commutative error absorption
+
+def test_error_absorption():
+    assert ev('true || (1 / 0 > 0)') is True
+    assert ev('(1 / 0 > 0) || true') is True
+    assert ev('false && (1 / 0 > 0)') is False
+    assert ev('(1 / 0 > 0) && false') is False
+    with pytest.raises(CelError):
+        ev('false || (1 / 0 > 0)')
+    with pytest.raises(CelError):
+        ev('true && (1 / 0 > 0)')
+
+
+# -- selection, has(), in, indexing
+
+def test_select_and_has():
+    obj = {"spec": {"replicas": 3, "labels": {"app": "x"}}}
+    assert ev("object.spec.replicas", object=obj) == 3
+    assert ev("has(object.spec.replicas)", object=obj) is True
+    assert ev("has(object.spec.missing)", object=obj) is False
+    with pytest.raises(CelError):
+        ev("object.spec.missing", object=obj)  # no_such_field
+    assert ev('"app" in object.spec.labels', object=obj) is True
+    assert ev('2 in [1, 2, 3]') is True
+    assert ev('object.spec.labels["app"]', object=obj) == "x"
+    assert ev('[10, 20][1]') == 20
+    with pytest.raises(CelError):
+        ev('[10][5]')
+
+
+def test_undeclared_variable_errors():
+    with pytest.raises(CelError):
+        ev("unknown_var + 1")
+
+
+# -- strings
+
+def test_string_functions():
+    assert ev('"hello world".contains("wor")') is True
+    assert ev('"abc".startsWith("ab")') is True
+    assert ev('"abc".endsWith("bc")') is True
+    assert ev('"abc123".matches("^[a-z]+[0-9]+$")') is True
+    assert ev('size("héllo")') == 5
+    assert ev('"a-b-c".split("-")') == ["a", "b", "c"]
+    assert ev('["a", "b"].join("/")') == "a/b"
+    assert ev('"AbC".lowerAscii()') == "abc"
+    assert ev('"  x ".trim()') == "x"
+    assert ev('"abcd".substring(1, 3)') == "bc"
+    assert ev('"a.b".replace(".", "-")') == "a-b"
+
+
+# -- conversions
+
+def test_conversions():
+    assert ev('int("42")') == 42
+    assert ev('string(42)') == "42"
+    assert ev('double("1.5")') == 1.5
+    assert ev('bool("true")') is True
+    assert ev('int(3.9)') == 3
+    assert ev('string(true)') == "true"
+    with pytest.raises(CelError):
+        ev('int("x")')
+    assert ev('type(1) == type(2)') is True
+    assert ev('string(type(1))') == "int"
+
+
+# -- macros
+
+def test_macros():
+    assert ev('[1, 2, 3].all(x, x > 0)') is True
+    assert ev('[1, -2, 3].all(x, x > 0)') is False
+    assert ev('[1, 2, 3].exists(x, x == 2)') is True
+    assert ev('[1, 2, 3].exists_one(x, x > 2)') is True
+    assert ev('[1, 2, 3].exists_one(x, x > 1)') is False
+    assert ev('[1, 2, 3].filter(x, x % 2 == 1)') == [1, 3]
+    assert ev('[1, 2, 3].map(x, x * 10)') == [10, 20, 30]
+    assert ev('[1, 2, 3].map(x, x > 1, x * 10)') == [20, 30]
+    # maps iterate keys
+    assert ev('{"a": 1, "b": 2}.all(k, k in ["a", "b"])') is True
+    # nested binders
+    assert ev('[[1], [2, 3]].map(xs, xs.map(x, x + 1))') == [[2], [3, 4]]
+
+
+def test_macro_error_absorption():
+    # all() absorbs errors when a false determines the result
+    assert ev('[1, 0, 2].all(x, 10 / x > 100)') is False
+    with pytest.raises(CelError):
+        ev('[1, 0, 2].all(x, 10 / x >= 0)')
+    assert ev('[0, 1].exists(x, 10 / x > 5)') is True
+
+
+# -- optionals (k8s optional library)
+
+def test_optionals():
+    obj = {"spec": {"replicas": 3}}
+    assert ev('object.?spec.?replicas.orValue(1)', object=obj) == 3
+    assert ev('object.?spec.?missing.orValue(1)', object=obj) == 1
+    assert ev('object.?missing.?x.orValue("d")', object=obj) == "d"
+    assert ev('object.?spec.replicas.orValue(1)', object=obj) == 3
+    assert ev('optional.of(5).hasValue()') is True
+    assert ev('optional.none().hasValue()') is False
+    assert ev('optional.of(5).value()') == 5
+    assert ev('optional.ofNonZeroValue("").hasValue()') is False
+    assert ev('object.?spec.?replicas.hasValue()', object=obj) is True
+
+
+# -- realistic VAP expressions
+
+def test_k8s_style_expressions():
+    pod = {
+        "metadata": {"name": "p", "labels": {"env": "prod"}},
+        "spec": {
+            "containers": [
+                {"name": "a", "image": "reg.io/app:v1",
+                 "securityContext": {"allowPrivilegeEscalation": False},
+                 "resources": {"limits": {"memory": "1Gi"}}},
+                {"name": "b", "image": "reg.io/b@sha256:abc",
+                 "securityContext": {"allowPrivilegeEscalation": False}},
+            ],
+        },
+    }
+    assert ev("object.spec.containers.all(c, "
+              "has(c.securityContext) && "
+              "c.securityContext.allowPrivilegeEscalation == false)",
+              object=pod) is True
+    assert ev("object.spec.containers.all(c, c.image.startsWith('reg.io/'))",
+              object=pod) is True
+    assert ev("object.spec.containers.exists(c, !has(c.resources))",
+              object=pod) is True
+    assert ev("has(object.metadata.labels) && 'env' in object.metadata.labels",
+              object=pod) is True
+    assert ev("object.metadata.?labels.?env.orValue('') == 'prod'",
+              object=pod) is True
+    # request-style vars
+    req = {"operation": "UPDATE", "userInfo": {"username": "alice"}}
+    assert ev("request.operation in ['CREATE', 'UPDATE']", request=req) is True
+    old = {"spec": {"replicas": 2}}
+    assert ev("object.spec.replicas > oldObject.spec.replicas",
+              object={"spec": {"replicas": 3}}, oldObject=old) is True
+
+
+# -- syntax errors
+
+def test_syntax_errors():
+    for bad in ["1 +", "foo(", "a.all(1, true)", "if", "a ? b", "'unterminated"]:
+        with pytest.raises(CelSyntaxError):
+            compile(bad)
+
+
+def test_comments_and_whitespace():
+    assert ev("1 + // comment\n 2") == 3
+
+
+def test_bad_escape_is_syntax_error_not_crash():
+    for bad in [r'"\xZZ"', r'"\8"', r'"\uZZZZ"']:
+        with pytest.raises(CelSyntaxError):
+            compile(bad + " == x")
+
+
+def test_split_limit_go_semantics():
+    assert ev('"a,b,c".split(",", -1)') == ["a", "b", "c"]
+    assert ev('"a,b,c".split(",", 0)') == []
+    assert ev('"a,b,c".split(",", 2)') == ["a", "b,c"]
+    assert ev('"a,b,c".split(",", 5)') == ["a", "b", "c"]

@@ -117,3 +117,36 @@ def test_namespace_label_change_invalidates_members():
                  "metadata": {"name": "default", "labels": {"env": "prod"}}})
     assert svc.scan_once() >= 1
     assert svc.aggregator.summary()["fail"] == 1
+
+
+def test_clean_rescan_skips_encode(monkeypatch):
+    """VERDICT r2 #1(b): a second scan of an unchanged snapshot must not
+    re-encode anything — dirty tracking short-circuits before the
+    encode/device layer entirely."""
+    import kyverno_tpu.parallel.sharding as sharding
+
+    snap = ClusterSnapshot()
+    cache = PolicyCache()
+    cache.set(make_policy("p1"))
+    svc = BackgroundScanService(snap, cache, mesh=make_mesh())
+    for i in range(4):
+        snap.upsert(pod(f"p{i}", True))
+
+    calls = {"n": 0}
+    real = sharding.encode_resources
+
+    def counting(*a, **kw):
+        calls["n"] += 1
+        return real(*a, **kw)
+
+    monkeypatch.setattr(sharding, "encode_resources", counting)
+    assert svc.scan_once() == 4
+    first = calls["n"]
+    assert first > 0
+    # unchanged snapshot: no encode at all
+    assert svc.scan_once() == 0
+    assert calls["n"] == first
+    # one dirty resource: exactly one more encode pass (single batch)
+    snap.upsert(pod("p0", False))
+    assert svc.scan_once() == 1
+    assert calls["n"] == first + 1

@@ -1,0 +1,396 @@
+"""Image verification subsystem tests.
+
+Covers pkg/utils/image/infos.go parsing, pkg/utils/api/image.go
+extraction, pkg/imageverifycache TTL semantics and the
+imageverifier.go rule flow (attestor counts, nested attestors,
+attestations with predicate conditions, digest mutation, annotation
+guard) through the engine facade."""
+
+import json
+
+import pytest
+
+from kyverno_tpu.api.policy import ClusterPolicy
+from kyverno_tpu.engine.engine import Engine
+from kyverno_tpu.engine.policycontext import PolicyContext
+from kyverno_tpu.engine.context import Context
+from kyverno_tpu.images import (
+    VERIFY_ANNOTATION,
+    BadImageError,
+    ImageVerificationMetadata,
+    ImageVerifyCache,
+    StaticRegistry,
+    Verifier,
+    extract_images,
+    get_image_info,
+    validate_image,
+)
+
+KEY_A = "-----BEGIN PUBLIC KEY-----\nAAA\n-----END PUBLIC KEY-----"
+KEY_B = "-----BEGIN PUBLIC KEY-----\nBBB\n-----END PUBLIC KEY-----"
+DIGEST = "sha256:" + "ab" * 32
+
+
+def pod(image="ghcr.io/org/app:v1", annotations=None):
+    meta = {"name": "p", "namespace": "default"}
+    if annotations:
+        meta["annotations"] = annotations
+    return {
+        "apiVersion": "v1", "kind": "Pod", "metadata": meta,
+        "spec": {"containers": [{"name": "main", "image": image}]},
+    }
+
+
+def vi_policy(iv):
+    return ClusterPolicy.from_dict({
+        "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+        "metadata": {"name": "check-images"},
+        "spec": {"rules": [{
+            "name": "verify",
+            "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+            "verifyImages": [iv],
+        }]},
+    })
+
+
+def run(policy, resource, registry, cache=None, old_resource=None):
+    ctx = Context()
+    ctx.add_resource(resource)
+    pctx = PolicyContext(policy=policy, new_resource=resource,
+                         old_resource=old_resource or {}, json_context=ctx)
+    return Engine().verify_and_patch_images(
+        pctx, registry_client=registry, iv_cache=cache)
+
+
+# ---------------------------------------------------------------------------
+# parsing (infos.go)
+
+
+def test_image_info_parsing_defaults():
+    i = get_image_info("nginx")
+    assert (i.registry, i.path, i.tag) == ("docker.io", "nginx", "latest")
+    assert str(i) == "docker.io/nginx:latest"
+    i = get_image_info("ghcr.io/org/app:v1")
+    assert (i.registry, i.path, i.name, i.tag) == ("ghcr.io", "org/app", "app", "v1")
+    i = get_image_info(f"ghcr.io/org/app@{DIGEST}")
+    assert i.digest == DIGEST and i.tag == ""
+    assert str(i).endswith("@" + DIGEST)
+    i = get_image_info("localhost:5000/app:2")
+    assert i.registry == "localhost:5000"
+
+
+def test_image_info_rejects_malformed():
+    for bad in ["", " ", "UPPER CASE/bad image", "ok/img:tag:tag",
+                "reg.io/path@sha256:short"]:
+        with pytest.raises(BadImageError):
+            get_image_info(bad)
+
+
+# ---------------------------------------------------------------------------
+# extraction (pkg/utils/api/image.go)
+
+
+def test_extract_standard_pod_paths():
+    res = {
+        "kind": "Pod",
+        "spec": {
+            "initContainers": [{"name": "init", "image": "busybox"}],
+            "containers": [{"name": "main", "image": "nginx:1.25"}],
+            "ephemeralContainers": [{"name": "dbg", "image": "alpine"}],
+        },
+    }
+    out = extract_images(res)
+    assert set(out) == {"initContainers", "containers", "ephemeralContainers"}
+    assert out["containers"]["main"].pointer == "/spec/containers/0/image"
+    assert str(out["containers"]["main"]) == "docker.io/nginx:1.25"
+
+
+def test_extract_deployment_and_custom_configs():
+    dep = {"kind": "Deployment",
+           "spec": {"template": {"spec": {"containers": [
+               {"name": "c", "image": "app:1"}]}}}}
+    out = extract_images(dep)
+    assert out["containers"]["c"].pointer == "/spec/template/spec/containers/0/image"
+    # custom extractor overrides the registered ones for that kind
+    task = {"kind": "Task", "spec": {"steps": [{"ref": "img.io/t:1"}]}}
+    out = extract_images(task, configs={"Task": [{"path": "/spec/steps/*/ref"}]})
+    assert str(out["custom"]["0"]) == "img.io/t:1"
+
+
+# ---------------------------------------------------------------------------
+# cache TTL + eviction (imageverifycache/client.go)
+
+
+def test_cache_ttl_and_eviction():
+    now = [0.0]
+    cache = ImageVerifyCache(ttl_s=10, max_size=2, clock=lambda: now[0])
+    pol = vi_policy({})
+    assert not cache.get(pol, "r", "img1")
+    cache.set(pol, "r", "img1")
+    assert cache.get(pol, "r", "img1")
+    now[0] = 11.0
+    assert not cache.get(pol, "r", "img1")  # expired
+    cache.set(pol, "r", "a"); cache.set(pol, "r", "b"); cache.set(pol, "r", "c")
+    assert not cache.get(pol, "r", "a")  # evicted oldest
+
+
+# ---------------------------------------------------------------------------
+# verification flow
+
+
+def make_registry():
+    reg = StaticRegistry()
+    reg.add_image("ghcr.io/org/app:v1", DIGEST)
+    reg.sign("ghcr.io/org/app:v1", key=KEY_A)
+    return reg
+
+
+def test_verify_pass_and_digest_patch():
+    iv = {"imageReferences": ["ghcr.io/org/*"],
+          "attestors": [{"entries": [{"keys": {"publicKeys": KEY_A}}]}]}
+    resp = run(vi_policy(iv), pod(), make_registry())
+    assert resp.is_successful()
+    [c] = resp.patched_resource["spec"]["containers"]
+    assert c["image"] == f"ghcr.io/org/app:v1@{DIGEST}"
+    ann = resp.patched_resource["metadata"]["annotations"][VERIFY_ANNOTATION]
+    # annotation key follows ImageInfo.String(): the digested form drops
+    # the tag (infos.go:34)
+    assert json.loads(ann) == {f"ghcr.io/org/app@{DIGEST}": "pass"}
+
+
+def test_verify_fail_wrong_key():
+    iv = {"imageReferences": ["ghcr.io/org/*"],
+          "attestors": [{"entries": [{"keys": {"publicKeys": KEY_B}}]}]}
+    resp = run(vi_policy(iv), pod(), make_registry())
+    assert not resp.is_successful()
+    [rr] = resp.policy_response.rules
+    assert rr.status == "fail" and "verifiedCount: 0" in rr.message
+
+
+def test_attestor_count_semantics():
+    reg = make_registry()
+    # 1-of-2 required: one bad key + one good key passes
+    iv = {"imageReferences": ["ghcr.io/org/*"],
+          "attestors": [{"count": 1, "entries": [
+              {"keys": {"publicKeys": KEY_B}},
+              {"keys": {"publicKeys": KEY_A}}]}]}
+    assert run(vi_policy(iv), pod(), reg).is_successful()
+    # all-of-2 (default): fails
+    iv2 = {"imageReferences": ["ghcr.io/org/*"],
+           "attestors": [{"entries": [
+               {"keys": {"publicKeys": KEY_B}},
+               {"keys": {"publicKeys": KEY_A}}]}]}
+    assert not run(vi_policy(iv2), pod(), reg).is_successful()
+
+
+def test_static_key_pem_bundle_splits():
+    # one entry with two PEM keys = two attestors (imageverifier.go:143)
+    reg = make_registry()
+    iv = {"imageReferences": ["ghcr.io/org/*"],
+          "attestors": [{"count": 1, "entries": [
+              {"keys": {"publicKeys": KEY_B + KEY_A}}]}]}
+    assert run(vi_policy(iv), pod(), reg).is_successful()
+
+
+def test_keyless_subject_issuer_and_nested_attestor():
+    reg = StaticRegistry()
+    reg.add_image("ghcr.io/org/app:v1", DIGEST)
+    reg.sign("ghcr.io/org/app:v1",
+             subject="https://github.com/org/repo/.github/workflows/build.yml@refs/heads/main",
+             issuer="https://token.actions.githubusercontent.com")
+    iv = {"imageReferences": ["ghcr.io/org/*"],
+          "attestors": [{"entries": [{"attestor": {"entries": [{"keyless": {
+              "subject": "https://github.com/org/*",
+              "issuer": "https://token.actions.githubusercontent.com"}}]}}]}]}
+    assert run(vi_policy(iv), pod(), reg).is_successful()
+    iv_bad = {"imageReferences": ["ghcr.io/org/*"],
+              "attestors": [{"entries": [{"keyless": {
+                  "subject": "https://gitlab.com/*"}}]}]}
+    assert not run(vi_policy(iv_bad), pod(), reg).is_successful()
+
+
+def test_attestations_with_conditions():
+    reg = make_registry()
+    reg.attest("ghcr.io/org/app:v1", "https://slsa.dev/provenance/v0.2",
+               {"builder": {"id": "https://github.com/actions"}}, key=KEY_A)
+    iv = {"imageReferences": ["ghcr.io/org/*"],
+          "attestations": [{
+              "type": "https://slsa.dev/provenance/v0.2",
+              "attestors": [{"entries": [{"keys": {"publicKeys": KEY_A}}]}],
+              "conditions": [{"all": [{
+                  "key": "{{ builder.id }}",
+                  "operator": "Equals",
+                  "value": "https://github.com/actions"}]}]}]}
+    resp = run(vi_policy(iv), pod(), reg)
+    assert resp.is_successful()
+    # failing condition
+    iv["attestations"][0]["conditions"] = [{"all": [{
+        "key": "{{ builder.id }}", "operator": "Equals", "value": "other"}]}]
+    assert not run(vi_policy(iv), pod(), reg).is_successful()
+    # missing predicate type
+    iv["attestations"][0]["type"] = "https://other/type"
+    resp = run(vi_policy(iv), pod(), reg)
+    assert not resp.is_successful()
+    assert "not found" in resp.policy_response.rules[0].message
+
+
+def test_skip_image_references_and_unmatched():
+    reg = make_registry()
+    iv = {"imageReferences": ["ghcr.io/org/*"], "mutateDigest": False,
+          "skipImageReferences": ["ghcr.io/org/app*"],
+          "attestors": [{"entries": [{"keys": {"publicKeys": KEY_A}}]}]}
+    resp = run(vi_policy(iv), pod(), reg)
+    [rr] = resp.policy_response.rules
+    assert rr.status == "skip"
+    assert resp.image_verification_metadata.data == {"ghcr.io/org/app:v1": "skip"}
+
+
+def test_cache_short_circuits_verification(monkeypatch):
+    reg = make_registry()
+    cache = ImageVerifyCache()
+    iv = {"imageReferences": ["ghcr.io/org/*"], "mutateDigest": False,
+          "attestors": [{"entries": [{"keys": {"publicKeys": KEY_A}}]}]}
+    assert run(vi_policy(iv), pod(), reg, cache=cache).is_successful()
+    assert cache.misses >= 1
+    calls = {"n": 0}
+    orig = reg.verify_signature
+
+    def counting(opts):
+        calls["n"] += 1
+        return orig(opts)
+
+    reg.verify_signature = counting
+    resp = run(vi_policy(iv), pod(), reg, cache=cache)
+    assert resp.is_successful()
+    assert calls["n"] == 0  # served from cache
+    assert "cache" in resp.policy_response.rules[0].message
+
+
+def test_annotation_tamper_guard():
+    reg = make_registry()
+    iv = {"imageReferences": ["ghcr.io/org/*"],
+          "attestors": [{"entries": [{"keys": {"publicKeys": KEY_A}}]}]}
+    old = pod(annotations={VERIFY_ANNOTATION: json.dumps({"img": "fail"})})
+    new = pod(annotations={VERIFY_ANNOTATION: json.dumps({"img": "pass"})})
+    resp = run(vi_policy(iv), new, reg, old_resource=old)
+    assert not resp.is_successful()
+    assert "cannot be changed" in resp.policy_response.rules[0].message
+
+
+def test_previously_verified_annotation_skips():
+    """The fast path only honors annotations carried over from the OLD
+    resource (hardening vs imageverifier.go:122 — see
+    test_forged_annotation_on_create_does_not_bypass)."""
+    reg = make_registry()
+    image = "ghcr.io/org/app:v1"
+    iv = {"imageReferences": ["ghcr.io/org/*"], "mutateDigest": False,
+          "attestors": [{"entries": [{"keys": {"publicKeys": KEY_B}}]}]}  # would fail
+    ann = {VERIFY_ANNOTATION: json.dumps({image: "pass"})}
+    res = pod(annotations=ann)
+    resp = run(vi_policy(iv), res, reg, old_resource=pod(annotations=ann))
+    # no rule response (skipped via carried-over annotation), ivm pass
+    assert resp.is_successful()
+    assert resp.image_verification_metadata.data[image] == "pass"
+
+
+def test_registry_error_is_rule_error_not_fail():
+    reg = StaticRegistry()  # empty: lookups raise RegistryError
+    iv = {"imageReferences": ["ghcr.io/org/*"], "mutateDigest": False,
+          "attestors": [{"entries": [{"keys": {"publicKeys": KEY_A}}]}]}
+    resp = run(vi_policy(iv), pod(), reg)
+    [rr] = resp.policy_response.rules
+    assert rr.status == "error"
+
+
+def test_validate_image_side():
+    info = get_image_info(f"ghcr.io/org/app:v1@{DIGEST}",
+                          pointer="/spec/containers/0/image")
+    res = pod(annotations={VERIFY_ANNOTATION: json.dumps({str(info): "pass"})})
+    [rr] = validate_image([{"imageReferences": ["ghcr.io/*"]}], "r", [info], res)
+    assert rr.status == "pass"
+    # missing digest fails verifyDigest
+    info2 = get_image_info("ghcr.io/org/app:v1")
+    [rr2] = validate_image([{"imageReferences": ["ghcr.io/*"]}], "r", [info2], res)
+    assert rr2.status == "fail" and "digest" in rr2.message
+    # unverified image fails required
+    [rr3] = validate_image([{"imageReferences": ["ghcr.io/*"], "verifyDigest": False}],
+                           "r", [info2], pod())
+    assert rr3.status == "fail" and "not verified" in rr3.message
+
+
+def test_ivm_annotation_roundtrip():
+    ivm = ImageVerificationMetadata()
+    ivm.add("img:1", "pass")
+    res = pod()
+    patch = ivm.annotation_patch(res)
+    assert patch["op"] == "add" and patch["path"] == "/metadata/annotations"
+    parsed = ImageVerificationMetadata.parse_annotation(
+        patch["value"][VERIFY_ANNOTATION])
+    assert parsed.is_verified("img:1")
+    # legacy boolean form
+    legacy = ImageVerificationMetadata.parse_annotation('{"img:1": true}')
+    assert legacy.is_verified("img:1")
+
+
+def test_forged_annotation_on_create_does_not_bypass():
+    """Hardening over the reference: a CREATE (no old resource) carrying
+    a self-minted verify-images 'pass' annotation must still be
+    verified — and fail when the signature doesn't check out."""
+    reg = StaticRegistry()
+    reg.add_image("ghcr.io/org/app:v1", DIGEST)  # present but unsigned
+    iv = {"imageReferences": ["ghcr.io/org/*"], "mutateDigest": False,
+          "attestors": [{"entries": [{"keys": {"publicKeys": KEY_A}}]}]}
+    forged = pod(annotations={VERIFY_ANNOTATION: json.dumps(
+        {"ghcr.io/org/app:v1": "pass"})})
+    resp = run(vi_policy(iv), forged, reg)
+    assert not resp.is_successful()
+    # UPDATE smuggling a NEW entry is also re-verified
+    old = pod()
+    resp2 = run(vi_policy(iv), forged, reg, old_resource=old)
+    assert not resp2.is_successful()
+
+
+def test_cache_invalidates_on_policy_edit():
+    reg = make_registry()
+    cache = ImageVerifyCache()
+    iv = {"imageReferences": ["ghcr.io/org/*"], "mutateDigest": False,
+          "attestors": [{"count": 1, "entries": [{"keys": {"publicKeys": KEY_A}}]}]}
+    assert run(vi_policy(iv), pod(), reg, cache=cache).is_successful()
+    # same policy name, stricter spec: must NOT reuse the cached pass
+    iv2 = {"imageReferences": ["ghcr.io/org/*"], "mutateDigest": False,
+           "attestors": [{"entries": [{"keys": {"publicKeys": KEY_B}}]}]}
+    assert not run(vi_policy(iv2), pod(), reg, cache=cache).is_successful()
+
+
+def test_annotation_patch_on_metadata_less_resource():
+    ivm = ImageVerificationMetadata()
+    ivm.add("img:1", "pass")
+    patch = ivm.annotation_patch({"kind": "Thing"})
+    assert patch == {"op": "add", "path": "/metadata", "value": {
+        "annotations": {VERIFY_ANNOTATION: json.dumps({"img:1": "pass"})}}}
+    from kyverno_tpu.engine.mutate import apply_json6902
+    patched = apply_json6902({"kind": "Thing"}, [patch])
+    assert VERIFY_ANNOTATION in patched["metadata"]["annotations"]
+
+
+def test_rule_type_is_image_verify_for_exception_and_errors():
+    """_invoke_rule paths label verifyImages rules ImageVerify, not
+    Mutation (three-way _rule_type)."""
+    from kyverno_tpu.engine.engine import Engine
+    from kyverno_tpu.api.policy import Rule
+
+    eng = Engine(exceptions=[{
+        "metadata": {"name": "exc"},
+        "spec": {"exceptions": [{"policyName": "check-images",
+                                 "ruleNames": ["verify"]}]},
+    }])
+    iv = {"imageReferences": ["ghcr.io/org/*"],
+          "attestors": [{"entries": [{"keys": {"publicKeys": KEY_A}}]}]}
+    pol = vi_policy(iv)
+    ctx = Context()
+    res = pod()
+    ctx.add_resource(res)
+    pctx = PolicyContext(policy=pol, new_resource=res, json_context=ctx)
+    resp = eng.verify_and_patch_images(pctx, registry_client=make_registry())
+    [rr] = resp.policy_response.rules
+    assert rr.status == "skip" and rr.rule_type == "ImageVerify"

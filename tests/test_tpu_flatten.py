@@ -125,7 +125,7 @@ def test_fast_encoder_matches_reference():
         {"arr": [[{"k": i} for i in range(20)]]},   # depth-1 instance overflow
         {"big": [{"k": i} for i in range(20)]},     # depth-0 overflow -> fallback
         {"metadata": {"labels": {"app": "x", "tier*": "backend"}}},
-        {"v": 2.0}, {"v": 0.001}, {"v": -0.0}, {"v": True},
+        {"v": 2.0}, {"v": 0.001}, {"v": -0.0}, {"v": 0.0}, {"v": True},
         {"v": 10**25}, {"v": "0"}, {"v": ""},
         POD,
     ]

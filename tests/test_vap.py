@@ -1,0 +1,216 @@
+"""validate.cel rules + ValidatingAdmissionPolicy evaluation
+(validate_cel.go:34, validatingadmissionpolicy/validate.go:66)."""
+
+from kyverno_tpu.api.policy import ClusterPolicy
+from kyverno_tpu.engine.context import Context
+from kyverno_tpu.engine.engine import Engine
+from kyverno_tpu.engine.match import RequestInfo
+from kyverno_tpu.engine.policycontext import PolicyContext
+from kyverno_tpu.vap import CelValidator, validate_vap
+from kyverno_tpu.vap.policy import kind_to_resource
+
+
+def deployment(replicas, labels=None):
+    return {
+        "apiVersion": "apps/v1", "kind": "Deployment",
+        "metadata": {"name": "d", "namespace": "default",
+                     "labels": labels or {}},
+        "spec": {"replicas": replicas},
+    }
+
+
+def cel_policy(expressions, variables=None, preconditions=None,
+               audit_annotations=None, message=""):
+    rule = {
+        "name": "cel-rule",
+        "match": {"any": [{"resources": {"kinds": ["Deployment"]}}]},
+        "validate": {"message": message,
+                     "cel": {"expressions": expressions}},
+    }
+    if variables:
+        rule["validate"]["cel"]["variables"] = variables
+    if audit_annotations:
+        rule["validate"]["cel"]["auditAnnotations"] = audit_annotations
+    if preconditions:
+        rule["celPreconditions"] = preconditions
+    return ClusterPolicy.from_dict({
+        "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+        "metadata": {"name": "cel-pol"},
+        "spec": {"rules": [rule]},
+    })
+
+
+def run(policy, resource, operation="CREATE", old=None):
+    ctx = Context()
+    ctx.add_resource(resource)
+    pctx = PolicyContext(policy=policy, new_resource=resource,
+                         old_resource=old or {}, operation=operation,
+                         admission_info=RequestInfo(username="alice"),
+                         json_context=ctx)
+    return Engine().validate(pctx)
+
+
+def test_cel_rule_pass_and_fail():
+    pol = cel_policy([{
+        "expression": "object.spec.replicas <= 5",
+        "message": "replicas must be <= 5",
+    }])
+    resp = run(pol, deployment(3))
+    [rr] = resp.policy_response.rules
+    assert rr.status == "pass"
+    resp = run(pol, deployment(9))
+    [rr] = resp.policy_response.rules
+    assert rr.status == "fail" and rr.message == "replicas must be <= 5"
+
+
+def test_cel_message_expression_and_variables():
+    pol = cel_policy(
+        [{"expression": "variables.r <= 5",
+          "messageExpression": "'got ' + string(variables.r) + ' replicas'"}],
+        variables=[{"name": "r", "expression": "object.spec.replicas"}])
+    resp = run(pol, deployment(7))
+    [rr] = resp.policy_response.rules
+    assert rr.status == "fail" and rr.message == "got 7 replicas"
+
+
+def test_cel_preconditions_gate():
+    pol = cel_policy(
+        [{"expression": "false", "message": "always fails"}],
+        preconditions=[{"name": "only-update",
+                        "expression": "request.operation == 'UPDATE'"}])
+    [rr] = run(pol, deployment(1), operation="CREATE").policy_response.rules
+    assert rr.status == "skip"
+    [rr] = run(pol, deployment(1), operation="UPDATE").policy_response.rules
+    assert rr.status == "fail"
+
+
+def test_cel_error_surfaces_as_error():
+    pol = cel_policy([{"expression": "object.spec.missing > 1"}])
+    [rr] = run(pol, deployment(1)).policy_response.rules
+    assert rr.status == "error" and "no_such_field" in rr.message
+
+
+def test_cel_old_object():
+    pol = cel_policy([{
+        "expression": "oldObject == null || object.spec.replicas >= oldObject.spec.replicas",
+        "message": "no scale down"}])
+    [rr] = run(pol, deployment(2), operation="UPDATE",
+               old=deployment(5)).policy_response.rules
+    assert rr.status == "fail"
+    [rr] = run(pol, deployment(8), operation="UPDATE",
+               old=deployment(5)).policy_response.rules
+    assert rr.status == "pass"
+
+
+# -- VAP objects
+
+
+VAP = {
+    "apiVersion": "admissionregistration.k8s.io/v1",
+    "kind": "ValidatingAdmissionPolicy",
+    "metadata": {"name": "replica-limit"},
+    "spec": {
+        "matchConstraints": {"resourceRules": [{
+            "apiGroups": ["apps"], "apiVersions": ["v1"],
+            "operations": ["CREATE", "UPDATE"],
+            "resources": ["deployments"]}]},
+        "validations": [{
+            "expression": "object.spec.replicas <= 5",
+            "message": "too many replicas",
+            "reason": "Invalid"}],
+    },
+}
+
+
+def test_vap_match_and_validate():
+    results = validate_vap(VAP, deployment(3))
+    assert [r.status for r in results] == ["pass"]
+    results = validate_vap(VAP, deployment(10))
+    assert results[0].status == "fail"
+    assert results[0].message == "too many replicas"
+    # non-matching kind -> None
+    assert validate_vap(VAP, {"apiVersion": "v1", "kind": "Pod",
+                              "metadata": {"name": "p"}}) is None
+    # non-matching operation -> None
+    assert validate_vap(VAP, deployment(3), operation="DELETE") is None
+
+
+def test_vap_selectors_and_exclude():
+    vap = {**VAP, "spec": {**VAP["spec"],
+           "matchConstraints": {
+               "resourceRules": [{"apiGroups": ["apps"], "apiVersions": ["v1"],
+                                  "operations": ["*"], "resources": ["*"]}],
+               "objectSelector": {"matchLabels": {"validate": "yes"}}}}}
+    assert validate_vap(vap, deployment(10)) is None
+    results = validate_vap(vap, deployment(10, labels={"validate": "yes"}))
+    assert results[0].status == "fail"
+
+
+def test_vap_audit_annotations_and_match_conditions():
+    vap = {
+        "apiVersion": "admissionregistration.k8s.io/v1",
+        "kind": "ValidatingAdmissionPolicy",
+        "metadata": {"name": "with-extras"},
+        "spec": {
+            "matchConditions": [{
+                "name": "not-kube-system",
+                "expression": "request.namespace != 'kube-system'"}],
+            "variables": [{"name": "r", "expression": "object.spec.replicas"}],
+            "validations": [{"expression": "variables.r <= 5"}],
+            "auditAnnotations": [{
+                "key": "replicas-seen",
+                "valueExpression": "string(variables.r)"}],
+        },
+    }
+    results = validate_vap(vap, deployment(9))
+    assert results[0].status == "fail"
+    assert results[0].audit_annotations == {"replicas-seen": "9"}
+    # match condition excludes kube-system
+    d = deployment(9)
+    d["metadata"]["namespace"] = "kube-system"
+    results = validate_vap(vap, d)
+    assert [r.status for r in results] == ["skip"]
+
+
+def test_kind_to_resource():
+    assert kind_to_resource("Pod") == "pods"
+    assert kind_to_resource("NetworkPolicy") == "networkpolicies"
+    assert kind_to_resource("Ingress") == "ingresses"
+    assert kind_to_resource("MyCustom") == "mycustoms"
+
+
+def test_validator_compile_error_reported_once():
+    v = CelValidator([{"expression": "1 +"}])
+    [r] = v.validate(object={})
+    assert r.status == "error"
+
+
+def test_cli_apply_evaluates_vap(tmp_path, capsys):
+    """VAP docs loaded among policies are evaluated in-process
+    (commands/apply/command.go:213)."""
+    import yaml
+
+    from kyverno_tpu.cli.apply import run as apply_run
+    import argparse
+
+    pol = tmp_path / "vap.yaml"
+    pol.write_text(yaml.safe_dump(VAP))
+    res = tmp_path / "dep.yaml"
+    res.write_text(yaml.safe_dump(deployment(10)))
+    args = argparse.Namespace(
+        policies=[str(pol)], resource=[str(res)], engine="scalar",
+        audit_warn=False, detailed_results=False, output_json=True,
+        registry_fixture=None)
+    rc = apply_run(args)
+    out = capsys.readouterr().out
+    assert rc == 1
+    import json as _json
+    summary = _json.loads(out.strip().splitlines()[-1])
+    assert summary["summary"]["fail"] == 1
+    assert summary["failures"][0]["policy"] == "replica-limit"
+    assert summary["failures"][0]["message"] == "too many replicas"
+
+
+def test_kind_to_resource_vowel_y():
+    assert kind_to_resource("Gateway") == "gateways"
+    assert kind_to_resource("Policy") == "policies"

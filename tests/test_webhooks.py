@@ -151,3 +151,122 @@ def test_scalar_toggle_and_config_filter():
     out = handlers.validate(r)
     assert out["response"]["allowed"] is True
     handlers.batcher.stop()
+
+
+def test_mutate_runs_image_verification():
+    """resource/handlers.go:139-177: the mutate path runs verify-image
+    policies after mutate policies; digest patches ride the same
+    JSONPatch response, and enforce failures deny."""
+    from kyverno_tpu.images import StaticRegistry
+
+    key = "-----BEGIN PUBLIC KEY-----\nGOOD\n-----END PUBLIC KEY-----"
+    digest = "sha256:" + "cd" * 32
+    reg = StaticRegistry()
+    reg.add_image("ghcr.io/org/app:v1", digest)
+    reg.sign("ghcr.io/org/app:v1", key=key)
+    vi_policy = ClusterPolicy.from_dict({
+        "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+        "metadata": {"name": "verify-img"},
+        "spec": {"validationFailureAction": "Enforce", "rules": [{
+            "name": "check-sig",
+            "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+            "verifyImages": [{
+                "imageReferences": ["ghcr.io/org/*"],
+                "attestors": [{"entries": [{"keys": {"publicKeys": key}}]}],
+            }],
+        }]},
+    })
+    cache = PolicyCache()
+    cache.set(vi_policy)
+    handlers = build_handlers(cache, registry_client=reg)
+    req = {"request": {
+        "uid": "u-iv", "operation": "CREATE", "namespace": "default",
+        "object": {"apiVersion": "v1", "kind": "Pod",
+                   "metadata": {"name": "p", "namespace": "default"},
+                   "spec": {"containers": [
+                       {"name": "c", "image": "ghcr.io/org/app:v1"}]}},
+    }}
+    out = handlers.mutate(req)
+    assert out["response"]["allowed"] is True
+    patch = json.loads(base64.b64decode(out["response"]["patch"]))
+    values = [op.get("value") for op in patch]
+    assert f"ghcr.io/org/app:v1@{digest}" in values
+
+    # unverifiable image (wrong key in registry) => denied
+    reg2 = StaticRegistry()
+    reg2.add_image("ghcr.io/org/app:v1", digest)
+    handlers2 = build_handlers(cache, registry_client=reg2)
+    out2 = handlers2.mutate(req)
+    assert out2["response"]["allowed"] is False
+
+
+def test_audit_verify_images_does_not_block():
+    """Audit-mode verifyImages failures must not deny admission
+    (utils/block.go: only Enforce blocks)."""
+    from kyverno_tpu.images import StaticRegistry
+
+    vi_policy = ClusterPolicy.from_dict({
+        "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+        "metadata": {"name": "verify-img-audit"},
+        "spec": {"validationFailureAction": "Audit",
+                 "rules": [{
+                     "name": "check-sig",
+                     "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+                     "verifyImages": [{
+                         "imageReferences": ["ghcr.io/org/*"],
+                         "mutateDigest": False,
+                         "attestors": [{"entries": [{"keys": {
+                             "publicKeys": "-----BEGIN PUBLIC KEY-----\nX\n-----END PUBLIC KEY-----"}}]}],
+                     }],
+                 }]},
+    })
+    cache = PolicyCache()
+    cache.set(vi_policy)
+    handlers = build_handlers(cache, registry_client=StaticRegistry())
+    req = {"request": {
+        "uid": "u-audit", "operation": "CREATE", "namespace": "default",
+        "object": {"apiVersion": "v1", "kind": "Pod",
+                   "metadata": {"name": "p", "namespace": "default"},
+                   "spec": {"containers": [
+                       {"name": "c", "image": "ghcr.io/org/app:v1"}]}},
+    }}
+    out = handlers.mutate(req)
+    assert out["response"]["allowed"] is True
+
+
+def test_audit_verify_images_lands_in_reports():
+    """Audit verifyImages failures surface in the report aggregator
+    even though admission is allowed."""
+    from kyverno_tpu.cluster import ReportAggregator
+    from kyverno_tpu.images import StaticRegistry
+
+    vi_policy = ClusterPolicy.from_dict({
+        "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+        "metadata": {"name": "verify-img-audit2"},
+        "spec": {"validationFailureAction": "Audit",
+                 "rules": [{
+                     "name": "check-sig",
+                     "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+                     "verifyImages": [{
+                         "imageReferences": ["ghcr.io/org/*"],
+                         "mutateDigest": False,
+                         "attestors": [{"entries": [{"keys": {
+                             "publicKeys": "-----BEGIN PUBLIC KEY-----\nX\n-----END PUBLIC KEY-----"}}]}],
+                     }],
+                 }]},
+    })
+    cache = PolicyCache()
+    cache.set(vi_policy)
+    agg = ReportAggregator()
+    handlers = build_handlers(cache, aggregator=agg,
+                              registry_client=StaticRegistry())
+    req = {"request": {
+        "uid": "u-audit2", "operation": "CREATE", "namespace": "default",
+        "object": {"apiVersion": "v1", "kind": "Pod",
+                   "metadata": {"name": "p2", "namespace": "default"},
+                   "spec": {"containers": [
+                       {"name": "c", "image": "ghcr.io/org/app:v1"}]}},
+    }}
+    out = handlers.mutate(req)
+    assert out["response"]["allowed"] is True
+    assert agg.summary().get("error", 0) + agg.summary().get("fail", 0) >= 1
