@@ -521,18 +521,20 @@ def _method(target, name: str, args: List[Any]):
         raise no_such_overload("replace", target, *args)
     if name == "split":
         if isinstance(target, str) and len(args) in (1, 2):
+            sep = args[0]
+            if not isinstance(sep, str):
+                raise no_such_overload("split", target, *args)
+            # Go strings.Split("abc", "") -> ["a","b","c"]
+            parts = list(target) if sep == "" else target.split(sep)
             if len(args) == 2:
                 # Go strings.SplitN: n<0 all, n==0 none, n>0 at most n
                 n_limit = args[1]
                 if n_limit == 0:
                     return []
-                if n_limit < 0:
-                    return target.split(args[0])
-                parts = target.split(args[0])
-                if n_limit >= len(parts):
+                if n_limit < 0 or n_limit >= len(parts):
                     return parts
-                return parts[:n_limit - 1] + [args[0].join(parts[n_limit - 1:])]
-            return target.split(args[0])
+                return parts[:n_limit - 1] + [sep.join(parts[n_limit - 1:])]
+            return parts
         raise no_such_overload("split", target, *args)
     if name == "join":
         if isinstance(target, list):

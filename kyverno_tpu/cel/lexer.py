@@ -118,9 +118,11 @@ def _number(src: str, i: int):
         j = i + 2
         while j < n and src[j] in "0123456789abcdefABCDEF":
             j += 1
+        if j == i + 2:
+            raise CelSyntaxError(f"malformed hex literal at {i}")
         if j < n and src[j] in "uU":
-            return Token("UINT", int(src[i:j], 16), start), j + 1
-        return Token("INT", int(src[i:j], 16), start), j
+            return Token("UINT", int(src[i + 2:j], 16), start), j + 1
+        return Token("INT", int(src[i + 2:j], 16), start), j
     j = i
     is_double = False
     while j < n and src[j].isdigit():

@@ -37,9 +37,11 @@ class BackgroundScanService:
         aggregator: Optional[ReportAggregator] = None,
         mesh=None,
         batch_size: int = 4096,
+        exceptions=None,
     ) -> None:
         self.snapshot = snapshot
         self.cache = cache
+        self.exceptions = exceptions or []
         self.aggregator = aggregator or ReportAggregator()
         self.mesh = mesh
         self.batch_size = batch_size
@@ -94,7 +96,8 @@ class BackgroundScanService:
 
             _, policies = self.cache.snapshot()
             mesh = self.mesh if self.mesh is not None else make_mesh()
-            self._scanner = ShardedScanner(policies, mesh=mesh)
+            self._scanner = ShardedScanner(policies, mesh=mesh,
+                                           exceptions=self.exceptions)
             self._scanner_rev = revision
         return self._scanner
 

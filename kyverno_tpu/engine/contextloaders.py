@@ -146,7 +146,15 @@ def _load_global(ctx: Context, spec: Dict[str, Any], sources: DataSources) -> An
     name = spec.get("name", "")
     if name not in sources.global_context:
         raise ContextLoaderError(f"global context entry {name!r} not found")
-    data = sources.global_context[name]
+    try:
+        data = sources.global_context[name]
+    except KeyError:
+        raise ContextLoaderError(f"global context entry {name!r} not found")
+    except Exception as e:
+        # a present-but-failing entry (stale external API, stopped
+        # watch) is a context-load error, not silently-empty data
+        # (pkg/globalcontext/invalid/entry.go)
+        raise ContextLoaderError(f"global context entry {name!r}: {e}")
     jmes = spec.get("jmesPath")
     if jmes:
         try:

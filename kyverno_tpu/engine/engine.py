@@ -46,9 +46,13 @@ from .variables import (
 class Engine:
     """engineapi.Engine equivalent (pkg/engine/api/engine.go:17)."""
 
-    def __init__(self, data_sources: Optional[DataSources] = None, exceptions: Optional[list] = None):
+    def __init__(self, data_sources: Optional[DataSources] = None,
+                 exceptions: Optional[list] = None, background: bool = False):
         self.data_sources = data_sources or DataSources()
         self.exceptions = exceptions or []
+        # background scans ignore exceptions with spec.background=false
+        # (policy_exception_types.go:41-44)
+        self.background = background
 
     # -- public API
 
@@ -181,7 +185,7 @@ class Engine:
         if reasons:
             return None
         # exception gate (engine.go:287, exceptions.go)
-        matched_exceptions = self._matching_exceptions(pctx, rule)
+        matched_exceptions = self._matching_exceptions(pctx, rule, self.background)
         if matched_exceptions:
             names = ", ".join(matched_exceptions)
             rtype = self._rule_type(rule)
@@ -213,27 +217,76 @@ class Engine:
         finally:
             ctx.restore()
 
-    def _matching_exceptions(self, pctx: PolicyContext, rule: Rule) -> List[str]:
+    def _typed_exceptions(self):
+        """Exceptions parsed once (they arrive as dicts from YAML/CR
+        watches); cached on the engine instance."""
+        typed = getattr(self, "_typed_exc_cache", None)
+        if typed is None or len(typed) != len(self.exceptions):
+            from ..api.exception import PolicyException
+
+            typed = [e if isinstance(e, PolicyException)
+                     else PolicyException.from_dict(e)
+                     for e in self.exceptions]
+            self._typed_exc_cache = typed
+        return typed
+
+    def _exception_applies(self, exc, pctx: PolicyContext, rule: Rule,
+                           background: bool) -> bool:
+        """engine/utils/exceptions.go:13 MatchesException: the exception
+        must name the rule (wildcards allowed), its match block must
+        select the resource, and its conditions tree must hold against
+        the JSON context. Exceptions with spec.background=false are
+        ignored during background scans."""
+        if background and not exc.background:
+            return False
+        if not exc.contains(pctx.policy.name, rule.name):
+            return False
+        if exc.match:
+            pseudo = Rule.from_dict({"name": "exception", "match": exc.match})
+            if matches_resource_description(
+                pctx.resource_for_match(),
+                pseudo,
+                pctx.admission_info,
+                pctx.namespace_labels,
+                operation=pctx.operation,
+            ):
+                return False
+        if exc.conditions is not None:
+            try:
+                if not evaluate_conditions(pctx.json_context, exc.conditions):
+                    return False
+            except Exception:
+                # condition errors disqualify the exception
+                # (exceptions.go:36-41 returns nil on error)
+                return False
+        return True
+
+    def _matching_exceptions(self, pctx: PolicyContext, rule: Rule,
+                             background: bool = False) -> List[str]:
         out = []
-        for exc in self.exceptions:
-            spec = exc.get("spec", {})
-            for entry in spec.get("exceptions", []):
-                if entry.get("policyName") != pctx.policy.name:
-                    continue
-                if rule.name not in (entry.get("ruleNames") or []):
-                    continue
-                match_block = spec.get("match")
-                if match_block:
-                    pseudo = Rule.from_dict({"name": "exception", "match": match_block})
-                    if matches_resource_description(
-                        pctx.resource_for_match(),
-                        pseudo,
-                        pctx.admission_info,
-                        pctx.namespace_labels,
-                        operation=pctx.operation,
-                    ):
-                        continue
-                out.append((exc.get("metadata") or {}).get("name", "exception"))
+        for exc in self._typed_exceptions():
+            if not self._exception_applies(exc, pctx, rule, background):
+                continue
+            # podSecurity exceptions against podSecurity rules apply
+            # control-level exclusions instead of skipping the rule
+            # (validate_pss.go HasPodSecurity branch)
+            if (exc.has_pod_security() and rule.validation is not None
+                    and rule.validation.pod_security is not None):
+                continue
+            out.append(exc.name or "exception")
+        return out
+
+    def _pod_security_exclusions(self, pctx: PolicyContext, rule: Rule) -> List[Dict[str, Any]]:
+        """podSecurity controls from matching exceptions, merged into
+        the rule's own excludes (validate_pss.go exception handling).
+        The exception must fully apply (match + conditions +
+        background), same gate as a rule-skipping exception."""
+        out: List[Dict[str, Any]] = []
+        for exc in self._typed_exceptions():
+            if not exc.has_pod_security():
+                continue
+            if self._exception_applies(exc, pctx, rule, self.background):
+                out.extend(exc.pod_security)
         return out
 
     # -- validation handler (validate_resource.go)
@@ -252,7 +305,9 @@ class Engine:
         if v.pod_security is not None:
             from ..pss import validate_pod_security
 
-            return [validate_pod_security(name, v, pctx.new_resource)]
+            return [validate_pod_security(
+                name, v, pctx.new_resource,
+                extra_exclusions=self._pod_security_exclusions(pctx, rule))]
         if v.cel is not None:
             return [self._validate_cel(pctx, name, rule)]
         return [RuleResponse.rule_error(name, RULE_TYPE_VALIDATION, "invalid validation rule")]

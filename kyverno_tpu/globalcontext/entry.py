@@ -1,0 +1,130 @@
+"""Store entries (pkg/globalcontext/{k8sresource,externalapi}/entry.go).
+
+Both expose ``get() -> data | raise EntryError``. The k8s-resource
+entry projects the ClusterSnapshot live (subscription keeps a uid set
+current); the external-API entry re-executes its call when the cached
+result is older than refreshInterval, and serves the last error state
+when the call keeps failing (invalid/entry.go semantics)."""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from .types import ExternalAPICallSpec, KubernetesResourceSpec
+
+
+class EntryError(Exception):
+    pass
+
+
+class KubernetesResourceEntry:
+    def __init__(self, spec: KubernetesResourceSpec, snapshot) -> None:
+        self.spec = spec
+        self.snapshot = snapshot
+        self._lock = threading.Lock()
+        self._uids: set = set()
+        self._stopped = False
+        snapshot.subscribe(self._on_change)
+        # warm from current snapshot contents
+        for uid, res, _ in snapshot.items():
+            if self._matches(res):
+                self._uids.add(uid)
+
+    def _matches(self, res: Dict[str, Any]) -> bool:
+        from ..vap.policy import kind_to_resource
+
+        api_version = res.get("apiVersion", "")
+        group, _, version = api_version.rpartition("/")
+        if self.spec.group != group or (
+                self.spec.version and self.spec.version != version):
+            return False
+        if kind_to_resource(res.get("kind", "")) != self.spec.resource:
+            return False
+        if self.spec.namespace:
+            ns = (res.get("metadata") or {}).get("namespace", "")
+            if ns != self.spec.namespace:
+                return False
+        return True
+
+    def _on_change(self, uid: str, change: str) -> None:
+        if self._stopped:
+            return
+        with self._lock:
+            if change == "delete":
+                self._uids.discard(uid)
+                return
+            res = self.snapshot.get(uid)
+            if res is not None and self._matches(res):
+                self._uids.add(uid)
+            else:
+                self._uids.discard(uid)
+
+    def get(self) -> List[Dict[str, Any]]:
+        if self._stopped:
+            raise EntryError("entry stopped")
+        with self._lock:
+            uids = list(self._uids)
+        out = []
+        for uid in uids:
+            res = self.snapshot.get(uid)
+            if res is not None:
+                out.append(res)
+        return out
+
+    def stop(self) -> None:
+        self._stopped = True
+
+
+class ExternalApiEntry:
+    """Polled API entry. ``executor(spec) -> data`` is the pluggable
+    call (the reference goes through apicall.Execute with service URLs,
+    apiCall.go:107); refresh happens lazily when the cached value is
+    older than refreshInterval, and a ``refresh()`` hook exists for a
+    background poller loop."""
+
+    def __init__(self, spec: ExternalAPICallSpec,
+                 executor: Callable[[ExternalAPICallSpec], Any],
+                 clock=time.monotonic) -> None:
+        self.spec = spec
+        self.executor = executor
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._data: Any = None
+        self._err: Optional[str] = None
+        self._fetched_at: Optional[float] = None
+        self._stopped = False
+
+    def refresh(self) -> None:
+        try:
+            data = self.executor(self.spec)
+            with self._lock:
+                self._data = data
+                self._err = None
+                self._fetched_at = self._clock()
+        except Exception as e:
+            with self._lock:
+                self._err = str(e)
+                # a failed poll marks the entry stale-with-error but
+                # keeps the timestamp so we don't hot-loop the executor
+                self._fetched_at = self._clock()
+
+    def _stale(self) -> bool:
+        return (self._fetched_at is None
+                or self._clock() - self._fetched_at >= self.spec.refresh_interval_s)
+
+    def get(self) -> Any:
+        if self._stopped:
+            raise EntryError("entry stopped")
+        with self._lock:
+            stale = self._stale()
+        if stale:
+            self.refresh()
+        with self._lock:
+            if self._err is not None:
+                raise EntryError(f"api call failed: {self._err}")
+            return self._data
+
+    def stop(self) -> None:
+        self._stopped = True

@@ -116,7 +116,10 @@ def extract_images(
                 if not isinstance(value, str):
                     raise BadImageError(
                         f"jmespath {ex.jmespath} must produce a string")
-            key = str(entry.get(ex.key, idx)) if ex.key else str(idx)
+            # without a key field, the JSON pointer is the entry key —
+            # unique across multiple same-named (default "custom")
+            # extractors, so configs cannot overwrite each other
+            key = str(entry.get(ex.key, idx)) if ex.key else (pointer or str(idx))
             info = get_image_info(
                 value, default_registry, enable_default_registry_mutation,
                 pointer=f"{pointer}/{_escape(ex.value)}")

@@ -284,17 +284,22 @@ class Verifier:
         image = str(info)
         if self.ctx is not None:
             self.ctx.add_image_infos({"image": info.to_dict()})
+        # reference checks hoisted above the attestors branch so
+        # attestation-only rules honor them too (the reference nests
+        # these under `if len(attestors) > 0`, imageverifier.go:344 —
+        # which silently ignores skipImageReferences for
+        # attestation-only rules; deliberate fix here)
+        refs = image_references(image_verify)
+        if refs and not matches_references(refs, image):
+            return RuleResponse.rule_skip(
+                self.rule_name, RULE_TYPE_IMAGE_VERIFY,
+                f"skipping image reference image {image}"), ""
+        if matches_references(image_verify.get("skipImageReferences") or [], image):
+            self.ivm.add(image, "skip")
+            return RuleResponse.rule_skip(
+                self.rule_name, RULE_TYPE_IMAGE_VERIFY,
+                f"skipping image reference image {image}"), ""
         if attestors:
-            refs = image_references(image_verify)
-            if refs and not matches_references(refs, image):
-                return RuleResponse.rule_skip(
-                    self.rule_name, RULE_TYPE_IMAGE_VERIFY,
-                    f"skipping image reference image {image}"), ""
-            if matches_references(image_verify.get("skipImageReferences") or [], image):
-                self.ivm.add(image, "skip")
-                return RuleResponse.rule_skip(
-                    self.rule_name, RULE_TYPE_IMAGE_VERIFY,
-                    f"skipping image reference image {image}"), ""
             resp, registry_resp = self._verify_attestors(attestors, image_verify, info)
             if not resp.is_pass():
                 return resp, ""

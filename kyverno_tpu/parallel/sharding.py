@@ -51,8 +51,10 @@ class ShardedScanner:
         mesh: Optional[Mesh] = None,
         encode_cfg: Optional[EncodeConfig] = None,
         meta_cfg=None,
+        exceptions: Sequence = (),
     ):
         self.cps: CompiledPolicySet = compile_policy_set(policies, encode_cfg, meta_cfg)
+        self.exceptions = list(exceptions)
         self.mesh = mesh if mesh is not None else make_mesh()
         self.axis = self.mesh.axis_names[0]
         self._raw_fn = build_program(
@@ -115,7 +117,7 @@ class ShardedScanner:
         from ..tpu.engine import TpuEngine
 
         device_table, _ = self.scan_device(resources, namespace_labels, operations)
-        eng = TpuEngine.from_compiled(self.cps)
+        eng = TpuEngine(cps=self.cps, exceptions=self.exceptions)
         return eng.assemble(device_table, resources, namespace_labels, operations)
 
     def put(self, batch: Dict[str, Any]) -> Dict[str, jnp.ndarray]:
@@ -151,7 +153,8 @@ class ShardedScanner:
         n = len(resources)
         stats = {"encode_s": 0.0, "device_s": 0.0, "host_s": 0.0,
                  "host_cells": 0, "tiles": 0, "tile": tile}
-        eng = TpuEngine.from_compiled(self.cps) if complete_host else None
+        eng = (TpuEngine(cps=self.cps, exceptions=self.exceptions)
+               if complete_host else None)
         tables = []
         pending = []  # (device verdicts future, tile slice, n_valid)
 

@@ -277,11 +277,14 @@ def _excluded(violation: Violation, resource: Dict[str, Any], excludes: List[Dic
     return False
 
 
-def validate_pod_security(rule_name: str, validation, resource: Dict[str, Any]) -> RuleResponse:
-    """Entry point used by the engine for validate.podSecurity rules."""
+def validate_pod_security(rule_name: str, validation, resource: Dict[str, Any],
+                          extra_exclusions=None) -> RuleResponse:
+    """Entry point used by the engine for validate.podSecurity rules.
+    ``extra_exclusions``: podSecurity controls contributed by matching
+    PolicyExceptions (validate_pss.go HasPodSecurity branch)."""
     ps = validation.pod_security or {}
     level = ps.get("level", "baseline")
-    excludes = ps.get("exclude") or []
+    excludes = (ps.get("exclude") or []) + list(extra_exclusions or [])
     violations = [v for v in evaluate_pss(level, resource) if not _excluded(v, resource, excludes)]
     if not violations:
         return RuleResponse.rule_pass(rule_name, RULE_TYPE_VALIDATION, "")

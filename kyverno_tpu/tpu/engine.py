@@ -104,10 +104,24 @@ class TpuEngine:
         encode_cfg: Optional[EncodeConfig] = None,
         meta_cfg: Optional[MetaConfig] = None,
         cps: Optional[CompiledPolicySet] = None,
+        exceptions: Sequence[Any] = (),
     ):
         self.cps: CompiledPolicySet = cps if cps is not None \
             else compile_policy_set(policies, encode_cfg, meta_cfg)
-        self.scalar = ScalarEngine()
+        self.scalar = ScalarEngine(exceptions=list(exceptions), background=True)
+        # rules named by any PolicyException evaluate on the host: the
+        # exception's match/conditions are per-resource dynamic state
+        # the compiled program does not model (engine/exceptions.go)
+        self._exception_rules: set = set()
+        if exceptions:
+            from ..api.exception import PolicyException
+
+            typed = [e if isinstance(e, PolicyException)
+                     else PolicyException.from_dict(e) for e in exceptions]
+            for ri, entry in enumerate(self.cps.rules):
+                if any(t.contains(entry.policy_name, entry.rule_name)
+                       for t in typed):
+                    self._exception_rules.add(ri)
 
     @classmethod
     def from_compiled(cls, cps: CompiledPolicySet) -> "TpuEngine":
@@ -160,7 +174,7 @@ class TpuEngine:
         # which (policy, resource) pairs need the scalar engine?
         host_cells: Dict[Tuple[int, int], None] = {}
         for ri, entry in enumerate(self.cps.rules):
-            if entry.device_row is None:
+            if entry.device_row is None or ri in self._exception_rules:
                 for ci in range(n):
                     host_cells[(entry.policy_idx, ci)] = None
             else:
@@ -183,7 +197,8 @@ class TpuEngine:
         for ri, entry in enumerate(self.cps.rules):
             for (pi, ci), verdicts in cache.items():
                 if pi == entry.policy_idx and entry.rule_name in verdicts:
-                    if entry.device_row is None or total[ri, ci] == HOST:
+                    if (entry.device_row is None or ri in self._exception_rules
+                            or total[ri, ci] == HOST):
                         total[ri, ci] = verdicts[entry.rule_name]
 
         return ScanResult(

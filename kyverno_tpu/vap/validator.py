@@ -25,6 +25,7 @@ class _LazyVars(dict):
         super().__init__()
         self._defs = {d.get("name", ""): d.get("expression", "") for d in defs}
         self._env = env
+        self._evaluating: set = set()
 
     def __contains__(self, key) -> bool:
         return key in self._defs or dict.__contains__(self, key)
@@ -33,7 +34,15 @@ class _LazyVars(dict):
         if not dict.__contains__(self, key):
             if key not in self._defs:
                 raise CelError(f"undeclared variable 'variables.{key}'")
-            value = cel_compile(self._defs[key]).evaluate(self._env)
+            if key in self._evaluating:
+                # k8s rejects self/forward references at compile time;
+                # surface cycles as a CEL error, not RecursionError
+                raise CelError(f"cyclic reference in variables.{key}")
+            self._evaluating.add(key)
+            try:
+                value = cel_compile(self._defs[key]).evaluate(self._env)
+            finally:
+                self._evaluating.discard(key)
             dict.__setitem__(self, key, value)
         return dict.__getitem__(self, key)
 

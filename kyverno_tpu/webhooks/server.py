@@ -60,6 +60,7 @@ class Handlers:
         metrics: Optional[MetricsRegistry] = None,
         registry_client=None,
         iv_cache=None,
+        exceptions=None,
     ) -> None:
         self.cache = cache
         self.snapshot = snapshot
@@ -72,7 +73,8 @@ class Handlers:
             from ..images import ImageVerifyCache
             iv_cache = ImageVerifyCache()
         self.iv_cache = iv_cache
-        self.scalar = ScalarEngine()
+        self.exceptions = exceptions or []
+        self.scalar = ScalarEngine(exceptions=self.exceptions)
         self._engines: Dict[int, TpuEngine] = {}
         self._lock = threading.Lock()
         self.batcher = MicroBatcher(self._evaluate_batch, max_batch, max_wait_ms)
@@ -84,7 +86,7 @@ class Handlers:
         with self._lock:
             eng = self._engines.get(rev)
             if eng is None:
-                eng = TpuEngine(policies)
+                eng = TpuEngine(policies, exceptions=self.exceptions)
                 self._engines.clear()  # single live revision
                 self._engines[rev] = eng
         return rev, eng
@@ -178,6 +180,30 @@ class Handlers:
                                                 {"path": "validate"})
         if block_msgs:
             return _response(req, False, "; ".join(block_msgs))
+        return _response(req, True, "")
+
+    def validate_exception(self, review: Dict[str, Any]) -> Dict[str, Any]:
+        """PolicyException CR validation webhook
+        (pkg/webhooks/exception, pkg/validation/exception)."""
+        from ..api.exception import PolicyException
+
+        req = review.get("request") or {}
+        obj = req.get("object") or {}
+        errs = PolicyException.from_dict(obj).validate()
+        if errs:
+            return _response(req, False, "; ".join(errs))
+        return _response(req, True, "")
+
+    def validate_globalcontext(self, review: Dict[str, Any]) -> Dict[str, Any]:
+        """GlobalContextEntry CR validation webhook
+        (pkg/webhooks/globalcontext)."""
+        from ..globalcontext import GlobalContextEntry
+
+        req = review.get("request") or {}
+        obj = req.get("object") or {}
+        errs = GlobalContextEntry.from_dict(obj).validate()
+        if errs:
+            return _response(req, False, "; ".join(errs))
         return _response(req, True, "")
 
     def _filtered(self, payload: AdmissionPayload) -> bool:
@@ -339,6 +365,10 @@ class AdmissionServer:
                     out = outer.handlers.validate(review, failure_policy)
                 elif base == "mutate":
                     out = outer.handlers.mutate(review, failure_policy)
+                elif base == "exception":
+                    out = outer.handlers.validate_exception(review)
+                elif base == "globalcontext":
+                    out = outer.handlers.validate_globalcontext(review)
                 else:
                     self.send_response(404)
                     self.end_headers()

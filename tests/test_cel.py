@@ -222,3 +222,18 @@ def test_split_limit_go_semantics():
     assert ev('"a,b,c".split(",", 0)') == []
     assert ev('"a,b,c".split(",", 2)') == ["a", "b,c"]
     assert ev('"a,b,c".split(",", 5)') == ["a", "b", "c"]
+
+
+def test_split_empty_separator_and_hex_edge():
+    assert ev('"abc".split("")') == ["a", "b", "c"]
+    with pytest.raises(CelSyntaxError):
+        compile("0x + 1")
+
+
+def test_cyclic_variables_is_cel_error():
+    from kyverno_tpu.vap import CelValidator
+
+    v = CelValidator([{"expression": "variables.a > 0"}],
+                     variables=[{"name": "a", "expression": "variables.a"}])
+    [r] = v.validate(object={})
+    assert r.status == "error" and "cyclic" in r.message

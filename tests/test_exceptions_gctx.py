@@ -1,0 +1,314 @@
+"""Typed PolicyException (with conditions/podSecurity/background) and
+the GlobalContextEntry store (policy_exception_types.go,
+global_context_entry_types.go, globalcontext/store)."""
+
+import pytest
+
+from kyverno_tpu.api.exception import PolicyException, is_exception_document
+from kyverno_tpu.api.policy import ClusterPolicy
+from kyverno_tpu.engine.context import Context
+from kyverno_tpu.engine.contextloaders import DataSources
+from kyverno_tpu.engine.engine import Engine
+from kyverno_tpu.engine.policycontext import PolicyContext
+from kyverno_tpu.cluster.snapshot import ClusterSnapshot
+from kyverno_tpu.globalcontext import (
+    EntryError,
+    ExternalApiEntry,
+    GlobalContextEntry,
+    GlobalContextStore,
+)
+
+
+def pod(name="p", ns="default", labels=None, privileged=True):
+    return {
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": name, "namespace": ns, "labels": labels or {}},
+        "spec": {"containers": [{"name": "c", "image": "nginx",
+                                 "securityContext": {"privileged": privileged}}]},
+    }
+
+
+POLICY = ClusterPolicy.from_dict({
+    "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+    "metadata": {"name": "no-priv"},
+    "spec": {"validationFailureAction": "Enforce", "rules": [{
+        "name": "check-privileged",
+        "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+        "validate": {"message": "privileged denied",
+                     "pattern": {"spec": {"containers": [
+                         {"=(securityContext)": {"=(privileged)": "false"}}]}}},
+    }]},
+})
+
+
+def exc_doc(name="exc", rule_names=("check-privileged",), match=None,
+            conditions=None, background=None, pod_security=None):
+    spec = {"exceptions": [{"policyName": "no-priv",
+                            "ruleNames": list(rule_names)}]}
+    if match is not None:
+        spec["match"] = match
+    if conditions is not None:
+        spec["conditions"] = conditions
+    if background is not None:
+        spec["background"] = background
+    if pod_security is not None:
+        spec["podSecurity"] = pod_security
+    return {"apiVersion": "kyverno.io/v2beta1", "kind": "PolicyException",
+            "metadata": {"name": name}, "spec": spec}
+
+
+def run_validate(resource, exceptions):
+    ctx = Context()
+    ctx.add_resource(resource)
+    pctx = PolicyContext(policy=POLICY, new_resource=resource, json_context=ctx)
+    return Engine(exceptions=exceptions).validate(pctx)
+
+
+def test_exception_wildcard_rule_names():
+    resp = run_validate(pod(), [exc_doc(rule_names=["check-*"])])
+    [rr] = resp.policy_response.rules
+    assert rr.status == "skip" and "exc" in rr.message
+
+
+def test_exception_match_block_gates_resources():
+    """Weak #4 from round 2: the exception's match block must actually
+    select the resource for the skip to apply."""
+    match = {"any": [{"resources": {"kinds": ["Pod"],
+                                    "namespaces": ["allowed-ns"]}}]}
+    # resource in a different namespace: exception does NOT apply
+    resp = run_validate(pod(ns="other"), [exc_doc(match=match)])
+    [rr] = resp.policy_response.rules
+    assert rr.status == "fail"
+    # matching namespace: exception applies
+    resp = run_validate(pod(ns="allowed-ns"), [exc_doc(match=match)])
+    [rr] = resp.policy_response.rules
+    assert rr.status == "skip"
+    # name wildcard in match block
+    match_names = {"any": [{"resources": {"kinds": ["Pod"], "names": ["legacy-*"]}}]}
+    resp = run_validate(pod(name="legacy-app"), [exc_doc(match=match_names)])
+    assert resp.policy_response.rules[0].status == "skip"
+    resp = run_validate(pod(name="new-app"), [exc_doc(match=match_names)])
+    assert resp.policy_response.rules[0].status == "fail"
+
+
+def test_exception_conditions_tree():
+    """policy_exception_types.go:70-73: conditions evaluated against
+    the JSON context decide exception applicability."""
+    conditions = {"all": [{
+        "key": "{{ request.object.metadata.labels.exempt || '' }}",
+        "operator": "Equals", "value": "true"}]}
+    resp = run_validate(pod(labels={"exempt": "true"}),
+                        [exc_doc(conditions=conditions)])
+    assert resp.policy_response.rules[0].status == "skip"
+    resp = run_validate(pod(labels={}), [exc_doc(conditions=conditions)])
+    assert resp.policy_response.rules[0].status == "fail"
+
+
+def test_exception_background_flag():
+    ctx = Context()
+    res = pod()
+    ctx.add_resource(res)
+    pctx = PolicyContext(policy=POLICY, new_resource=res, json_context=ctx)
+    eng = Engine(exceptions=[exc_doc(background=False)])
+    rule = POLICY.get_rules()[0]
+    assert eng._matching_exceptions(pctx, rule) == ["exc"]
+    assert eng._matching_exceptions(pctx, rule, background=True) == []
+
+
+def test_exception_pod_security_controls():
+    """A podSecurity exception on a podSecurity rule applies
+    control-level exclusions instead of skipping the rule."""
+    pss_policy = ClusterPolicy.from_dict({
+        "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+        "metadata": {"name": "no-priv"},
+        "spec": {"rules": [{
+            "name": "check-privileged",
+            "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+            "validate": {"podSecurity": {"level": "baseline",
+                                         "version": "latest"}},
+        }]},
+    })
+    bad = pod(privileged=True)
+    ctx = Context()
+    ctx.add_resource(bad)
+    pctx = PolicyContext(policy=pss_policy, new_resource=bad, json_context=ctx)
+    # without exception: fails on Privileged Containers
+    resp = Engine().validate(pctx)
+    assert resp.policy_response.rules[0].status == "fail"
+    # exception excluding the control: passes, NOT skipped
+    exc = exc_doc(pod_security=[{"controlName": "Privileged Containers",
+                                 "images": ["*"]}])
+    ctx2 = Context()
+    ctx2.add_resource(bad)
+    pctx2 = PolicyContext(policy=pss_policy, new_resource=bad, json_context=ctx2)
+    resp = Engine(exceptions=[exc]).validate(pctx2)
+    assert resp.policy_response.rules[0].status == "pass"
+
+
+def test_exception_validation():
+    assert PolicyException.from_dict(exc_doc()).validate() == []
+    bad = {"apiVersion": "kyverno.io/v2beta1", "kind": "PolicyException",
+           "metadata": {"name": "x"}, "spec": {"exceptions": [{}]}}
+    errs = PolicyException.from_dict(bad).validate()
+    assert any("policyName" in e for e in errs)
+    assert any("ruleNames" in e for e in errs)
+    # background=true + user info in match is rejected
+    ud = exc_doc(match={"any": [{"subjects": [{"kind": "User", "name": "a"}]}]})
+    errs = PolicyException.from_dict(ud).validate()
+    assert any("background" in e for e in errs)
+    assert is_exception_document(exc_doc())
+
+
+# ---------------------------------------------------------------------------
+# GlobalContext
+
+
+def test_gctx_k8s_resource_entry_tracks_snapshot():
+    snap = ClusterSnapshot()
+    store = GlobalContextStore(snapshot=snap)
+    snap.upsert({"apiVersion": "apps/v1", "kind": "Deployment",
+                 "metadata": {"name": "d1", "namespace": "prod"}})
+    errs = store.apply({
+        "apiVersion": "kyverno.io/v2alpha1", "kind": "GlobalContextEntry",
+        "metadata": {"name": "deployments"},
+        "spec": {"kubernetesResource": {
+            "group": "apps", "version": "v1", "resource": "deployments",
+            "namespace": "prod"}}})
+    assert errs == []
+    assert "deployments" in store
+    assert [d["metadata"]["name"] for d in store["deployments"]] == ["d1"]
+    # live updates
+    snap.upsert({"apiVersion": "apps/v1", "kind": "Deployment",
+                 "metadata": {"name": "d2", "namespace": "prod"}})
+    snap.upsert({"apiVersion": "apps/v1", "kind": "Deployment",
+                 "metadata": {"name": "other-ns", "namespace": "dev"}})
+    assert sorted(d["metadata"]["name"] for d in store["deployments"]) == ["d1", "d2"]
+    snap.delete({"apiVersion": "apps/v1", "kind": "Deployment",
+                 "metadata": {"name": "d1", "namespace": "prod"}})
+    assert [d["metadata"]["name"] for d in store["deployments"]] == ["d2"]
+
+
+def test_gctx_external_api_entry_polls_and_staleness():
+    calls = {"n": 0}
+    now = [0.0]
+
+    def executor(spec):
+        calls["n"] += 1
+        if calls["n"] == 3:
+            raise RuntimeError("upstream down")
+        return {"seen": calls["n"]}
+
+    entry = ExternalApiEntry.__new__(ExternalApiEntry)
+    from kyverno_tpu.globalcontext.types import ExternalAPICallSpec
+    entry.__init__(ExternalAPICallSpec(url_path="/x", refresh_interval_s=10),
+                   executor, clock=lambda: now[0])
+    assert entry.get() == {"seen": 1}
+    assert entry.get() == {"seen": 1}  # cached within interval
+    now[0] = 11.0
+    assert entry.get() == {"seen": 2}  # refreshed
+    now[0] = 22.0
+    with pytest.raises(EntryError):   # failed poll -> error state
+        entry.get()
+    now[0] = 33.0
+    assert entry.get() == {"seen": 4}  # recovers
+
+
+def test_gctx_feeds_global_reference_loader():
+    snap = ClusterSnapshot()
+    store = GlobalContextStore(snapshot=snap)
+    snap.upsert({"apiVersion": "v1", "kind": "ConfigMap",
+                 "metadata": {"name": "cm", "namespace": "default"},
+                 "data": {"limit": "5"}})
+    store.apply({
+        "apiVersion": "kyverno.io/v2alpha1", "kind": "GlobalContextEntry",
+        "metadata": {"name": "cms"},
+        "spec": {"kubernetesResource": {
+            "group": "", "version": "v1", "resource": "configmaps"}}})
+    policy = ClusterPolicy.from_dict({
+        "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+        "metadata": {"name": "use-gctx"},
+        "spec": {"rules": [{
+            "name": "limit-check",
+            "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+            "context": [{"name": "cmlimit",
+                         "globalReference": {"name": "cms",
+                                             "jmesPath": "[0].data.limit"}}],
+            "validate": {"message": "limit is {{ cmlimit }}",
+                         "deny": {"conditions": {"all": [{
+                             "key": "{{ cmlimit }}",
+                             "operator": "Equals", "value": "5"}]}}},
+        }]},
+    })
+    ctx = Context()
+    res = pod()
+    ctx.add_resource(res)
+    pctx = PolicyContext(policy=policy, new_resource=res, json_context=ctx)
+    eng = Engine(data_sources=DataSources(global_context=store))
+    [rr] = eng.validate(pctx).policy_response.rules
+    assert rr.status == "fail"  # deny condition met via gctx value
+    # entry missing -> context-load error
+    store.delete("cms")
+    ctx2 = Context()
+    ctx2.add_resource(res)
+    pctx2 = PolicyContext(policy=policy, new_resource=res, json_context=ctx2)
+    [rr] = eng.validate(pctx2).policy_response.rules
+    assert rr.status == "error" and "not found" in rr.message
+
+
+def test_gctx_validation():
+    e = GlobalContextEntry.from_dict({
+        "metadata": {"name": "x"},
+        "spec": {}})
+    assert any("exactly one" in m for m in e.validate())
+    both = GlobalContextEntry.from_dict({
+        "metadata": {"name": "x"},
+        "spec": {"kubernetesResource": {"version": "v1", "resource": "pods"},
+                 "apiCall": {"urlPath": "/x"}}})
+    assert any("cannot have both" in m for m in both.validate())
+    ok = GlobalContextEntry.from_dict({
+        "metadata": {"name": "x"},
+        "spec": {"apiCall": {"urlPath": "/api/v1/pods",
+                             "refreshInterval": "30s"}}})
+    assert ok.validate() == []
+    assert ok.api_call.refresh_interval_s == 30.0
+
+
+def test_tpu_engine_routes_exception_rules_to_host():
+    """Rules named by exceptions evaluate on the host (per-resource
+    dynamic state the device program does not model) — verdicts match
+    the scalar engine including the per-resource skip."""
+    from kyverno_tpu.tpu.engine import TpuEngine, VERDICT_NAMES
+
+    match = {"any": [{"resources": {"kinds": ["Pod"], "names": ["legacy-*"]}}]}
+    exc = exc_doc(match=match)
+    eng = TpuEngine([POLICY], exceptions=[exc])
+    resources = [pod(name="legacy-app"), pod(name="new-app")]
+    result = eng.scan(resources)
+    row = result.rules.index(("no-priv", "check-privileged"))
+    assert VERDICT_NAMES[int(result.verdicts[row, 0])] == "skip"
+    assert VERDICT_NAMES[int(result.verdicts[row, 1])] == "fail"
+
+
+def test_pod_security_exclusion_requires_conditions_to_hold():
+    """A disqualified podSecurity exception (conditions false) must not
+    excuse violations."""
+    pss_policy = ClusterPolicy.from_dict({
+        "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+        "metadata": {"name": "no-priv"},
+        "spec": {"rules": [{
+            "name": "check-privileged",
+            "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+            "validate": {"podSecurity": {"level": "baseline",
+                                         "version": "latest"}},
+        }]},
+    })
+    exc = exc_doc(pod_security=[{"controlName": "Privileged Containers",
+                                 "images": ["*"]}],
+                  conditions={"all": [{"key": "1", "operator": "Equals",
+                                       "value": "2"}]})
+    bad = pod(privileged=True)
+    ctx = Context()
+    ctx.add_resource(bad)
+    pctx = PolicyContext(policy=pss_policy, new_resource=bad, json_context=ctx)
+    resp = Engine(exceptions=[exc]).validate(pctx)
+    assert resp.policy_response.rules[0].status == "fail"

@@ -112,9 +112,14 @@ def test_extract_deployment_and_custom_configs():
     out = extract_images(dep)
     assert out["containers"]["c"].pointer == "/spec/template/spec/containers/0/image"
     # custom extractor overrides the registered ones for that kind
-    task = {"kind": "Task", "spec": {"steps": [{"ref": "img.io/t:1"}]}}
-    out = extract_images(task, configs={"Task": [{"path": "/spec/steps/*/ref"}]})
-    assert str(out["custom"]["0"]) == "img.io/t:1"
+    task = {"kind": "Task", "spec": {"steps": [{"ref": "img.io/t:1"}],
+                                     "sidecars": [{"img": "img.io/s:1"}]}}
+    out = extract_images(task, configs={"Task": [
+        {"path": "/spec/steps/*/ref"}, {"path": "/spec/sidecars/*/img"}]})
+    # keyless custom extractors key by JSON pointer: two unnamed
+    # configs must not overwrite each other
+    values = sorted(str(i) for i in out["custom"].values())
+    assert values == ["img.io/s:1", "img.io/t:1"]
 
 
 # ---------------------------------------------------------------------------
@@ -394,3 +399,13 @@ def test_rule_type_is_image_verify_for_exception_and_errors():
     resp = eng.verify_and_patch_images(pctx, registry_client=make_registry())
     [rr] = resp.policy_response.rules
     assert rr.status == "skip" and rr.rule_type == "ImageVerify"
+
+
+def test_skip_image_references_applies_to_attestation_only_rules():
+    reg = make_registry()
+    iv = {"imageReferences": ["ghcr.io/org/*"], "mutateDigest": False,
+          "skipImageReferences": ["ghcr.io/org/app*"],
+          "attestations": [{"type": "https://slsa.dev/provenance/v0.2"}]}
+    resp = run(vi_policy(iv), pod(), reg)
+    [rr] = resp.policy_response.rules
+    assert rr.status == "skip"
