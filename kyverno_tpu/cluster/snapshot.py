@@ -67,6 +67,15 @@ class ClusterSnapshot:
     def subscribe(self, fn: Callable[[str, str], None]) -> None:
         self._subscribers.append(fn)
 
+    def unsubscribe(self, fn: Callable[[str, str], None]) -> None:
+        """Detach a watcher (informer handler removal); long-lived
+        subscribers like GlobalContext entries must unsubscribe on
+        stop or every reconcile leaks a callback."""
+        try:
+            self._subscribers.remove(fn)
+        except ValueError:
+            pass
+
     # -- reads
 
     def get(self, uid: str) -> Optional[Dict[str, Any]]:

@@ -1,0 +1,227 @@
+"""Webhook-configuration generation from the live policy set.
+
+Mirrors pkg/controllers/webhook/controller.go: the served webhook
+surface is derived from the policies in the cache — one webhook per
+failurePolicy class (Ignore -> /validate/ignore fails open, Fail ->
+/validate/fail fails closed, controller.go:851-881), plus fine-grained
+per-policy webhooks for policies annotated with a custom webhook
+configuration; rules merge each policy's matched kinds into
+(group, version) -> resource sets with wildcard support
+(utils.go:23 webhook struct, :76 buildRulesWithOperations). Reconcile
+runs on policy-cache revision changes; the produced configuration
+dicts are *Validating/MutatingWebhookConfiguration*-shaped and are
+handed to a pluggable sink (in-memory for tests, a k8s client in a
+cluster)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Set, Tuple
+
+from ..api.policy import ClusterPolicy
+from ..vap.policy import kind_to_resource
+from .policycache import PolicyCache
+
+DEFAULT_TIMEOUT = 10  # seconds — webhook/controller.go:52
+
+# group resolution for the built-in kinds (no discovery offline)
+_KIND_GROUPS = {
+    "Deployment": "apps", "DaemonSet": "apps", "StatefulSet": "apps",
+    "ReplicaSet": "apps", "Job": "batch", "CronJob": "batch",
+    "Ingress": "networking.k8s.io", "NetworkPolicy": "networking.k8s.io",
+    "Role": "rbac.authorization.k8s.io",
+    "RoleBinding": "rbac.authorization.k8s.io",
+    "ClusterRole": "rbac.authorization.k8s.io",
+    "ClusterRoleBinding": "rbac.authorization.k8s.io",
+    "HorizontalPodAutoscaler": "autoscaling",
+    "PodDisruptionBudget": "policy",
+    "CustomResourceDefinition": "apiextensions.k8s.io",
+}
+
+_CLUSTER_KINDS = {"Namespace", "Node", "PersistentVolume", "ClusterRole",
+                  "ClusterRoleBinding", "CustomResourceDefinition"}
+
+FINE_GRAINED_ANNOTATION = "kyverno.io/custom-webhook-configuration"
+
+
+def _parse_kind(kind: str) -> Tuple[str, str, str]:
+    """Kind selector -> (group, version, resource-plural[/subresource]),
+    reusing the engine's ParseKindSelector port (utils/kube.py) so
+    'Pod/exec', 'apps/v1/Deployment', 'v1/Pod' and dotted subresource
+    forms all resolve consistently."""
+    from ..utils.kube import parse_kind_selector
+
+    g, v, k, sub = parse_kind_selector(kind)
+    resource = "*" if k == "*" else kind_to_resource(k)
+    if sub and sub != "*":
+        resource = f"{resource}/{sub}"
+    if g == "*" and k != "*":
+        # bare kinds resolve their group from the builtin table (core
+        # group otherwise); explicit groups pass through
+        g = _KIND_GROUPS.get(k, "")
+    if v == "*" and g == "" and k in _KIND_GROUPS:
+        pass  # non-core builtin with unspecified version keeps "*"
+    return g, v, resource
+
+
+def _policy_kinds(policy: ClusterPolicy, kinds_filter) -> Set[str]:
+    out: Set[str] = set()
+    for rule in policy.get_rules():
+        if not kinds_filter(rule):
+            continue
+        for rf in (rule.match.any or []) + (rule.match.all or []):
+            out.update(rf.resources.kinds or [])
+        out.update(rule.match.resources.kinds or [])
+    return out
+
+
+class Webhook:
+    """utils.go:23 — rule aggregation per failurePolicy class."""
+
+    def __init__(self, failure_policy: str, timeout: int = DEFAULT_TIMEOUT,
+                 policy_name: str = ""):
+        self.failure_policy = failure_policy  # "Ignore" | "Fail"
+        self.timeout = timeout
+        self.policy_name = policy_name        # fine-grained webhooks
+        self.rules: Dict[Tuple[str, str, str], Set[str]] = {}
+
+    def merge_kind(self, kind: str) -> None:
+        g, v, resource = _parse_kind(kind)
+        scope = "*"  # scopeType: without discovery both scopes are served
+        key = (g, v, scope)
+        self.rules.setdefault(key, set()).add(resource)
+
+    def is_empty(self) -> bool:
+        return not self.rules
+
+    def build_rules(self, operations: Sequence[str]) -> List[Dict[str, Any]]:
+        out = []
+        for (g, v, scope), resources in self.rules.items():
+            resources = set(resources)
+            # pods imply pods/ephemeralcontainers (utils.go:81-84)
+            if g in ("", "*") and v in ("v1", "*") and (
+                    "pods" in resources or "*" in resources):
+                resources.add("pods/ephemeralcontainers")
+            out.append({
+                "apiGroups": [g], "apiVersions": [v],
+                "resources": sorted(resources), "scope": scope,
+                "operations": list(operations),
+            })
+        out.sort(key=lambda r: (r["apiGroups"], r["apiVersions"], r["resources"]))
+        return out
+
+
+class WebhookConfigGenerator:
+    """Builds the desired webhook configurations from a PolicyCache and
+    keeps a sink reconciled as the cache revision moves."""
+
+    def __init__(
+        self,
+        cache: PolicyCache,
+        server: str = "kyverno-svc.kyverno.svc",
+        timeout: int = DEFAULT_TIMEOUT,
+        sink: Optional[Callable[[str, Dict[str, Any]], None]] = None,
+    ):
+        self.cache = cache
+        self.server = server
+        self.timeout = timeout
+        self.sink = sink
+        self._lock = threading.Lock()
+        self._last_rev = -1
+        self.configs: Dict[str, Dict[str, Any]] = {}
+
+    # -- builders (controller.go:838 buildResourceValidatingWebhookConfiguration)
+
+    def _build(self, kind_name: str, kinds_filter, path_base: str,
+               ca_bundle: str) -> Dict[str, Any]:
+        _, policies = self.cache.snapshot()
+        ignore = Webhook("Ignore", self.timeout)
+        fail = Webhook("Fail", self.timeout)
+        fine_grained: List[Webhook] = []
+        for p in policies:
+            kinds = _policy_kinds(p, kinds_filter)
+            if not kinds:
+                continue
+            fp = "Ignore" if (p.spec.failure_policy or "Fail") == "Ignore" else "Fail"
+            if p.annotations.get(FINE_GRAINED_ANNOTATION) == "true":
+                wh = Webhook(fp, self.timeout, policy_name=p.name)
+                for k in kinds:
+                    wh.merge_kind(k)
+                fine_grained.append(wh)
+                continue
+            target = ignore if fp == "Ignore" else fail
+            for k in kinds:
+                target.merge_kind(k)
+
+        webhooks = []
+        for wh in [ignore, fail] + fine_grained:
+            if wh.is_empty():
+                continue
+            suffix = wh.failure_policy.lower()
+            path = f"{path_base}/{suffix}"
+            name = f"{kind_name}-{suffix}.kyverno.svc"
+            if wh.policy_name:
+                path += f"/{wh.policy_name}"
+                name = f"{kind_name}-{suffix}-{wh.policy_name}.kyverno.svc"
+            webhooks.append({
+                "name": name,
+                "clientConfig": {
+                    "url": f"https://{self.server}{path}",
+                    "caBundle": ca_bundle,
+                },
+                "rules": wh.build_rules(["CREATE", "UPDATE", "DELETE", "CONNECT"]),
+                "failurePolicy": wh.failure_policy,
+                "timeoutSeconds": min(wh.timeout, 30),
+                "sideEffects": "NoneOnDryRun",
+                "admissionReviewVersions": ["v1"],
+            })
+        return {
+            "apiVersion": "admissionregistration.k8s.io/v1",
+            "kind": ("ValidatingWebhookConfiguration" if "validate" in path_base
+                     else "MutatingWebhookConfiguration"),
+            "metadata": {"name": f"kyverno-{kind_name}-webhook-cfg"},
+            "webhooks": webhooks,
+        }
+
+    def build_validating(self, ca_bundle: str = "") -> Dict[str, Any]:
+        return self._build(
+            "resource-validating",
+            lambda r: r.has_validate() or r.has_generate(),
+            "/validate", ca_bundle)
+
+    def build_mutating(self, ca_bundle: str = "") -> Dict[str, Any]:
+        return self._build(
+            "resource-mutating",
+            lambda r: r.has_mutate() or r.has_verify_images(),
+            "/mutate", ca_bundle)
+
+    # -- reconcile loop body
+
+    def reconcile(self, ca_bundle: str = "") -> bool:
+        """Rebuild when the policy-cache revision moved. Returns True
+        when the served surface changed."""
+        rev = self.cache.revision
+        with self._lock:
+            if rev == self._last_rev:
+                return False
+            validating = self.build_validating(ca_bundle)
+            mutating = self.build_mutating(ca_bundle)
+            changed = (validating != self.configs.get("validating")
+                       or mutating != self.configs.get("mutating"))
+            self.configs = {"validating": validating, "mutating": mutating}
+            self._last_rev = rev
+        if changed and self.sink is not None:
+            self.sink("validating", validating)
+            self.sink("mutating", mutating)
+        return changed
+
+    def serves(self, kind: str, phase: str = "validating") -> bool:
+        """Would the current configuration send this kind to us?"""
+        cfg = self.configs.get(phase) or {}
+        _, _, resource = _parse_kind(kind)
+        for wh in cfg.get("webhooks", []):
+            for rule in wh.get("rules", []):
+                if "*" in rule["resources"] or resource in rule["resources"] \
+                        or f"{resource}/ephemeralcontainers" in rule["resources"]:
+                    return True
+        return False

@@ -144,8 +144,6 @@ def _load_image_registry(ctx: Context, spec: Dict[str, Any], sources: DataSource
 
 def _load_global(ctx: Context, spec: Dict[str, Any], sources: DataSources) -> Any:
     name = spec.get("name", "")
-    if name not in sources.global_context:
-        raise ContextLoaderError(f"global context entry {name!r} not found")
     try:
         data = sources.global_context[name]
     except KeyError:

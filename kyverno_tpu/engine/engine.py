@@ -220,15 +220,16 @@ class Engine:
     def _typed_exceptions(self):
         """Exceptions parsed once (they arrive as dicts from YAML/CR
         watches); cached on the engine instance."""
-        typed = getattr(self, "_typed_exc_cache", None)
-        if typed is None or len(typed) != len(self.exceptions):
+        key = tuple(id(e) for e in self.exceptions)
+        cached = getattr(self, "_typed_exc_cache", None)
+        if cached is None or cached[0] != key:
             from ..api.exception import PolicyException
 
             typed = [e if isinstance(e, PolicyException)
                      else PolicyException.from_dict(e)
                      for e in self.exceptions]
-            self._typed_exc_cache = typed
-        return typed
+            self._typed_exc_cache = (key, typed)
+        return self._typed_exc_cache[1]
 
     def _exception_applies(self, exc, pctx: PolicyContext, rule: Rule,
                            background: bool) -> bool:

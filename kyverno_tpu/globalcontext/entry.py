@@ -75,6 +75,9 @@ class KubernetesResourceEntry:
 
     def stop(self) -> None:
         self._stopped = True
+        unsub = getattr(self.snapshot, "unsubscribe", None)
+        if unsub is not None:
+            unsub(self._on_change)
 
 
 class ExternalApiEntry:

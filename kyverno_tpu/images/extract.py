@@ -116,12 +116,14 @@ def extract_images(
                 if not isinstance(value, str):
                     raise BadImageError(
                         f"jmespath {ex.jmespath} must produce a string")
-            # without a key field, the JSON pointer is the entry key —
-            # unique across multiple same-named (default "custom")
-            # extractors, so configs cannot overwrite each other
-            key = str(entry.get(ex.key, idx)) if ex.key else (pointer or str(idx))
+            # without a key field, the VALUE's JSON pointer is the
+            # entry key — unique across multiple same-named (default
+            # "custom") extractors even when they share a path but
+            # extract different fields
+            value_pointer = f"{pointer}/{_escape(ex.value)}"
+            key = str(entry.get(ex.key, idx)) if ex.key else (value_pointer or str(idx))
             info = get_image_info(
                 value, default_registry, enable_default_registry_mutation,
-                pointer=f"{pointer}/{_escape(ex.value)}")
+                pointer=value_pointer)
             out.setdefault(ex.name, {})[key] = info
     return out

@@ -121,6 +121,13 @@ class MetricsRegistry:
             "kyverno_tpu_device_dispatch_seconds", "device program wall time")
         self.compile_cache = self.counter(
             "kyverno_tpu_compile_cache_total", "policy-set compiles by outcome")
+        # scan_stream phase split (SURVEY §5: encode/device/host costs)
+        self.scan_encode_seconds = self.histogram(
+            "kyverno_tpu_scan_encode_seconds", "host encode time per scan")
+        self.scan_device_seconds = self.histogram(
+            "kyverno_tpu_scan_device_seconds", "device wall time per scan")
+        self.scan_host_seconds = self.histogram(
+            "kyverno_tpu_scan_host_seconds", "host completion time per scan")
 
     def counter(self, name: str, help_: str) -> Counter:
         with self._lock:

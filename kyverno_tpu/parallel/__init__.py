@@ -1,3 +1,3 @@
 """Device-mesh parallelism for the scan engine."""
 
-from .sharding import ShardedScanner, make_mesh
+from .sharding import ShardedScanner, make_mesh, make_mesh_2d

@@ -35,6 +35,26 @@ def make_mesh(devices: Optional[Sequence] = None, axis: str = "data") -> Mesh:
     return Mesh(np.array(devices), (axis,))
 
 
+def make_mesh_2d(
+    hosts: int,
+    per_host: int,
+    devices: Optional[Sequence] = None,
+    axes: Tuple[str, str] = ("hosts", "data"),
+) -> Mesh:
+    """Two-axis (hosts, devices-per-host) mesh — the multi-host shape
+    (SURVEY §2.7): the tile stream shards over the host axis (DCN
+    boundary), per-tile resources over the intra-host axis (ICI), and
+    verdict-count reductions cross both. On real multi-host topology
+    the same axes map onto jax.distributed process boundaries."""
+    devices = list(devices if devices is not None else jax.devices())
+    if len(devices) < hosts * per_host:
+        raise ValueError(
+            f"need {hosts * per_host} devices for a {hosts}x{per_host} mesh, "
+            f"have {len(devices)}")
+    arr = np.array(devices[: hosts * per_host]).reshape(hosts, per_host)
+    return Mesh(arr, axes)
+
+
 class ShardedScanner:
     """Compile once, evaluate resource batches sharded across a mesh.
 
@@ -56,11 +76,16 @@ class ShardedScanner:
         self.cps: CompiledPolicySet = compile_policy_set(policies, encode_cfg, meta_cfg)
         self.exceptions = list(exceptions)
         self.mesh = mesh if mesh is not None else make_mesh()
-        self.axis = self.mesh.axis_names[0]
+        # resources shard over ALL mesh axes jointly: on a 1-D mesh
+        # that is plain data parallelism; on a (hosts, data) mesh the
+        # N axis splits host-major, so each host owns a contiguous
+        # tile range and ICI carries the within-host shards
+        self.axes: Tuple[str, ...] = tuple(self.mesh.axis_names)
+        self.axis = self.axes[0]
         self._raw_fn = build_program(
             self.cps.device_programs, self.cps.encode_cfg.max_instances
         )
-        data_sharding = NamedSharding(self.mesh, P(self.axis))
+        data_sharding = NamedSharding(self.mesh, P(self.axes))
         repl = NamedSharding(self.mesh, P())
 
         def step(batch: Dict[str, jnp.ndarray]):
@@ -74,7 +99,7 @@ class ShardedScanner:
         self._step = jax.jit(
             step,
             in_shardings=({k: data_sharding for k in self._batch_keys()},),
-            out_shardings=(NamedSharding(self.mesh, P(None, self.axis)), repl),
+            out_shardings=(NamedSharding(self.mesh, P(None, self.axes)), repl),
         )
 
     def _batch_keys(self):
@@ -123,7 +148,7 @@ class ShardedScanner:
     def put(self, batch: Dict[str, Any]) -> Dict[str, jnp.ndarray]:
         """Place a host batch on the mesh with the step's data sharding
         (resident across repeated steps — no per-step H2D transfer)."""
-        sh = NamedSharding(self.mesh, P(self.axis))
+        sh = NamedSharding(self.mesh, P(self.axes))
         return {k: jax.device_put(jnp.asarray(v), sh) for k, v in batch.items()}
 
     def scan_stream(
@@ -146,6 +171,8 @@ class ShardedScanner:
         """
         import time
 
+        from ..observability.metrics import global_registry
+        from ..observability.tracing import global_tracer
         from ..tpu.engine import TpuEngine
         from ..tpu.evaluator import HOST
 
@@ -161,15 +188,17 @@ class ShardedScanner:
         def drain():
             dv, sl, nv = pending.pop(0)
             t0 = time.perf_counter()
-            table = np.asarray(dv)[:, :nv]  # blocks on the device
+            with global_tracer.span("scan_device_wait", tile=nv):
+                table = np.asarray(dv)[:, :nv]  # blocks on the device
             stats["device_s"] += time.perf_counter() - t0
             if eng is not None:
                 t0 = time.perf_counter()
-                res = eng.assemble(
-                    table, resources[sl],
-                    namespace_labels,
-                    operations[sl] if operations else None,
-                )
+                with global_tracer.span("scan_host_complete", tile=nv):
+                    res = eng.assemble(
+                        table, resources[sl],
+                        namespace_labels,
+                        operations[sl] if operations else None,
+                    )
                 stats["host_cells"] += int((table == HOST).sum())
                 stats["host_s"] += time.perf_counter() - t0
                 tables.append(res.verdicts)
@@ -181,11 +210,12 @@ class ShardedScanner:
             chunk = resources[sl]
             nv = len(chunk)
             t0 = time.perf_counter()
-            padded = list(chunk) + [{} for _ in range(tile - nv)]
-            ops = None
-            if operations:
-                ops = list(operations[sl]) + [""] * (tile - nv)
-            batch, _ = self.encode(padded, namespace_labels, ops)
+            with global_tracer.span("scan_encode", tile=nv):
+                padded = list(chunk) + [{} for _ in range(tile - nv)]
+                ops = None
+                if operations:
+                    ops = list(operations[sl]) + [""] * (tile - nv)
+                batch, _ = self.encode(padded, namespace_labels, ops)
             stats["encode_s"] += time.perf_counter() - t0
             verdicts, _ = self._step(batch)  # async dispatch
             pending.append((verdicts, sl, nv))
@@ -194,6 +224,11 @@ class ShardedScanner:
                 drain()
         while pending:
             drain()
+        # phase timings land in metrics too (SURVEY §5: emit the
+        # per-phase costs scan_stream collects)
+        global_registry.scan_encode_seconds.observe(stats["encode_s"])
+        global_registry.scan_device_seconds.observe(stats["device_s"])
+        global_registry.scan_host_seconds.observe(stats["host_s"])
 
         from ..tpu.engine import ScanResult
 

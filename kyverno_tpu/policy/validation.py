@@ -95,8 +95,186 @@ def _check_pattern_anchors(pattern: Any, path: str, errs: List[str]) -> None:
             _check_pattern_anchors(v, f"{path}/{i}", errs)
 
 
+# api/kyverno/v1/common_types.go:278-297 — the 18 condition operators
+_CONDITION_OPERATORS = {
+    "Equal", "Equals", "NotEqual", "NotEquals", "In", "AnyIn", "AllIn",
+    "NotIn", "AnyNotIn", "AllNotIn", "GreaterThanOrEquals", "GreaterThan",
+    "LessThanOrEquals", "LessThan", "DurationGreaterThanOrEquals",
+    "DurationGreaterThan", "DurationLessThanOrEquals", "DurationLessThan",
+}
+
+_REQUEST_OPERATIONS = {"CREATE", "UPDATE", "DELETE", "CONNECT"}
+
+# exactly one source per context entry (validateRuleContext,
+# validate.go:1184)
+_CONTEXT_SOURCES = ("configMap", "apiCall", "imageRegistry", "variable",
+                    "globalReference")
+
+# names the engine seeds itself; context entries may not shadow them
+_RESERVED_CONTEXT_NAMES = {"request", "element", "elementIndex", "images",
+                           "image", "serviceAccountName",
+                           "serviceAccountNamespace", "target"}
+
+_JSON_PATCH_OPS = {"add", "remove", "replace", "move", "copy", "test"}
+
+
+def _iter_conditions(node: Any):
+    """Yield {key, operator, value} condition dicts from any/all trees
+    or legacy flat lists."""
+    if isinstance(node, dict):
+        if "operator" in node or "key" in node:
+            yield node
+        for sub in (node.get("any"), node.get("all")):
+            if isinstance(sub, list):
+                for c in sub:
+                    yield from _iter_conditions(c)
+    elif isinstance(node, list):
+        for c in node:
+            yield from _iter_conditions(c)
+
+
+def _check_conditions(node: Any, where: str, errs: List[str]) -> None:
+    """validateConditions (validate.go:1004): operator must be one of
+    the 18; {{request.operation}} values constrained to the four
+    admission operations (validate.go:1139)."""
+    for c in _iter_conditions(node):
+        op = c.get("operator", "")
+        if op and op not in _CONDITION_OPERATORS:
+            errs.append(f"{where}: invalid condition operator {op!r}")
+        key = c.get("key")
+        if isinstance(key, str) and key.replace(" ", "") == "{{request.operation}}":
+            values = c.get("value")
+            values = values if isinstance(values, list) else [values]
+            for v in values:
+                if isinstance(v, str) and v.startswith("{{") and v.endswith("}}"):
+                    continue
+                if v not in _REQUEST_OPERATIONS:
+                    errs.append(
+                        f"{where}: unknown value {v!r} for "
+                        f"{{{{request.operation}}}}; allowed: "
+                        f"[CREATE, UPDATE, DELETE, CONNECT]")
+
+
+def _check_context_entries(rule: Dict[str, Any], errs: List[str]) -> None:
+    """validateRuleContext (validate.go:1184): one source per entry,
+    no reserved names, apiCall/variable field sanity."""
+    name = rule.get("name") or ""
+    for entry in rule.get("context") or []:
+        ename = entry.get("name") or ""
+        if not ename:
+            errs.append(f"rule {name!r}: context entry without a name")
+        if ename in _RESERVED_CONTEXT_NAMES:
+            errs.append(f"rule {name!r}: context entry name {ename!r} "
+                        f"shadows a reserved variable")
+        sources = [s for s in _CONTEXT_SOURCES if entry.get(s) is not None]
+        if len(sources) != 1:
+            errs.append(
+                f"rule {name!r}: context entry {ename!r} requires exactly "
+                f"one of {'/'.join(_CONTEXT_SOURCES)}, found {sources or 'none'}")
+            continue
+        if sources == ["variable"]:
+            var = entry["variable"] or {}
+            if var.get("value") is None and not var.get("jmesPath"):
+                errs.append(f"rule {name!r}: variable context entry "
+                            f"{ename!r} requires value or jmesPath")
+        if sources == ["apiCall"]:
+            call = entry["apiCall"] or {}
+            if not call.get("urlPath") and not (call.get("service") or {}).get("url"):
+                errs.append(f"rule {name!r}: apiCall context entry "
+                            f"{ename!r} requires urlPath or service.url")
+            if call.get("urlPath") and (call.get("service") or {}).get("url"):
+                errs.append(f"rule {name!r}: apiCall context entry "
+                            f"{ename!r} cannot have both urlPath and service")
+
+
+def _check_json_patch(rule: Dict[str, Any], errs: List[str]) -> None:
+    """validateJSONPatch (validate.go:87): op/path shape, no variables
+    in the path section (validate.go:590)."""
+    import yaml as _yaml
+
+    name = rule.get("name") or ""
+    mutate = rule.get("mutate") or {}
+    patch = mutate.get("patchesJson6902")
+    if not patch:
+        return
+    try:
+        ops = _yaml.safe_load(patch) if isinstance(patch, str) else patch
+    except _yaml.YAMLError as e:
+        errs.append(f"rule {name!r}: invalid patchesJson6902: {e}")
+        return
+    if not isinstance(ops, list):
+        errs.append(f"rule {name!r}: patchesJson6902 must be a list")
+        return
+    for i, op in enumerate(ops):
+        if not isinstance(op, dict):
+            errs.append(f"rule {name!r}: patchesJson6902[{i}] must be a map")
+            continue
+        if op.get("op") not in _JSON_PATCH_OPS:
+            errs.append(f"rule {name!r}: patchesJson6902[{i}] has invalid "
+                        f"op {op.get('op')!r}")
+        path = op.get("path", "")
+        if not isinstance(path, str) or not path.startswith("/"):
+            errs.append(f"rule {name!r}: patchesJson6902[{i}] path must "
+                        f"start with '/'")
+        elif REGEX_VARIABLES.search(path):
+            errs.append(f"rule {name!r}: variables are not allowed in "
+                        f"patchesJson6902 path")
+
+
+def _check_forbidden_variables(rule: Dict[str, Any], errs: List[str]) -> None:
+    """ruleForbiddenSectionsHaveVariables (validate.go:528): match,
+    exclude and verifyImages imageReferences may not contain
+    variables."""
+    name = rule.get("name") or ""
+    for section in ("match", "exclude"):
+        for var in _iter_variables(rule.get(section) or {}):
+            if var.strip().startswith("element"):
+                continue
+            errs.append(f"rule {name!r}: variables are not allowed in the "
+                        f"{section} section ({{{{{var}}}}})")
+            break
+    for iv in rule.get("verifyImages") or []:
+        for ref in (iv.get("imageReferences") or []):
+            if isinstance(ref, str) and REGEX_VARIABLES.search(ref):
+                errs.append(f"rule {name!r}: variables are not allowed in "
+                            f"image reference {ref!r}")
+
+
+def _check_generate(rule: Dict[str, Any], errs: List[str],
+                    auth_checker=None) -> None:
+    """generate-rule structure + CanIGenerate permission seam
+    (validate.go generate checks, pkg/auth CanI)."""
+    name = rule.get("name") or ""
+    gen = rule.get("generate")
+    if gen is None:
+        return
+    has_data = gen.get("data") is not None
+    has_clone = bool(gen.get("clone")) or bool(gen.get("cloneList"))
+    if has_data == has_clone:
+        errs.append(f"rule {name!r}: generate requires exactly one of "
+                    f"data or clone/cloneList")
+    if not gen.get("kind") and not gen.get("cloneList"):
+        # cloneList carries its kinds inside the block
+        errs.append(f"rule {name!r}: generate requires kind")
+    if not gen.get("name") and not gen.get("cloneList"):
+        errs.append(f"rule {name!r}: generate requires name")
+    clone = gen.get("clone") or {}
+    if clone and not clone.get("name"):
+        errs.append(f"rule {name!r}: generate clone requires name")
+    if auth_checker is not None and gen.get("kind"):
+        for verb in ("create", "update", "delete", "get"):
+            if not auth_checker(verb, gen.get("kind", ""),
+                                gen.get("namespace", "")):
+                errs.append(
+                    f"rule {name!r}: controller lacks {verb!r} permission "
+                    f"for generated kind {gen.get('kind')!r} "
+                    f"(CanIGenerate)")
+                break
+
+
 def validate_policy(policy: ClusterPolicy,
-                    extra_allowed: Tuple[str, ...] = ()) -> Tuple[List[str], List[str]]:
+                    extra_allowed: Tuple[str, ...] = (),
+                    auth_checker=None) -> Tuple[List[str], List[str]]:
     """Returns (errors, warnings)."""
     errors: List[str] = []
     warnings: List[str] = []
@@ -124,6 +302,12 @@ def validate_policy(policy: ClusterPolicy,
                 f"rule {name!r} must define exactly one of validate/mutate/"
                 f"generate/verifyImages, found {types or 'none'}")
         errors.extend(_check_match_block(rule))
+        _check_context_entries(rule, errors)
+        _check_json_patch(rule, errors)
+        _check_forbidden_variables(rule, errors)
+        _check_generate(rule, errors, auth_checker)
+        _check_conditions(rule.get("preconditions"),
+                          f"rule {name!r} preconditions", errors)
         v = rule.get("validate")
         if v is not None:
             errors.extend(f"rule {name!r}: {e}" for e in _validate_body_types(v))
@@ -131,6 +315,15 @@ def validate_policy(policy: ClusterPolicy,
                 _check_pattern_anchors(v["pattern"], "pattern", errors)
             for p in v.get("anyPattern") or []:
                 _check_pattern_anchors(p, "anyPattern", errors)
+            deny = v.get("deny") or {}
+            _check_conditions(deny.get("conditions"),
+                              f"rule {name!r} deny conditions", errors)
+            for fe in v.get("foreach") or []:
+                _check_conditions((fe.get("deny") or {}).get("conditions"),
+                                  f"rule {name!r} foreach deny", errors)
+                _check_conditions(fe.get("preconditions"),
+                                  f"rule {name!r} foreach preconditions",
+                                  errors)
         # variable whitelist
         context_names = tuple(
             (c.get("name") or "") for c in (rule.get("context") or []))

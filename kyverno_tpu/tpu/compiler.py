@@ -68,6 +68,17 @@ def compile_policy_set(
     encode_cfg: Optional[EncodeConfig] = None,
     meta_cfg: Optional[MetaConfig] = None,
 ) -> CompiledPolicySet:
+    from ..observability.tracing import global_tracer
+
+    with global_tracer.span("policy_set_compile", policies=len(policies)):
+        return _compile_policy_set(policies, encode_cfg, meta_cfg)
+
+
+def _compile_policy_set(
+    policies: Sequence[ClusterPolicy],
+    encode_cfg: Optional[EncodeConfig] = None,
+    meta_cfg: Optional[MetaConfig] = None,
+) -> CompiledPolicySet:
     encode_cfg = encode_cfg or EncodeConfig()
     meta_cfg = meta_cfg or MetaConfig()
     entries: List[RuleEntry] = []

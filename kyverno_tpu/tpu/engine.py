@@ -144,6 +144,17 @@ class TpuEngine:
 
     # -- evaluation
 
+    # batch sizes bucket to powers of two so arbitrary N never triggers
+    # unbounded XLA recompiles (SURVEY §7 "recompilation churn": the
+    # jit cache is keyed by shape; bucketing caps it at ~log2 shapes)
+    MIN_BUCKET = 16
+
+    def bucket_size(self, n: int) -> int:
+        b = self.MIN_BUCKET
+        while b < n:
+            b *= 2
+        return b
+
     def scan(
         self,
         resources: Sequence[Dict[str, Any]],
@@ -151,8 +162,14 @@ class TpuEngine:
         operations: Optional[Sequence[str]] = None,
         admission_infos: Optional[Sequence[Optional[RequestInfo]]] = None,
     ) -> ScanResult:
-        batch, rows, meta = self.encode(resources, namespace_labels, operations, admission_infos)
-        device_table = np.asarray(self.cps.device_fn()(batch))  # (D, N)
+        n = len(resources)
+        padded_n = self.bucket_size(max(n, 1))
+        padded = list(resources) + [{} for _ in range(padded_n - n)]
+        ops = (list(operations) + [""] * (padded_n - n)) if operations else None
+        infos = (list(admission_infos) + [None] * (padded_n - n)) \
+            if admission_infos else None
+        batch, rows, meta = self.encode(padded, namespace_labels, ops, infos)
+        device_table = np.asarray(self.cps.device_fn()(batch))[:, :n]  # (D, N)
         return self.assemble(
             device_table, resources, namespace_labels, operations, admission_infos
         )

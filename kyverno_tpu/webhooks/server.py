@@ -332,8 +332,13 @@ class AdmissionServer:
         port: int = 9443,
         certfile: Optional[str] = None,
         keyfile: Optional[str] = None,
+        enable_debug: bool = False,
     ) -> None:
         self.handlers = handlers
+        # the reference serves pprof on a separate localhost-only port
+        # behind the `profile` flag (pkg/profiling); here the /debug/*
+        # surface is opt-in and OFF by default on the admission port
+        self.enable_debug = enable_debug
         outer = self
 
         class _Req(BaseHTTPRequestHandler):
@@ -345,6 +350,13 @@ class AdmissionServer:
                     self.send_response(200)
                     self.end_headers()
                     self.wfile.write(b"ok")
+                elif self.path.startswith("/debug/") and outer.enable_debug:
+                    # pprof-equivalent surface (pkg/profiling, SURVEY §5)
+                    code, body = outer.handle_debug(self.path)
+                    self.send_response(code)
+                    self.send_header("Content-Type", "text/plain")
+                    self.end_headers()
+                    self.wfile.write(body)
                 else:
                     self.send_response(404)
                     self.end_headers()
@@ -381,11 +393,59 @@ class AdmissionServer:
                 self.wfile.write(data)
 
         self._httpd = ThreadingHTTPServer((host, port), _Req)
+        self._ssl_ctx: Optional[ssl.SSLContext] = None
         if certfile:
             ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
             ctx.load_cert_chain(certfile, keyfile)
+            self._ssl_ctx = ctx
             self._httpd.socket = ctx.wrap_socket(self._httpd.socket, server_side=True)
         self._thread: Optional[threading.Thread] = None
+
+    def handle_debug(self, path: str) -> Tuple[int, bytes]:
+        """Profiling surface (pkg/profiling pprof analogue + the XLA
+        profiler hook, SURVEY §5):
+
+        /debug/spans            recent tracer spans (phase breakdown)
+        /debug/xla/start?dir=D  start the JAX/XLA profiler trace
+        /debug/xla/stop         stop it (trace lands in the dir)
+        """
+        from ..observability.tracing import global_tracer
+
+        if path.startswith("/debug/spans"):
+            lines = []
+            for s in global_tracer.finished()[-200:]:
+                attrs = " ".join(f"{k}={v}" for k, v in s.attributes.items())
+                lines.append(f"{s.name} {s.duration * 1e3:.3f}ms "
+                             f"status={s.status} {attrs}".rstrip())
+            return 200, ("\n".join(lines) + "\n").encode()
+        if path.startswith("/debug/xla/start"):
+            import jax
+
+            out_dir = "/tmp/kyverno-tpu-xla-trace"
+            if "dir=" in path:
+                out_dir = path.split("dir=", 1)[1].split("&")[0]
+            try:
+                jax.profiler.start_trace(out_dir)
+            except Exception as e:
+                return 500, f"profiler start failed: {e}\n".encode()
+            return 200, f"xla trace started -> {out_dir}\n".encode()
+        if path.startswith("/debug/xla/stop"):
+            import jax
+
+            try:
+                jax.profiler.stop_trace()
+            except Exception as e:
+                return 500, f"profiler stop failed: {e}\n".encode()
+            return 200, b"xla trace stopped\n"
+        return 404, b"unknown debug path\n"
+
+    def reload_cert(self, certfile: str, keyfile: Optional[str] = None) -> None:
+        """Hot cert rotation (tls/renewer.go): reloading the chain into
+        the live SSLContext affects only new handshakes — established
+        connections and the listening socket keep serving."""
+        if self._ssl_ctx is None:
+            raise RuntimeError("server was not started with TLS")
+        self._ssl_ctx.load_cert_chain(certfile, keyfile)
 
     @property
     def port(self) -> int:

@@ -150,3 +150,35 @@ def test_clean_rescan_skips_encode(monkeypatch):
     snap.upsert(pod("p0", False))
     assert svc.scan_once() == 1
     assert calls["n"] == first + 1
+
+
+def test_leader_election_single_holder_and_failover():
+    """pkg/leaderelection/leaderelection.go: one holder at a time;
+    leadership moves when the holder stops renewing past the lease
+    duration; release hands off immediately."""
+    from kyverno_tpu.cluster.leaderelection import LeaderElector, LeaseStore
+
+    now = [0.0]
+    store = LeaseStore(clock=lambda: now[0])
+    started, stopped = [], []
+    a = LeaderElector("ctl", "replica-a", store, lease_duration_s=12,
+                      on_started_leading=lambda: started.append("a"),
+                      on_stopped_leading=lambda: stopped.append("a"))
+    b = LeaderElector("ctl", "replica-b", store, lease_duration_s=12,
+                      on_started_leading=lambda: started.append("b"))
+    assert a.tick() is True and b.tick() is False
+    assert a.is_leader() and not b.is_leader()
+    assert started == ["a"]
+    # renewals keep the lease
+    now[0] = 10.0
+    assert a.tick() is True and b.tick() is False
+    # holder goes silent: lease expires, b takes over
+    now[0] = 23.1
+    assert b.tick() is True
+    assert store.holder("ctl") == "replica-b"
+    assert a.tick() is False  # a notices it lost
+    assert stopped == ["a"] and started == ["a", "b"]
+    # explicit release hands off immediately
+    store.release("ctl", "replica-b")
+    assert store.holder("ctl") is None
+    assert a.tick() is True

@@ -70,3 +70,68 @@ def test_toggles_env_and_overrides(monkeypatch):
     monkeypatch.setenv("KYVERNO_TPU_ENGINE", "scalar")
     assert Toggles().engine == "scalar"
     assert Toggles(engine="tpu").engine == "tpu"
+
+
+def test_scan_stream_emits_spans_and_phase_metrics():
+    """SURVEY §5: one scan produces a host/device phase breakdown in
+    both the tracer and the metrics registry."""
+    from kyverno_tpu.api.policy import ClusterPolicy
+    from kyverno_tpu.observability.metrics import global_registry
+    from kyverno_tpu.observability.tracing import global_tracer
+    from kyverno_tpu.parallel import ShardedScanner, make_mesh
+
+    pol = ClusterPolicy.from_dict({
+        "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+        "metadata": {"name": "t"},
+        "spec": {"rules": [{
+            "name": "r",
+            "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+            "validate": {"pattern": {"metadata": {"name": "?*"}}},
+        }]},
+    })
+    scanner = ShardedScanner([pol], mesh=make_mesh())
+    res = [{"apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": f"p{i}", "namespace": "d"}, "spec": {}}
+           for i in range(8)]
+    before = len(global_tracer.finished("scan_encode"))
+    result, stats = scanner.scan_stream(res, tile=8)
+    assert result.verdicts.shape[1] == 8
+    assert len(global_tracer.finished("scan_encode")) > before
+    assert global_tracer.finished("scan_device_wait")
+    assert global_tracer.finished("policy_set_compile")
+    # phase metrics were observed
+    assert sum(global_registry.scan_encode_seconds._totals.values()) >= 1
+    assert sum(global_registry.scan_device_seconds._totals.values()) >= 1
+
+
+def test_debug_endpoints():
+    import http.client
+
+    from kyverno_tpu.cluster import PolicyCache
+    from kyverno_tpu.webhooks import AdmissionServer, build_handlers
+
+    handlers = build_handlers(PolicyCache())
+    # default: the debug surface is OFF on the admission port (the
+    # reference serves pprof on a separate localhost port behind a flag)
+    srv = AdmissionServer(handlers, port=0)
+    srv.start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=10)
+        conn.request("GET", "/debug/spans")
+        assert conn.getresponse().status == 404
+        conn.close()
+    finally:
+        srv.stop()
+    srv = AdmissionServer(handlers, port=0, enable_debug=True)
+    srv.start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=10)
+        conn.request("GET", "/debug/spans")
+        resp = conn.getresponse()
+        assert resp.status == 200
+        body = resp.read().decode()
+        assert "policy_set_compile" in body or body.strip() == ""
+        conn.close()
+    finally:
+        srv.stop()
+        handlers.batcher.stop()

@@ -86,3 +86,86 @@ def test_plus_anchor_rejected_in_validate():
     }
     errs, _ = validate_policy(make([rule]))
     assert any("+()" in e for e in errs)
+
+
+def _pol(rule):
+    from kyverno_tpu.api.policy import ClusterPolicy
+    return ClusterPolicy.from_dict({
+        "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+        "metadata": {"name": "p"},
+        "spec": {"rules": [rule]},
+    })
+
+
+def test_condition_operator_validation():
+    from kyverno_tpu.policy.validation import validate_policy
+
+    rule = {"name": "r",
+            "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+            "preconditions": {"all": [{"key": "x", "operator": "Equalz",
+                                       "value": "y"}]},
+            "validate": {"pattern": {"metadata": {}}}}
+    errs, _ = validate_policy(_pol(rule))
+    assert any("invalid condition operator 'Equalz'" in e for e in errs)
+    # request.operation values constrained (validate.go:1139)
+    rule["preconditions"] = {"all": [{
+        "key": "{{request.operation}}", "operator": "Equals",
+        "value": "PATCH"}]}
+    errs, _ = validate_policy(_pol(rule))
+    assert any("unknown value 'PATCH'" in e for e in errs)
+
+
+def test_context_entry_validation():
+    from kyverno_tpu.policy.validation import validate_policy
+
+    rule = {"name": "r",
+            "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+            "context": [
+                {"name": "images", "configMap": {"name": "x", "namespace": "y"}},
+                {"name": "two", "configMap": {"name": "x"}, "variable": {"value": 1}},
+                {"name": "none"},
+                {"name": "badcall", "apiCall": {}},
+            ],
+            "validate": {"pattern": {"metadata": {}}}}
+    errs, _ = validate_policy(_pol(rule))
+    assert any("shadows a reserved variable" in e for e in errs)
+    assert sum("exactly one of" in e for e in errs) == 2
+    assert any("urlPath or service.url" in e for e in errs)
+
+
+def test_json_patch_and_forbidden_variables():
+    from kyverno_tpu.policy.validation import validate_policy
+
+    rule = {"name": "r",
+            "match": {"any": [{"resources": {"kinds": ["Pod"],
+                                             "names": ["{{request.object.x}}"]}}]},
+            "mutate": {"patchesJson6902":
+                       '[{"op": "patchify", "path": "nope"}]'}}
+    errs, _ = validate_policy(_pol(rule))
+    assert any("invalid op" in e for e in errs)
+    assert any("path must start with '/'" in e for e in errs)
+    assert any("variables are not allowed in the match section" in e for e in errs)
+
+
+def test_generate_validation_and_auth_seam():
+    from kyverno_tpu.policy.validation import validate_policy
+
+    rule = {"name": "r",
+            "match": {"any": [{"resources": {"kinds": ["Namespace"]}}]},
+            "generate": {"kind": "NetworkPolicy", "name": "np",
+                         "namespace": "{{request.object.metadata.name}}",
+                         "data": {"spec": {}}}}
+    errs, _ = validate_policy(_pol(rule))
+    assert errs == []
+    # both data and clone is invalid
+    bad = dict(rule)
+    bad["generate"] = {**rule["generate"], "clone": {"name": "x"}}
+    errs, _ = validate_policy(_pol(bad))
+    assert any("exactly one of" in e for e in errs)
+    # auth seam: denied permission -> CanIGenerate error
+    errs, _ = validate_policy(_pol(rule),
+                              auth_checker=lambda verb, kind, ns: False)
+    assert any("CanIGenerate" in e for e in errs)
+    errs, _ = validate_policy(_pol(rule),
+                              auth_checker=lambda verb, kind, ns: True)
+    assert errs == []

@@ -361,3 +361,40 @@ def test_host_fallback_rules_complete():
     ]
     eng = check_parity(policies, resources)
     assert eng.coverage() == (1, 2)
+
+
+def test_engine_buckets_batch_shapes(monkeypatch):
+    """Two odd-sized batches must reuse one compiled shape (SURVEY §7
+    recompilation churn: bucketing lives in the engine, not in caller
+    convention)."""
+    from kyverno_tpu.tpu.engine import TpuEngine
+
+    pol = ClusterPolicy.from_dict({
+        "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+        "metadata": {"name": "b"},
+        "spec": {"rules": [{
+            "name": "r",
+            "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+            "validate": {"pattern": {"metadata": {"name": "?*"}}},
+        }]},
+    })
+    eng = TpuEngine([pol])
+    shapes = []
+    real_fn = eng.cps.device_fn()
+
+    def spying(batch):
+        shapes.append(batch["norm_hi"].shape[0])
+        return real_fn(batch)
+
+    monkeypatch.setattr(eng.cps, "device_fn", lambda: spying)
+
+    def mk(i):
+        return {"apiVersion": "v1", "kind": "Pod",
+                "metadata": {"name": f"x{i}", "namespace": "d"}, "spec": {}}
+
+    r1 = eng.scan([mk(i) for i in range(3)])
+    r2 = eng.scan([mk(i) for i in range(13)])
+    assert shapes == [16, 16]  # both bucket to MIN_BUCKET
+    assert r1.verdicts.shape[1] == 3 and r2.verdicts.shape[1] == 13
+    r3 = eng.scan([mk(i) for i in range(17)])
+    assert shapes[-1] == 32 and r3.verdicts.shape[1] == 17

@@ -1,0 +1,183 @@
+"""Webhook-config generation from the live policy set + TLS cert
+generation/rotation (pkg/controllers/webhook/controller.go,
+pkg/tls/renewer.go)."""
+
+import datetime
+import http.client
+import json
+import ssl
+
+from kyverno_tpu.api.policy import ClusterPolicy
+from kyverno_tpu.cluster import PolicyCache
+from kyverno_tpu.cluster.webhookconfig import (
+    FINE_GRAINED_ANNOTATION,
+    WebhookConfigGenerator,
+)
+from kyverno_tpu.utils.tlsutil import CertRenewer
+from kyverno_tpu.webhooks import AdmissionServer, build_handlers
+
+
+def policy(name, kinds=("Pod",), failure_policy=None, annotations=None,
+           rule_kind="validate"):
+    rule = {"name": "r",
+            "match": {"any": [{"resources": {"kinds": list(kinds)}}]}}
+    if rule_kind == "validate":
+        rule["validate"] = {"pattern": {"metadata": {"name": "?*"}}}
+    else:
+        rule["mutate"] = {"patchStrategicMerge": {"metadata": {
+            "labels": {"+(x)": "y"}}}}
+    spec = {"rules": [rule]}
+    if failure_policy:
+        spec["failurePolicy"] = failure_policy
+    return ClusterPolicy.from_dict({
+        "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+        "metadata": {"name": name, "annotations": annotations or {}},
+        "spec": spec,
+    })
+
+
+def test_webhook_config_from_policies_and_failure_policy_split():
+    cache = PolicyCache()
+    cache.set(policy("fail-pol", kinds=("Pod",)))
+    cache.set(policy("ignore-pol", kinds=("apps/v1/Deployment",),
+                     failure_policy="Ignore"))
+    gen = WebhookConfigGenerator(cache)
+    assert gen.reconcile(ca_bundle="CA") is True
+    cfg = gen.configs["validating"]
+    byname = {w["name"]: w for w in cfg["webhooks"]}
+    fail = byname["resource-validating-fail.kyverno.svc"]
+    ignore = byname["resource-validating-ignore.kyverno.svc"]
+    assert fail["failurePolicy"] == "Fail"
+    assert ignore["failurePolicy"] == "Ignore"
+    # pods imply pods/ephemeralcontainers (utils.go:81-84); the cache
+    # autogen-expands the Pod policy, so the surface also includes the
+    # pod controllers (apps/batch groups)
+    core = [r for r in fail["rules"] if r["apiGroups"] == [""]][0]
+    assert {"pods", "pods/ephemeralcontainers"} <= set(core["resources"])
+    apps = [r for r in fail["rules"] if r["apiGroups"] == ["apps"]][0]
+    assert "deployments" in apps["resources"]
+    [irule] = ignore["rules"]
+    assert irule["apiGroups"] == ["apps"] and irule["resources"] == ["deployments"]
+    assert fail["clientConfig"]["url"].endswith("/validate/fail")
+    assert fail["clientConfig"]["caBundle"] == "CA"
+
+
+def test_webhook_config_reacts_to_policy_change():
+    cache = PolicyCache()
+    cache.set(policy("p1", kinds=("ConfigMap",)))
+    gen = WebhookConfigGenerator(cache)
+    gen.reconcile()
+    assert gen.serves("ConfigMap") and not gen.serves("apps/v1/Deployment")
+    # adding a Deployment policy changes the served surface
+    cache.set(policy("p2", kinds=("apps/v1/Deployment",)))
+    assert gen.reconcile() is True
+    assert gen.serves("apps/v1/Deployment")
+    # removing it shrinks the surface again
+    cache.unset("p2")
+    assert gen.reconcile() is True
+    assert not gen.serves("apps/v1/Deployment")
+    # no revision change -> no work
+    assert gen.reconcile() is False
+
+
+def test_fine_grained_webhook_per_policy():
+    cache = PolicyCache()
+    cache.set(policy("special", kinds=("Pod",),
+                     annotations={FINE_GRAINED_ANNOTATION: "true"}))
+    gen = WebhookConfigGenerator(cache)
+    gen.reconcile()
+    [wh] = gen.configs["validating"]["webhooks"]
+    assert wh["name"] == "resource-validating-fail-special.kyverno.svc"
+    assert wh["clientConfig"]["url"].endswith("/validate/fail/special")
+
+
+def test_mutating_config_covers_mutate_and_verify_images():
+    cache = PolicyCache()
+    cache.set(policy("mut", kinds=("Pod",), rule_kind="mutate"))
+    gen = WebhookConfigGenerator(cache)
+    gen.reconcile()
+    cfg = gen.configs["mutating"]
+    assert cfg["kind"] == "MutatingWebhookConfiguration"
+    [wh] = cfg["webhooks"]
+    assert wh["clientConfig"]["url"].endswith("/mutate/fail")
+
+
+# ---------------------------------------------------------------------------
+# TLS
+
+
+def test_cert_generation_and_renewal(tmp_path):
+    now = [datetime.datetime.now(datetime.timezone.utc)]
+    r = CertRenewer(str(tmp_path), ["localhost"], clock=lambda: now[0],
+                    cert_validity_s=100 * 24 * 3600)
+    assert r.renew_if_needed() is True
+    first = open(r.certfile, "rb").read()
+    assert b"BEGIN CERTIFICATE" in first
+    # inside validity: no renewal
+    assert r.renew_if_needed() is False
+    # move clock into renew-before window (15d before expiry)
+    now[0] = now[0] + datetime.timedelta(days=90)
+    assert r.renew_if_needed() is True
+    assert open(r.certfile, "rb").read() != first
+    assert r.renewals == 2
+
+
+def test_cert_rotation_without_dropping_requests(tmp_path):
+    """renewer.go:94: rolling the cert must not interrupt serving —
+    requests succeed before and after the rotation, and the new
+    handshake presents the new certificate."""
+    renewer = CertRenewer(str(tmp_path), ["127.0.0.1", "localhost"])
+    renewer.renew_if_needed()
+    cache = PolicyCache()
+    handlers = build_handlers(cache)
+    srv = AdmissionServer(handlers, port=0, certfile=renewer.certfile,
+                          keyfile=renewer.keyfile)
+    renewer.on_reload = lambda c, k, ca: srv.reload_cert(c, k)
+    srv.start()
+    try:
+        ctx = ssl.create_default_context(cafile=renewer.cafile)
+        ctx.check_hostname = False
+
+        def probe():
+            conn = http.client.HTTPSConnection("127.0.0.1", srv.port,
+                                               context=ctx, timeout=10)
+            conn.connect()
+            cert = conn.sock.getpeercert(binary_form=True)
+            conn.request("GET", "/health/liveness")
+            resp = conn.getresponse()
+            body = resp.read()
+            conn.close()
+            return resp.status, body, cert
+
+        status, body, cert1 = probe()
+        assert status == 200 and body == b"ok"
+        # force a rotation (fresh serving pair under the same CA)
+        renewer.cert = None
+        assert renewer.renew_if_needed() is True
+        status, body, cert2 = probe()
+        assert status == 200 and body == b"ok"
+        assert cert1 != cert2  # new serving cert actually presented
+    finally:
+        srv.stop()
+        handlers.batcher.stop()
+
+
+def test_parse_kind_subresource_and_gctx_unsubscribe():
+    from kyverno_tpu.cluster.webhookconfig import _parse_kind
+    from kyverno_tpu.cluster.snapshot import ClusterSnapshot
+    from kyverno_tpu.globalcontext import GlobalContextStore
+
+    assert _parse_kind("Pod/exec") == ("", "*", "pods/exec")
+    assert _parse_kind("apps/v1/Deployment") == ("apps", "v1", "deployments")
+    assert _parse_kind("Pod") == ("", "*", "pods")
+    # reconciling the same gctx entry twice must not leak subscribers
+    snap = ClusterSnapshot()
+    store = GlobalContextStore(snapshot=snap)
+    doc = {"metadata": {"name": "e"},
+           "spec": {"kubernetesResource": {"group": "", "version": "v1",
+                                           "resource": "pods"}}}
+    before = len(snap._subscribers)
+    store.apply(doc)
+    store.apply(doc)
+    store.apply(doc)
+    assert len(snap._subscribers) == before + 1
