@@ -67,14 +67,52 @@ class TestCase:
             for d in _load_yaml_docs(os.path.join(base, rel)):
                 if not is_policy_document(d):
                     self.resources.append(d)
-        values = self.spec.get("values") or {}
+        # values: inline (spec.values) or the variables file named by
+        # spec.variables (default sibling values.yaml) — the reference
+        # Values schema (apis/v1alpha1/values.go)
+        values = dict(self.spec.get("values") or {})
+        var_file = self.spec.get("variables") or "values.yaml"
+        var_path = os.path.join(base, var_file)
+        if os.path.exists(var_path):
+            with open(var_path) as f:
+                file_vals = yaml.safe_load(f) or {}
+            for k, v in file_vals.items():
+                values.setdefault(k, v)
         self.ns_labels: Dict[str, Dict[str, str]] = {}
         for ns in values.get("namespaces") or []:
             meta = ns.get("metadata") or {}
-            self.ns_labels[meta.get("name", "")] = dict(meta.get("labels") or {})
+            name = meta.get("name", "") or ns.get("name", "")
+            self.ns_labels[name] = dict(
+                (meta.get("labels") or {}) or (ns.get("labels") or {}))
         # GlobalValues is a map in the reference schema (values.go)
         self.variables: Dict[str, Any] = dict(values.get("globalValues") or {})
+        # per-policy rule values (context variables) and per-resource
+        # values (request.* seeds)
+        self.rule_values: Dict[str, Dict[str, Any]] = {}
+        self.resource_values: Dict[tuple, Dict[str, Any]] = {}
+        for pv in values.get("policies") or []:
+            pname = pv.get("name", "")
+            merged = {}
+            for rv in pv.get("rules") or []:
+                merged.update(rv.get("values") or {})
+            if merged:
+                self.rule_values[pname] = merged
+            for rv in pv.get("resources") or []:
+                if rv.get("values"):
+                    self.resource_values[(pname, rv.get("name", ""))] = \
+                        dict(rv["values"])
         self.results: List[Dict[str, Any]] = list(self.spec.get("results") or [])
+
+    def values_for(self, pname: str, resource: Dict[str, Any]) -> Dict[str, Any]:
+        meta = resource.get("metadata") or {}
+        name = meta.get("name", "")
+        ns = meta.get("namespace", "")
+        out = dict(self.variables)
+        out.update(self.rule_values.get(pname, {}))
+        out.update(self.resource_values.get((pname, name), {}))
+        if ns:
+            out.update(self.resource_values.get((pname, f"{ns}/{name}"), {}))
+        return out
 
     def name(self) -> str:
         meta = self.spec.get("metadata") or {}
@@ -90,6 +128,21 @@ def _run_case(case: TestCase) -> List[Tuple[Dict[str, Any], str, bool]]:
     from ..tpu.engine import build_scan_context
 
     eng = ScalarEngine()
+
+    def build_ctx(policy, current, key):
+        """Admission-shaped context: operation defaults to CREATE (the
+        reference CLI's default, overridable per resource via values);
+        CLI-store values PIN over context loaders."""
+        vals = case.values_for(policy.name, current)
+        op = vals.pop("request.operation", "CREATE")
+        pctx = build_scan_context(policy, current, case.ns_labels.get(key, {}),
+                                  operation=op or "")
+        if op:
+            pctx.json_context.add_operation(op)
+        for name, value in vals.items():
+            pctx.json_context.pin_variable(name, value)
+        return pctx
+
     # evaluate every (policy, resource) once; collect rule responses
     responses: List[Tuple[str, str, Dict[str, Any], str]] = []
     patched: Dict[int, Dict[str, Any]] = {}
@@ -99,9 +152,7 @@ def _run_case(case: TestCase) -> List[Tuple[Dict[str, Any], str, bool]]:
             meta = current.get("metadata") or {}
             ns = meta.get("namespace", "")
             key = meta.get("name", "") if current.get("kind") == "Namespace" else ns
-            pctx = build_scan_context(policy, current, case.ns_labels.get(key, {}))
-            for name, value in case.variables.items():
-                pctx.json_context.add_variable(name, value)
+            pctx = build_ctx(policy, current, key)
             if any(r.has_mutate() for r in policy.get_rules()):
                 m = eng.mutate(pctx)
                 for rr in m.policy_response.rules:
@@ -109,9 +160,7 @@ def _run_case(case: TestCase) -> List[Tuple[Dict[str, Any], str, bool]]:
                 if m.patched_resource is not None:
                     patched[ri] = m.patched_resource
                     current = m.patched_resource
-                    pctx = build_scan_context(policy, current, case.ns_labels.get(key, {}))
-                    for name, value in case.variables.items():
-                        pctx.json_context.add_variable(name, value)
+                    pctx = build_ctx(policy, current, key)
             v = eng.validate(pctx)
             for rr in v.policy_response.rules:
                 responses.append((policy.name, rr.name, current, rr.status))

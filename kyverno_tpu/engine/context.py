@@ -35,6 +35,10 @@ class Context:
         self._root: Dict[str, Any] = {"request": {}}
         self._checkpoints: List[Dict[str, Any]] = []
         self._deferred = []  # (name, loader) pairs, see deferred loading
+        # CLI-store values: entry names pinned here override context
+        # loaders (the reference CLI's store-backed context loader,
+        # processor/policy_processor.go:75-85 + store.ContextVar)
+        self._pinned: set = set()
 
     # -- builders
 
@@ -81,8 +85,10 @@ class Context:
         self._root["images"] = images
 
     def add_variable(self, name: str, value: Any) -> None:
-        """Set a dotted-name variable (context entries, CLI values)."""
-        parts = name.split(".")
+        """Set a dotted-name variable (context entries, CLI values).
+        Quoted segments keep their dots: `a."x.y/z".b` has three
+        segments, matching JMESPath navigation."""
+        parts = _split_dotted(name)
         node = self._root
         for part in parts[:-1]:
             nxt = node.get(part)
@@ -91,6 +97,12 @@ class Context:
                 node[part] = nxt
             node = nxt
         node[parts[-1]] = value
+
+    def pin_variable(self, name: str, value: Any) -> None:
+        """CLI-store value: set AND shadow any context entry of the
+        same root name (deferred loaders for it will not fire)."""
+        self.add_variable(name, value)
+        self._pinned.add(_split_dotted(name)[0])
 
     def add_context_entry(self, name: str, value: Any) -> None:
         self.add_variable(name, value)
@@ -124,6 +136,8 @@ class Context:
     # -- deferred loaders (deferred.go)
 
     def add_deferred_loader(self, name: str, loader) -> None:
+        if name in self._pinned:
+            return  # CLI-store value wins over the context source
         self._deferred.append((name, loader))
 
     def _load_deferred(self, query: str) -> None:
@@ -167,6 +181,22 @@ class Context:
 
     def json(self) -> str:
         return json.dumps(self._root)
+
+
+def _split_dotted(name: str):
+    """Split a dotted path, honoring double-quoted segments
+    (`a."x.y/z".b` -> ['a', 'x.y/z', 'b'])."""
+    parts, buf, quoted = [], [], False
+    for ch in name:
+        if ch == '"':
+            quoted = not quoted
+        elif ch == "." and not quoted:
+            parts.append("".join(buf))
+            buf = []
+        else:
+            buf.append(ch)
+    parts.append("".join(buf))
+    return [p for p in parts if p != ""] or [name]
 
 
 def _merge(dst: Dict[str, Any], src: Dict[str, Any]) -> None:

@@ -99,7 +99,9 @@ def _load_variable(ctx: Context, spec: Dict[str, Any]) -> Any:
                 raise ContextLoaderError(str(e))
             result = None
     if result is None and default is not None:
-        result = default
+        # defaults may themselves contain variables
+        # (loaders/variable.go applies substitution to the default)
+        result = substitute_all(ctx, default)
     return result
 
 

@@ -53,6 +53,19 @@ def build_scan_context(
         ctx.add_operation(operation)
     info = admission_info or RequestInfo()
     ctx.add_user_info({"username": info.username, "uid": info.uid, "groups": info.groups})
+    # images.* variables from the resource's containers
+    # (policy_context.go:257 builds image infos at construction; rules
+    # reference e.g. {{ images.containers.*.registry }})
+    try:
+        from ..images import extract_images
+
+        extracted = extract_images(resource)
+        if extracted:
+            ctx.add_image_infos({
+                group: {key: info_.to_dict() for key, info_ in entries.items()}
+                for group, entries in extracted.items()})
+    except Exception:
+        pass  # malformed image strings must not break context building
     return PolicyContext(
         policy=policy,
         new_resource=resource,
