@@ -126,21 +126,27 @@ def _wild_either(a: str, b: str) -> bool:
 
 
 def _value_as_string_list(value: Any) -> Optional[List[str]]:
+    """anyin.go:80-88: a string value that is VALID JSON must unmarshal
+    as a string array (else invalid type => None); invalid JSON is a
+    singleton literal."""
     if isinstance(value, list):
         return [_go_sprint(v) for v in value]
     if isinstance(value, str):
         try:
             arr = json.loads(value)
-            if isinstance(arr, list) and all(isinstance(x, str) for x in arr):
-                return arr
         except ValueError:
-            pass
-        return [value]
+            return [value]
+        if isinstance(arr, list) and all(isinstance(x, str) for x in arr):
+            return arr
+        return None
     return None
 
 
-def _key_exists_in_array(key: str, value: Any) -> bool:
-    # anyin.go anyKeyExistsInArray / allin.go allKeyExistsInArray
+def _key_exists_in_array(key: str, value: Any) -> Optional[bool]:
+    """anyin.go:61 anyKeyExistsInArray / allin.go allKeyExistsInArray.
+    Returns None for an invalid value type (nil, map, JSON-but-not-
+    string-array), which evaluates to False for BOTH the In and NotIn
+    directions upstream (anynotin.go:44-50)."""
     if isinstance(value, list):
         return any(_wild_either(_go_sprint(v), key) for v in value)
     if isinstance(value, str):
@@ -150,9 +156,9 @@ def _key_exists_in_array(key: str, value: Any) -> bool:
             return patternpkg.validate(key, value)
         arr = _value_as_string_list(value)
         if arr is None:
-            return False
+            return None  # valid JSON that is not a string array
         return any(key == v for v in arr)
-    return False
+    return None  # invalidType
 
 
 def _set_in(keys: List[str], value: Any, mode: str) -> bool:
@@ -246,6 +252,8 @@ def _membership(key: Any, value: Any, mode: str) -> bool:
         key = _go_sprint(key)
     if isinstance(key, str):
         hit = _key_exists_in_array(key, value)
+        if hit is None:
+            return False  # invalid value type: false both ways
         if mode in ("any_in", "all_in"):
             return hit
         return not hit
@@ -359,6 +367,10 @@ def evaluate_condition_values(key: Any, operator: str, value: Any) -> bool:
     if op in ("equal", "equals"):
         return _equals(key, value)
     if op in ("notequal", "notequals"):
+        # notequal.go:47-49: an unsupported key type (nil, etc.) is
+        # false for NotEquals too, NOT the negation of Equals
+        if key is None or not isinstance(key, (bool, int, float, str, dict, list)):
+            return False
         return not _equals(key, value)
     if op == "in":
         return _deprecated_in(key, value, not_in=False)

@@ -21,6 +21,15 @@ class InvalidVariableError(Exception):
     pass
 
 
+class VariableNotFoundError(InvalidVariableError):
+    """The reference's forked go-jmespath returns a NotFoundError when
+    a plain field path does not exist in the document (as opposed to
+    existing with a null value). Substitution propagates it
+    (vars.go:351-359), so conditions over missing paths surface as
+    rule errors — the behavior the nil-values-in-variables fixtures
+    pin down."""
+
+
 class ContextEntryError(Exception):
     """A registered context-entry loader failed. Deliberately NOT an
     InvalidVariableError: the preconditions resolver maps unresolved
@@ -119,9 +128,13 @@ class Context:
             raise InvalidVariableError("invalid query (nil)")
         self._load_deferred(query)
         try:
-            return jp.search(query, self._root)
+            result = jp.search(query, self._root)
         except JMESPathError as e:
             raise InvalidVariableError(f"failed to query {query!r}: {e}") from e
+        if result is None and _is_bare_path(query) \
+                and not _path_exists(self._root, query):
+            raise VariableNotFoundError(f"variable {query} not found")
+        return result
 
     def query_operation(self) -> str:
         req = self._root.get("request") or {}
@@ -205,6 +218,52 @@ def _merge(dst: Dict[str, Any], src: Dict[str, Any]) -> None:
             _merge(dst[k], v)
         else:
             dst[k] = v
+
+
+_BARE_SEGMENT = r'(?:[A-Za-z_][A-Za-z0-9_]*|"(?:[^"\\]|\\.)*")(?:\[\d+\])*'
+_BARE_PATH_RE = None  # compiled lazily
+
+
+def _is_bare_path(query: str) -> bool:
+    """True for plain field paths (identifiers/quoted keys/numeric
+    indexes) — the shape whose missing-path lookups raise the forked
+    go-jmespath NotFoundError. Expressions (functions, projections,
+    pipes, operators) keep standard null semantics."""
+    import re
+
+    global _BARE_PATH_RE
+    if _BARE_PATH_RE is None:
+        _BARE_PATH_RE = re.compile(
+            rf"^{_BARE_SEGMENT}(?:\.{_BARE_SEGMENT})*$")
+    return _BARE_PATH_RE.match(query) is not None
+
+
+def _bare_segments(query: str):
+    """Split a bare path into (key, [indexes]) pairs."""
+    import re
+
+    out = []
+    for m in re.finditer(_BARE_SEGMENT, query):
+        seg = m.group(0)
+        idx = [int(i) for i in re.findall(r"\[(\d+)\]", seg)]
+        key = re.sub(r"\[\d+\]", "", seg)
+        if key.startswith('"'):
+            key = key[1:-1].replace('\\"', '"')
+        out.append((key, idx))
+    return out
+
+
+def _path_exists(root: Any, query: str) -> bool:
+    node = root
+    for key, indexes in _bare_segments(query):
+        if not isinstance(node, dict) or key not in node:
+            return False
+        node = node[key]
+        for i in indexes:
+            if not isinstance(node, list) or i >= len(node):
+                return False
+            node = node[i]
+    return True
 
 
 def _query_references(query: str, name: str) -> bool:

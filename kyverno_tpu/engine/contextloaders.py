@@ -27,7 +27,12 @@ class ContextLoaderError(Exception):
 
 
 class DataSources:
-    """Pluggable backends for context entries."""
+    """Pluggable backends for context entries. A ``None`` backend means
+    the source is unavailable: entries of that kind are silently
+    disabled, matching the reference factory's behavior when the
+    resolver/client is nil (factories/contextloaderfactory.go:103-131
+    logs "disabled loading of ... context entry" and registers no
+    loader). A present backend that fails a lookup is still an error."""
 
     def __init__(
         self,
@@ -37,10 +42,10 @@ class DataSources:
         global_context: Optional[Dict[str, Any]] = None,
     ):
         # configmaps: "namespace/name" -> configmap object dict
-        self.configmaps = configmaps or {}
+        self.configmaps = configmaps
         self.api_call = api_call
         self.image_data = image_data
-        self.global_context = global_context or {}
+        self.global_context = global_context
 
 
 def load_context_entries(
@@ -56,6 +61,8 @@ def load_context_entries(
         if not name:
             raise ContextLoaderError("context entry without name")
         loader = _make_loader(ctx, entry, sources)
+        if loader is None:
+            continue  # backend unavailable: entry disabled, not an error
         if deferred:
             ctx.add_deferred_loader(name, loader)
         else:
@@ -67,12 +74,20 @@ def _make_loader(ctx: Context, entry: Dict[str, Any], sources: DataSources):
     if "variable" in entry:
         return lambda: _load_variable(ctx, entry["variable"])
     if "configMap" in entry:
+        if sources.configmaps is None:
+            return None
         return lambda: _load_configmap(ctx, entry["configMap"], sources)
     if "apiCall" in entry:
+        if sources.api_call is None:
+            return None
         return lambda: _load_apicall(ctx, entry["apiCall"], sources)
     if "imageRegistry" in entry:
+        if sources.image_data is None:
+            return None
         return lambda: _load_image_registry(ctx, entry["imageRegistry"], sources)
     if "globalReference" in entry:
+        if sources.global_context is None:
+            return None
         return lambda: _load_global(ctx, entry["globalReference"], sources)
     raise ContextLoaderError(f"context entry {name!r} has no recognized source")
 
@@ -117,8 +132,6 @@ def _load_configmap(ctx: Context, spec: Dict[str, Any], sources: DataSources) ->
 
 
 def _load_apicall(ctx: Context, spec: Dict[str, Any], sources: DataSources) -> Any:
-    if sources.api_call is None:
-        raise ContextLoaderError("no API-call backend configured")
     substituted = substitute_all(ctx, dict(spec))
     data = sources.api_call(substituted)
     jmes = substituted.get("jmesPath")
@@ -131,8 +144,6 @@ def _load_apicall(ctx: Context, spec: Dict[str, Any], sources: DataSources) -> A
 
 
 def _load_image_registry(ctx: Context, spec: Dict[str, Any], sources: DataSources) -> Any:
-    if sources.image_data is None:
-        raise ContextLoaderError("no image-registry backend configured")
     reference = substitute_all(ctx, spec.get("reference", ""))
     data = sources.image_data(reference)
     jmes = spec.get("jmesPath")

@@ -63,9 +63,20 @@ class Engine:
             namespace_labels=pctx.namespace_labels,
         )
         for rule in pctx.policy.get_rules():
-            if not rule.has_validate():
+            if rule.has_validate():
+                rr = self._invoke_rule(pctx, rule, self._validate_rule)
+            elif rule.has_verify_images():
+                # verifyImages rules with digest/required checks also
+                # run in the validate stage without registry access
+                # (validation.go HasVerifyImageChecks branch →
+                # handlers/validation/validate_image.go)
+                from ..images import has_verify_image_checks
+
+                if not has_verify_image_checks(rule.verify_images):
+                    continue
+                rr = self._invoke_rule(pctx, rule, self._validate_image_checks)
+            else:
                 continue
-            rr = self._invoke_rule(pctx, rule, self._validate_rule)
             if rr is not None:
                 response.policy_response.add(*rr)
         return response
@@ -179,6 +190,7 @@ class Engine:
             pctx.admission_info,
             pctx.namespace_labels,
             pctx.policy.namespace,
+            gvk=pctx.gvk,
             subresource=pctx.subresource,
             operation=pctx.operation,
         )
@@ -219,8 +231,12 @@ class Engine:
 
     def _typed_exceptions(self):
         """Exceptions parsed once (they arrive as dicts from YAML/CR
-        watches); cached on the engine instance."""
-        key = tuple(id(e) for e in self.exceptions)
+        watches); cached on the engine instance. Keyed by list identity
+        AND element identities: the list id catches a swapped list whose
+        freed elements were reallocated at the old addresses, the
+        element ids catch in-place replacement (`exceptions[i] = new`)
+        by a watch handler sharing the list with this engine."""
+        key = (id(self.exceptions), tuple(id(e) for e in self.exceptions))
         cached = getattr(self, "_typed_exc_cache", None)
         if cached is None or cached[0] != key:
             from ..api.exception import PolicyException
@@ -311,7 +327,48 @@ class Engine:
                 extra_exclusions=self._pod_security_exclusions(pctx, rule))]
         if v.cel is not None:
             return [self._validate_cel(pctx, name, rule)]
+        if v.manifests is not None:
+            return [self._validate_manifests(pctx, name, rule)]
         return [RuleResponse.rule_error(name, RULE_TYPE_VALIDATION, "invalid validation rule")]
+
+    def _validate_manifests(self, pctx: PolicyContext, name: str, rule: Rule) -> RuleResponse:
+        """validate.manifests handler (validate_manifest.go:53 Process):
+        signed-YAML verification; DELETE requests are skipped like the
+        reference's nil handler (NewValidateManifestHandler:45)."""
+        from .manifests import ManifestVerificationError, verify_manifest
+
+        if pctx.operation == "DELETE" and not pctx.new_resource:
+            return RuleResponse.rule_skip(
+                name, RULE_TYPE_VALIDATION, "manifest verification skipped on delete")
+        try:
+            verified, reason = verify_manifest(
+                pctx.new_resource, rule.validation.manifests or {})
+        except ManifestVerificationError as e:
+            return RuleResponse.rule_error(
+                name, RULE_TYPE_VALIDATION,
+                f"error occurred during manifest verification: {e}")
+        if not verified:
+            return RuleResponse.rule_fail(name, RULE_TYPE_VALIDATION, reason)
+        return RuleResponse.rule_pass(name, RULE_TYPE_VALIDATION, reason)
+
+    def _validate_image_checks(self, pctx: PolicyContext, rule: Rule) -> List[RuleResponse]:
+        """validate-side verifyImages checks (validate_image.go:41):
+        digest presence + verified-annotation lookups, no registry."""
+        from ..images import BadImageError, extract_images, validate_image_rule
+
+        if pctx.operation == "DELETE" and not pctx.new_resource:
+            return []
+        try:
+            extracted = extract_images(pctx.new_resource, rule.image_extractors)
+        except BadImageError as e:
+            return [RuleResponse.rule_error(
+                rule.name, RULE_TYPE_VALIDATION, str(e))]
+        images = [info for group in extracted.values()
+                  for info in group.values()]
+        if not images:
+            return []  # no images => handler not created (nil, nil)
+        return validate_image_rule(rule.verify_images or [], rule.name,
+                                   images, pctx.new_resource)
 
     def _validate_cel(self, pctx: PolicyContext, name: str, rule: Rule) -> RuleResponse:
         """validate.cel handler (validate_cel.go:40 Process): CEL
@@ -441,85 +498,119 @@ class Engine:
     def _run_foreach(
         self, pctx: PolicyContext, name: str, rule: Rule, fe: Dict[str, Any], nesting: int
     ):
-        """Returns (fail/error response or None, applied element count)."""
+        """One foreach entry (validateForEach + validateElements,
+        validate_resource.go:186-252). Returns (fail/error response or
+        None, applied element count). List-evaluation failures skip the
+        entry entirely (:190-193 `continue`); per-element ERRORS are
+        dropped unless the element is the LAST one (:239-246)."""
         ctx = pctx.json_context
         list_expr = fe.get("list", "")
         try:
             elements = ctx.query(substitute_all(ctx, list_expr, precondition_resolver))
-        except (InvalidVariableError, SubstitutionError) as e:
-            return (
-                RuleResponse.rule_error(name, RULE_TYPE_VALIDATION, f"foreach list error: {e}"),
-                0,
-            )
+        except (InvalidVariableError, SubstitutionError):
+            return None, 0  # EvaluateList error => entry skipped
         if elements is None:
             return None, 0  # nothing to iterate
         if isinstance(elements, dict):
             elements = [{"key": k, "value": v} for k, v in elements.items()]
         if not isinstance(elements, list):
-            return (
-                RuleResponse.rule_error(
-                    name, RULE_TYPE_VALIDATION, f"foreach list is not a list: {list_expr}"
-                ),
-                0,
-            )
+            return None, 0
         applied = 0
-        element_scope = fe.get("elementScope", True)
+        # elementScope is tri-state (utils/foreach.go:41-56): default =
+        # scoped iff the element is a map; an explicit true on a
+        # non-map element is a rule ERROR; explicit false disables.
+        element_scope = fe.get("elementScope")
+        last = len(elements) - 1
         for i, element in enumerate(elements):
             if element is None:
                 continue  # validate_resource.go:212 skips nil elements
+            if element_scope is True and not isinstance(element, dict):
+                # AddElementToContext failure: immediate rule error
+                # (validateElements:218-221)
+                return (
+                    RuleResponse.rule_error(
+                        name, RULE_TYPE_VALIDATION,
+                        "cannot use elementScope=true foreach rules for "
+                        f"elements that are not maps, got {type(element).__name__}"),
+                    applied,
+                )
             ctx.checkpoint()
             try:
-                try:
-                    load_context_entries(ctx, fe.get("context") or [], self.data_sources)
-                except ContextLoaderError as e:
-                    return RuleResponse.rule_error(name, RULE_TYPE_VALIDATION, str(e)), applied
-                ctx.add_element(element, i, nesting)
-                try:
-                    if not evaluate_conditions(ctx, fe.get("preconditions")):
-                        continue
-                except (SubstitutionError, InvalidVariableError) as e:
-                    return RuleResponse.rule_error(name, RULE_TYPE_VALIDATION, str(e)), applied
-                target = element if element_scope and isinstance(element, dict) else pctx.new_resource
-                if fe.get("deny") is not None:
-                    try:
-                        denied = evaluate_conditions(ctx, fe["deny"].get("conditions"))
-                    except (SubstitutionError, InvalidVariableError) as e:
-                        return RuleResponse.rule_error(name, RULE_TYPE_VALIDATION, str(e)), applied
-                    if denied:
-                        return (
-                            RuleResponse.rule_fail(
-                                name, RULE_TYPE_VALIDATION,
-                                self._message(ctx, rule, f"denied at element {i}"),
-                            ),
-                            applied,
-                        )
-                    applied += 1
-                elif fe.get("pattern") is not None or fe.get("anyPattern") is not None:
-                    pseudo = Rule.from_dict(
-                        {
-                            "name": name,
-                            "validate": {
-                                "message": rule.validation.message,
-                                "pattern": fe.get("pattern"),
-                                "anyPattern": fe.get("anyPattern"),
-                            },
-                        }
-                    )
-                    rr = self._validate_patterns(ctx, name, pseudo, target)
-                    if rr.is_fail() or rr.status == "error":
-                        rr.message = f"{rr.message} (element {i})"
-                        return rr, applied
-                    if rr.status != "skip":
-                        applied += 1
-                elif fe.get("foreach") is not None:
-                    for nested in fe["foreach"]:
-                        result, count = self._run_foreach(pctx, name, rule, nested, nesting + 1)
-                        applied += count
-                        if result is not None:
-                            return result, applied
+                rr = self._foreach_element(pctx, name, rule, fe, element, i, nesting)
             finally:
                 ctx.restore()
+            if rr is None or rr.status == "skip":
+                continue
+            if rr.status == "error":
+                if i < last:
+                    continue  # non-final element errors are dropped
+                rr.message = f"validation failure: {rr.message}"
+                return rr, applied
+            if rr.is_fail():
+                return rr, applied
+            applied += 1
         return None, applied
+
+    def _foreach_element(
+        self, pctx: PolicyContext, name: str, rule: Rule, fe: Dict[str, Any],
+        element: Any, i: int, nesting: int
+    ) -> Optional[RuleResponse]:
+        """One element through the nested validator (newForEachValidator
+        -> validator.validate): context -> preconditions -> deny/pattern/
+        nested-foreach. None = not applied (a nested foreach with zero
+        applications)."""
+        ctx = pctx.json_context
+        try:
+            load_context_entries(ctx, fe.get("context") or [], self.data_sources)
+        except ContextLoaderError as e:
+            return RuleResponse.rule_error(name, RULE_TYPE_VALIDATION, str(e))
+        ctx.add_element(element, i, nesting)
+        try:
+            if not evaluate_conditions(ctx, fe.get("preconditions")):
+                return RuleResponse.rule_skip(
+                    name, RULE_TYPE_VALIDATION, "preconditions not met")
+        except (SubstitutionError, InvalidVariableError) as e:
+            return RuleResponse.rule_error(name, RULE_TYPE_VALIDATION, str(e))
+        element_scope = fe.get("elementScope")
+        scoped = (isinstance(element, dict) if element_scope is None
+                  else element_scope)
+        target = element if scoped and isinstance(element, dict) else pctx.new_resource
+        if fe.get("deny") is not None:
+            try:
+                denied = evaluate_conditions(ctx, fe["deny"].get("conditions"))
+            except (SubstitutionError, InvalidVariableError) as e:
+                return RuleResponse.rule_error(name, RULE_TYPE_VALIDATION, str(e))
+            if denied:
+                return RuleResponse.rule_fail(
+                    name, RULE_TYPE_VALIDATION,
+                    self._message(ctx, rule, f"denied at element {i}"))
+            return RuleResponse.rule_pass(name, RULE_TYPE_VALIDATION, "")
+        if fe.get("pattern") is not None or fe.get("anyPattern") is not None:
+            pseudo = Rule.from_dict(
+                {
+                    "name": name,
+                    "validate": {
+                        "message": rule.validation.message,
+                        "pattern": fe.get("pattern"),
+                        "anyPattern": fe.get("anyPattern"),
+                    },
+                }
+            )
+            rr = self._validate_patterns(ctx, name, pseudo, target)
+            if rr.is_fail() or rr.status == "error":
+                rr.message = f"{rr.message} (element {i})"
+            return rr
+        if fe.get("foreach") is not None:
+            applied = 0
+            for nested in fe["foreach"]:
+                result, count = self._run_foreach(pctx, name, rule, nested, nesting + 1)
+                if result is not None:
+                    return result
+                applied += count
+            if applied == 0:
+                return None
+            return RuleResponse.rule_pass(name, RULE_TYPE_VALIDATION, "")
+        return None
 
     # -- mutation handler (mutate_resource.go, mutation.go)
 

@@ -684,10 +684,136 @@ def _fn_random(fn, args):
 
 
 def _fn_x509_decode(fn, args):
-    raise FunctionError(
-        "x509_decode: certificate parsing requires the host cosign/notary "
-        "subsystem and is not available in this build"
-    )
+    """x509_decode (functions.go:1177 jpX509Decode): PEM CERTIFICATE or
+    CERTIFICATE REQUEST -> Go x509.Certificate-shaped object. RSA only,
+    with PublicKey rendered {N: decimal string, E: int} like the
+    reference's PublicKey override (functions.go:1212-1215)."""
+    pem_text = _require(fn, args[0], "string")
+    try:
+        from cryptography import x509 as cx509
+        from cryptography.hazmat.primitives.asymmetric import rsa
+    except ImportError as e:  # pragma: no cover - baked into the image
+        raise FunctionError(f"x509_decode: crypto backend unavailable: {e}")
+    data = pem_text.encode()
+    if b"-----BEGIN" not in data:
+        raise FunctionError("x509_decode: failed to decode PEM block")
+    is_csr = b"CERTIFICATE REQUEST" in data
+    try:
+        import warnings
+
+        with warnings.catch_warnings():
+            # Go's parser accepts non-positive serial numbers; match it
+            warnings.simplefilter("ignore")
+            if is_csr:
+                cert = cx509.load_pem_x509_csr(data)
+            else:
+                cert = cx509.load_pem_x509_certificate(data)
+    except ValueError as e:
+        raise FunctionError(f"x509_decode: {e}")
+    pub = cert.public_key()
+    if not isinstance(pub, rsa.RSAPublicKey):
+        raise FunctionError("x509_decode: certificate should use rsa algorithm")
+    numbers = pub.public_numbers()
+
+    def _name(n):
+        # pkix.Name JSON shape (the fields Go marshals)
+        oid = {k: [a.value for a in n.get_attributes_for_oid(v)]
+               for k, v in (
+                   ("Country", cx509.NameOID.COUNTRY_NAME),
+                   ("Organization", cx509.NameOID.ORGANIZATION_NAME),
+                   ("OrganizationalUnit", cx509.NameOID.ORGANIZATIONAL_UNIT_NAME),
+                   ("Locality", cx509.NameOID.LOCALITY_NAME),
+                   ("Province", cx509.NameOID.STATE_OR_PROVINCE_NAME),
+                   ("StreetAddress", cx509.NameOID.STREET_ADDRESS),
+                   ("PostalCode", cx509.NameOID.POSTAL_CODE),
+               )}
+        cn = n.get_attributes_for_oid(cx509.NameOID.COMMON_NAME)
+        sn = n.get_attributes_for_oid(cx509.NameOID.SERIAL_NUMBER)
+        return {
+            **oid,
+            "SerialNumber": sn[0].value if sn else "",
+            "CommonName": cn[0].value if cn else "",
+            # pkix.AttributeTypeAndValue.Type is asn1.ObjectIdentifier,
+            # which Go JSON-marshals as an int array
+            "Names": [{"Type": [int(x) for x in a.oid.dotted_string.split(".")],
+                       "Value": a.value} for a in n],
+            "ExtraNames": None,
+        }
+
+    # x509.SignatureAlgorithm enum values (crypto/x509 constants)
+    sig_algs = {
+        "1.2.840.113549.1.1.2": 1, "1.2.840.113549.1.1.4": 2,
+        "1.2.840.113549.1.1.5": 3, "1.2.840.113549.1.1.11": 4,
+        "1.2.840.113549.1.1.12": 5, "1.2.840.113549.1.1.13": 6,
+        "1.2.840.10040.4.3": 7, "2.16.840.1.101.3.4.3.2": 8,
+        "1.2.840.10045.4.1": 9, "1.2.840.10045.4.3.2": 10,
+        "1.2.840.10045.4.3.3": 11, "1.2.840.10045.4.3.4": 12,
+        "1.2.840.113549.1.1.10": 13, "1.3.101.112": 16,
+    }
+    sig_alg = sig_algs.get(cert.signature_algorithm_oid.dotted_string, 0)
+    out = {
+        "PublicKey": {"N": str(numbers.n), "E": numbers.e},
+        "PublicKeyAlgorithm": 1,  # x509.RSA
+        "SignatureAlgorithm": sig_alg,
+        "Subject": _name(cert.subject),
+    }
+    if is_csr:
+        out["Version"] = 0
+        return out
+    try:
+        san = cert.extensions.get_extension_for_class(
+            cx509.SubjectAlternativeName).value
+        dns_names = san.get_values_for_type(cx509.DNSName)
+        ip_addrs = [str(i) for i in san.get_values_for_type(cx509.IPAddress)]
+        emails = san.get_values_for_type(cx509.RFC822Name)
+        uris = san.get_values_for_type(cx509.UniformResourceIdentifier)
+    except cx509.ExtensionNotFound:
+        dns_names, ip_addrs, emails, uris = [], [], [], []
+    try:
+        bc = cert.extensions.get_extension_for_class(cx509.BasicConstraints)
+        is_ca, bc_valid = bool(bc.value.ca), True
+        max_path = bc.value.path_length if bc.value.path_length is not None else -1
+    except cx509.ExtensionNotFound:
+        is_ca, bc_valid, max_path = False, False, 0
+    # Go x509.KeyUsage bitmask (DigitalSignature=1 ... DecipherOnly=256)
+    key_usage = 0
+    try:
+        ku = cert.extensions.get_extension_for_class(cx509.KeyUsage).value
+        for bit, flag in enumerate((
+                ku.digital_signature, ku.content_commitment,
+                ku.key_encipherment, ku.data_encipherment, ku.key_agreement,
+                ku.key_cert_sign, ku.crl_sign)):
+            if flag:
+                key_usage |= 1 << bit
+        if ku.key_agreement:
+            if ku.encipher_only:
+                key_usage |= 1 << 7
+            if ku.decipher_only:
+                key_usage |= 1 << 8
+    except cx509.ExtensionNotFound:
+        pass
+    import warnings
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        serial = cert.serial_number
+    out.update({
+        "Version": cert.version.value + 1,
+        "SerialNumber": serial,
+        "KeyUsage": key_usage,
+        "Issuer": _name(cert.issuer),
+        "NotBefore": cert.not_valid_before_utc.strftime("%Y-%m-%dT%H:%M:%SZ"),
+        "NotAfter": cert.not_valid_after_utc.strftime("%Y-%m-%dT%H:%M:%SZ"),
+        "IsCA": is_ca,
+        "BasicConstraintsValid": bc_valid,
+        "MaxPathLen": max_path,
+        "MaxPathLenZero": max_path == 0,
+        "DNSNames": dns_names,
+        "EmailAddresses": emails,
+        "IPAddresses": ip_addrs,
+        "URIs": uris,
+    })
+    return out
 
 
 def _fn_image_normalize(fn, args):

@@ -8,11 +8,30 @@ namespace labels, operation, and the JSON variable context.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Tuple
 
 from ..api.policy import ClusterPolicy
 from .context import Context
 from .match import RequestInfo
+
+
+def context_image_infos(resource: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """The ``images`` context document extracted from a resource's
+    pod-spec containers (context.go:306 AddImageInfos →
+    convertImagesToUnstructured): {containerType: {containerName:
+    {registry,name,path,tag,digest,reference,referenceWithTag}}}."""
+    try:
+        from ..images import extract_images
+
+        extracted = extract_images(resource)
+    except Exception:
+        return None  # malformed image strings must not break context building
+    if not extracted:
+        return None
+    return {
+        group: {key: info.to_dict() for key, info in entries.items()}
+        for group, entries in extracted.items()
+    }
 
 
 @dataclass
@@ -24,6 +43,12 @@ class PolicyContext:
     namespace_labels: Dict[str, str] = field(default_factory=dict)
     operation: str = "CREATE"
     subresource: str = ""
+    # explicit (group, version, kind) for match gating; when set it
+    # overrides the resource's own apiVersion/kind — the admission and
+    # CLI subresource paths use this (WithResourceKind,
+    # policy_processor.go:86-105: a Scale document matches as
+    # Deployment/scale via the parent GVK + subresource name)
+    gvk: Optional[Tuple[str, str, str]] = None
     json_context: Context = field(default_factory=Context)
     element: Optional[Dict[str, Any]] = None
 
@@ -45,6 +70,9 @@ class PolicyContext:
         if old_resource:
             ctx.add_old_resource(old_resource)
         ctx.add_operation(operation)
+        images = context_image_infos(resource)
+        if images:
+            ctx.add_image_infos(images)
         info = admission_info or RequestInfo()
         ctx.add_user_info({"username": info.username, "uid": info.uid, "groups": info.groups})
         if info.username:

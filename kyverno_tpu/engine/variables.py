@@ -60,12 +60,14 @@ def default_resolver(ctx: Optional[Context], variable: str) -> Any:
 
 
 def precondition_resolver(ctx: Optional[Context], variable: str) -> Any:
-    """Preconditions treat unresolvable variables as None
-    (vars.go newPreconditionsVariableResolver)."""
-    try:
-        return default_resolver(ctx, variable)
-    except InvalidVariableError:
-        return None
+    """Preconditions resolver (vars.go:42 newPreconditionsVariableResolver).
+    Despite its stale upstream comment, it PROPAGATES evaluation errors
+    (vars.go:45-53 logs and returns err; vars.go:351-359 surfaces it).
+    Unset variables already resolve to None naturally — JMESPath
+    returns null for missing paths without erroring — so the lenient
+    behavior preconditions need comes from query semantics, not from
+    swallowing genuine evaluation errors (type errors, bad syntax)."""
+    return default_resolver(ctx, variable)
 
 
 def substitute_all(ctx: Optional[Context], document: Any, resolver: VariableResolver = default_resolver) -> Any:

@@ -20,7 +20,9 @@ from .verify import (
     ImageVerificationMetadata,
     Verifier,
     expand_static_keys,
+    has_verify_image_checks,
     validate_image,
+    validate_image_rule,
 )
 
 __all__ = [
@@ -28,5 +30,6 @@ __all__ = [
     "REGISTERED", "ImageVerifyCache", "disabled_cache", "StaticRegistry",
     "VerifyOptions", "Response", "RegistryError", "VerificationFailed",
     "Verifier", "ImageVerificationMetadata", "VERIFY_ANNOTATION",
-    "expand_static_keys", "validate_image",
+    "expand_static_keys", "validate_image", "validate_image_rule",
+    "has_verify_image_checks",
 ]

@@ -495,6 +495,75 @@ def _resolve_pointer(doc: Any, pointer: str) -> Any:
     return node
 
 
+def validate_image_rule(rule_verify_images: List[Dict[str, Any]],
+                        rule_name: str,
+                        images: List[ImageInfo],
+                        resource: Dict[str, Any]) -> List[RuleResponse]:
+    """The validate-side verifyImages handler, one AGGREGATED response
+    per rule (handlers/validation/validate_image.go:66-101): fail fast
+    on the first failing image (missing digest under verifyDigest, or
+    unverified under required); pass when any image passed or no image
+    applied; skip when every applicable image was skipped. An image
+    that does not match the rule's imageReferences aborts the whole
+    rule with NO response (validate_image.go:74-77), which the CLI test
+    harness reports as "excluded"."""
+    annotations = (resource.get("metadata") or {}).get("annotations") or {}
+    ivm = None
+    if VERIFY_ANNOTATION in annotations:
+        try:
+            ivm = ImageVerificationMetadata.parse_annotation(
+                annotations[VERIFY_ANNOTATION])
+        except (ValueError, TypeError):
+            ivm = None
+    skipped: List[str] = []
+    passed: List[str] = []
+    for iv in rule_verify_images:
+        refs = image_references(iv)
+        verify_digest = iv.get("verifyDigest", True)
+        required = iv.get("required", True)
+        for info in images:
+            image = str(info)
+            if not matches_references(refs, image):
+                return []
+            if verify_digest and not info.digest:
+                return [RuleResponse.rule_fail(
+                    rule_name, RULE_TYPE_IMAGE_VERIFY,
+                    f"missing digest for {image}")]
+            # images not under `required` count as "not applied": they
+            # land in neither list, so an all-unrequired rule passes
+            # (validate_image.go:103 zero-value status)
+            status = None
+            if required:
+                # IsImageVerified (engine/utils/image.go:68): absent or
+                # unparsable annotation, or absent image entry => fail
+                status = ivm.data.get(image, "fail") if ivm else "fail"
+                if status == "fail":
+                    return [RuleResponse.rule_fail(
+                        rule_name, RULE_TYPE_IMAGE_VERIFY,
+                        f"unverified image {image}")]
+            if status == "skip":
+                skipped.append(image)
+            elif status == "pass":
+                passed.append(image)
+    from ..engine.response import RULE_TYPE_VALIDATION
+
+    if passed or not (passed or skipped):
+        msg = "image verified"
+        if skipped:
+            msg += ", skipped images: " + " ".join(skipped)
+        return [RuleResponse.rule_pass(rule_name, RULE_TYPE_VALIDATION, msg)]
+    return [RuleResponse.rule_skip(
+        rule_name, RULE_TYPE_VALIDATION,
+        "image skipped, skipped images: " + " ".join(skipped))]
+
+
+def has_verify_image_checks(rule_verify_images: List[Dict[str, Any]]) -> bool:
+    """rule_types.go:139 HasVerifyImageChecks: any entry with
+    verifyDigest or required (both default true)."""
+    return any(iv.get("verifyDigest", True) or iv.get("required", True)
+               for iv in rule_verify_images or [])
+
+
 def validate_image(rule_verify_images: List[Dict[str, Any]],
                    rule_name: str,
                    images: List[ImageInfo],

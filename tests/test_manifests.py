@@ -1,0 +1,227 @@
+"""validate.manifests — real-crypto unit tests.
+
+Signs manifests with freshly generated ECDSA P-256 keys (the same
+scheme the reference verifies via k8s-manifest-sigstore:
+message = base64(gzip(tar.gz(yaml))), signature = ECDSA-SHA256 over the
+inner tar.gz — see kyverno_tpu/engine/manifests.py), then checks the
+engine's pass/fail/error behavior on genuine, tampered, and unsigned
+resources. Reference: pkg/engine/handlers/validation/validate_manifest.go.
+"""
+
+import base64
+import copy
+import gzip
+import io
+import tarfile
+
+import pytest
+import yaml
+
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import ec
+
+from kyverno_tpu.api.policy import ClusterPolicy
+from kyverno_tpu.engine.engine import Engine
+from kyverno_tpu.engine.manifests import (
+    DEFAULT_ANNOTATION_DOMAIN,
+    ManifestVerificationError,
+    masked_diff,
+    verify_manifest,
+)
+from kyverno_tpu.engine.policycontext import PolicyContext
+
+
+def _keypair():
+    key = ec.generate_private_key(ec.SECP256R1())
+    pem = key.public_key().public_bytes(
+        serialization.Encoding.PEM,
+        serialization.PublicFormat.SubjectPublicKeyInfo).decode()
+    return key, pem
+
+
+def _sign_resource(resource, private_keys, domain=DEFAULT_ANNOTATION_DOMAIN):
+    """Produce the annotated resource the way k8s-manifest-sigstore
+    does: tar the YAML, gzip it, sign the tar.gz, wrap in another gzip
+    + base64 for the message annotation."""
+    manifest_yaml = yaml.safe_dump(resource, sort_keys=False).encode()
+    tar_buf = io.BytesIO()
+    with tarfile.open(fileobj=tar_buf, mode="w:gz") as tar:
+        info = tarfile.TarInfo("resource-sig-tmp.yaml")
+        info.size = len(manifest_yaml)
+        tar.addfile(info, io.BytesIO(manifest_yaml))
+    payload = tar_buf.getvalue()
+    message = base64.b64encode(gzip.compress(payload)).decode()
+    signed = copy.deepcopy(resource)
+    annotations = signed.setdefault("metadata", {}).setdefault("annotations", {})
+    annotations[f"{domain}/message"] = message
+    for i, key in enumerate(private_keys):
+        sig = key.sign(payload, ec.ECDSA(hashes.SHA256()))
+        suffix = "signature" if i == 0 else f"signature_{i}"
+        annotations[f"{domain}/{suffix}"] = base64.b64encode(sig).decode()
+    return signed
+
+
+def _service(name="web"):
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {"name": name},
+        "spec": {"ports": [{"port": 80, "targetPort": 8080}],
+                 "selector": {"app": name}},
+    }
+
+
+def _policy(pem, count=None, extra_entries=None):
+    entry = {"keys": {"publicKeys": pem, "signatureAlgorithm": "sha256"}}
+    entries = [entry] + (extra_entries or [])
+    attestor = {"entries": entries}
+    if count is not None:
+        attestor["count"] = count
+    return ClusterPolicy.from_dict({
+        "apiVersion": "kyverno.io/v1",
+        "kind": "ClusterPolicy",
+        "metadata": {"name": "check-manifest"},
+        "spec": {"rules": [{
+            "name": "verify-manifest",
+            "match": {"any": [{"resources": {"kinds": ["Service"]}}]},
+            "validate": {"manifests": {"attestors": [attestor]}},
+        }]},
+    })
+
+
+def _run(policy, resource):
+    eng = Engine()
+    pctx = PolicyContext.build(policy, resource)
+    resp = eng.validate(pctx)
+    [rr] = resp.policy_response.rules
+    return rr
+
+
+class TestManifestVerification:
+    def test_genuinely_signed_passes(self):
+        key, pem = _keypair()
+        signed = _sign_resource(_service(), [key])
+        rr = _run(_policy(pem), signed)
+        assert rr.status == "pass", rr.message
+
+    def test_tampered_resource_fails_with_diff(self):
+        key, pem = _keypair()
+        signed = _sign_resource(_service(), [key])
+        signed["spec"]["ports"][0]["port"] = 443  # post-signing mutation
+        rr = _run(_policy(pem), signed)
+        assert rr.status == "fail"
+        assert "diff" in rr.message
+
+    def test_unsigned_resource_fails(self):
+        _, pem = _keypair()
+        rr = _run(_policy(pem), _service())
+        assert rr.status == "fail"
+        assert "no signed message" in rr.message
+
+    def test_wrong_key_fails(self):
+        key, _ = _keypair()
+        _, other_pem = _keypair()
+        signed = _sign_resource(_service(), [key])
+        rr = _run(_policy(other_pem), signed)
+        assert rr.status == "fail"
+        assert "failed to verify signature" in rr.message
+
+    def test_tampered_signature_fails(self):
+        key, pem = _keypair()
+        signed = _sign_resource(_service(), [key])
+        ann = signed["metadata"]["annotations"]
+        sig = bytearray(base64.b64decode(
+            ann[f"{DEFAULT_ANNOTATION_DOMAIN}/signature"]))
+        sig[-1] ^= 0xFF
+        ann[f"{DEFAULT_ANNOTATION_DOMAIN}/signature"] = \
+            base64.b64encode(bytes(sig)).decode()
+        rr = _run(_policy(pem), signed)
+        assert rr.status == "fail"
+
+    def test_multi_signature_count(self):
+        # two keys must both verify (count=2) against signature and
+        # signature_1 annotations (validate_manifest.go numbered keys)
+        k1, p1 = _keypair()
+        k2, p2 = _keypair()
+        signed = _sign_resource(_service(), [k1, k2])
+        pol = _policy(p1, count=2, extra_entries=[
+            {"keys": {"publicKeys": p2, "signatureAlgorithm": "sha256"}}])
+        assert _run(pol, signed).status == "pass"
+        # one of two signatures missing -> that key fails -> count unmet
+        del signed["metadata"]["annotations"][
+            f"{DEFAULT_ANNOTATION_DOMAIN}/signature_1"]
+        assert _run(pol, signed).status == "fail"
+
+    def test_count_one_of_two(self):
+        k1, p1 = _keypair()
+        _, p2 = _keypair()
+        signed = _sign_resource(_service(), [k1])
+        pol = _policy(p1, count=1, extra_entries=[
+            {"keys": {"publicKeys": p2}}])
+        assert _run(pol, signed).status == "pass"
+
+    def test_keyless_attestor_errors(self):
+        key, _ = _keypair()
+        signed = _sign_resource(_service(), [key])
+        pol = ClusterPolicy.from_dict({
+            "apiVersion": "kyverno.io/v1",
+            "kind": "ClusterPolicy",
+            "metadata": {"name": "check-manifest"},
+            "spec": {"rules": [{
+                "name": "verify-manifest",
+                "match": {"any": [{"resources": {"kinds": ["Service"]}}]},
+                "validate": {"manifests": {"attestors": [{"entries": [
+                    {"keyless": {"issuer": "https://accounts.example.com"}},
+                ]}]}},
+            }]},
+        })
+        rr = _run(pol, signed)
+        assert rr.status == "error"
+        assert "not supported offline" in rr.message
+
+    def test_ignore_fields_allow_declared_mutation(self):
+        key, pem = _keypair()
+        signed = _sign_resource(_service(), [key])
+        signed["spec"]["ports"][0]["port"] = 443
+        pol_dict = _policy(pem).raw
+        pol_dict["spec"]["rules"][0]["validate"]["manifests"]["ignoreFields"] = [
+            {"fields": ["spec.ports.*.port"], "objects": [{"kind": "Service"}]},
+        ]
+        rr = _run(ClusterPolicy.from_dict(pol_dict), signed)
+        assert rr.status == "pass", rr.message
+
+    def test_default_ignore_fields_cover_namespace_and_status(self):
+        key, pem = _keypair()
+        signed = _sign_resource(_service(), [key])
+        signed["metadata"]["namespace"] = "prod"
+        signed["status"] = {"loadBalancer": {}}
+        rr = _run(_policy(pem), signed)
+        assert rr.status == "pass", rr.message
+
+    def test_multi_pem_bundle_expands(self):
+        # two PEM blocks in one publicKeys string = two entries, both
+        # required (ExpandStaticKeys semantics shared with images)
+        k1, p1 = _keypair()
+        k2, p2 = _keypair()
+        signed = _sign_resource(_service(), [k1, k2])
+        assert _run(_policy(p1 + "\n" + p2), signed).status == "pass"
+
+
+class TestMaskedDiff:
+    def test_clean_match(self):
+        a = {"kind": "Service", "metadata": {"name": "x"}, "spec": {"p": 1}}
+        assert masked_diff(a, copy.deepcopy(a), [], "cosign.sigstore.dev") == []
+
+    def test_added_and_changed_fields_surface(self):
+        a = {"kind": "Service", "metadata": {"name": "x"}, "spec": {"p": 1}}
+        b = {"kind": "Service", "metadata": {"name": "x"},
+             "spec": {"p": 2, "q": 3}}
+        diff = masked_diff(a, b, [], "cosign.sigstore.dev")
+        assert "~spec.p" in diff and "+spec.q" in diff
+
+    def test_signature_annotations_masked(self):
+        a = {"kind": "Service", "metadata": {"name": "x"}}
+        b = {"kind": "Service", "metadata": {"name": "x", "annotations": {
+            "cosign.sigstore.dev/signature": "zzz",
+            "cosign.sigstore.dev/message": "yyy"}}}
+        assert masked_diff(a, b, [], "cosign.sigstore.dev") == []

@@ -6,7 +6,7 @@ from kyverno_tpu.engine.conditions import (
     evaluate_condition_values,
     evaluate_conditions,
 )
-from kyverno_tpu.engine.context import Context
+from kyverno_tpu.engine.context import Context, InvalidVariableError, VariableNotFoundError
 from kyverno_tpu.engine.variables import (
     SubstitutionError,
     is_reference,
@@ -37,7 +37,12 @@ class TestContext:
         assert ctx.query("request.object.metadata.name") == "nginx"
         assert ctx.query("request.object.spec.containers[0].image") == "nginx:1.25"
         assert ctx.query("request.operation") == "CREATE"
-        assert ctx.query("request.object.missing") is None
+        # missing bare paths raise like the forked go-jmespath
+        # NotFoundError (nil-values-in-variables corpus semantics);
+        # expressions keep null semantics
+        with pytest.raises(VariableNotFoundError):
+            ctx.query("request.object.missing")
+        assert ctx.query("request.object.missing || `null`") is None
 
     def test_checkpoint_restore(self):
         ctx = make_ctx()
@@ -45,7 +50,8 @@ class TestContext:
         ctx.add_variable("foo", "bar")
         assert ctx.query("foo") == "bar"
         ctx.restore()
-        assert ctx.query("foo") is None
+        with pytest.raises(VariableNotFoundError):
+            ctx.query("foo")
 
     def test_element(self):
         ctx = make_ctx()
@@ -73,6 +79,7 @@ class TestContext:
             return {"data": {"k": "v"}}
 
         ctx.add_deferred_loader("mycm", loader)
+        ctx.add_resource({})
         ctx.query("request.object")  # unrelated query: not loaded
         assert calls == []
         assert ctx.query("mycm.data.k") == "v"
@@ -117,8 +124,20 @@ class TestVariables:
         with pytest.raises(SubstitutionError):
             substitute_all(None, {"x": "{{foo}}"})
 
-    def test_precondition_resolver_nils(self):
-        out = substitute_all_in_preconditions(Context(), {"x": "{{ bad..query }}"})
+    def test_precondition_resolver_propagates_errors(self):
+        # vars.go:45-53: the preconditions resolver logs but PROPAGATES
+        # evaluation errors; missing paths resolve to None via query
+        # semantics instead
+        with pytest.raises(SubstitutionError):
+            substitute_all_in_preconditions(Context(), {"x": "{{ bad..query }}"})
+        ctx = Context()
+        ctx.add_resource({"metadata": {}})
+        with pytest.raises(SubstitutionError):
+            substitute_all_in_preconditions(
+                ctx, {"x": "{{ request.object.missing.path }}"})
+        # a present-but-null value stays null
+        ctx.add_variable("maybe", None)
+        out = substitute_all_in_preconditions(ctx, {"x": "{{ maybe }}"})
         assert out["x"] is None
 
     def test_detection(self):
@@ -245,8 +264,15 @@ class TestEvaluateConditions:
         assert evaluate_conditions(None, {})
         assert evaluate_conditions(None, [])
 
-    def test_unresolved_var_is_null(self):
+    def test_unresolved_var_errors(self):
+        # a missing bare path in a condition is a rule-level error
+        # (vars.go:351-359 propagates gojmespath.NotFoundError)
         ctx = make_ctx()
         conds = {"all": [{"key": "{{ nonexistent.thing }}", "operator": "Equals", "value": ""}]}
-        # null key vs "" value via Equals -> string compare fails (key None)
+        with pytest.raises((SubstitutionError, InvalidVariableError)):
+            evaluate_conditions(ctx, conds)
+        # an expression resolving to null is NOT an error: null key via
+        # Equals -> unsupported type -> false
+        conds = {"all": [{"key": "{{ nonexistent.thing || `null` }}",
+                          "operator": "Equals", "value": ""}]}
         assert not evaluate_conditions(ctx, conds)
