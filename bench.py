@@ -186,9 +186,16 @@ def _match_policies(n_rules=500, seed=1):
 
 
 def _expand_batch(batch, idx):
+    """Expand a vocabulary-form batch to len(idx) resources by gathering
+    the per-resource lanes; vocabulary tables (vocab_*/pool_svocab/
+    pool_slen) are shared across resources and pass through untouched."""
     import numpy as np
 
-    return {k: np.take(np.asarray(v), idx, axis=0) for k, v in batch.items()}
+    from kyverno_tpu.parallel.sharding import ShardedScanner
+
+    return {k: v if ShardedScanner._replicated_key(k)
+            else np.take(np.asarray(v), idx, axis=0)
+            for k, v in batch.items()}
 
 
 def bench_match(n_rules=500, n_resources=1_000_000, vocab=8192, tile=131072):

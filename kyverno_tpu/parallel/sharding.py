@@ -25,8 +25,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..api.policy import ClusterPolicy
 from ..tpu.compiler import CompiledPolicySet, compile_policy_set
-from ..tpu.evaluator import batch_to_device, build_program
-from ..tpu.flatten import EncodeConfig, encode_resources
+from ..tpu.evaluator import build_program
+from ..tpu.flatten import EncodeConfig, encode_resources_vocab
 from ..tpu.metadata import encode_metadata
 
 
@@ -85,8 +85,11 @@ class ShardedScanner:
         self._raw_fn = build_program(
             self.cps.device_programs, self.cps.encode_cfg.max_instances
         )
-        data_sharding = NamedSharding(self.mesh, P(self.axes))
         repl = NamedSharding(self.mesh, P())
+        # vocabulary-axis buckets grow monotonically so tile-to-tile
+        # vocabulary size changes never change the jitted shapes
+        self._vbucket = 1024
+        self._sbucket = 256
 
         def step(batch: Dict[str, jnp.ndarray]):
             verdicts = self._raw_fn(batch)  # (rules, N)
@@ -96,17 +99,19 @@ class ShardedScanner:
             )  # (rules, classes) — cross-device reduction over the N shard
             return verdicts, counts
 
+        # input shardings come from the committed arrays put() produces:
+        # per-resource lanes shard over the mesh, vocabulary lanes
+        # replicate (they are the per-tile "embedding tables")
         self._step = jax.jit(
             step,
-            in_shardings=({k: data_sharding for k in self._batch_keys()},),
             out_shardings=(NamedSharding(self.mesh, P(None, self.axes)), repl),
         )
 
-    def _batch_keys(self):
-        # all batch lanes lead with N; enumerate from a tiny probe encode
-        rows = encode_resources([{}], self.cps.encode_cfg, ())
-        meta = encode_metadata([{}])
-        return list(batch_to_device(rows, meta).keys())
+    # vocabulary lanes are replicated; everything else leads with N and
+    # shards across the mesh axes
+    @staticmethod
+    def _replicated_key(k: str) -> bool:
+        return k.startswith("vocab_") or k in ("pool_svocab", "pool_slen")
 
     @property
     def n_devices(self) -> int:
@@ -121,10 +126,14 @@ class ShardedScanner:
         padded = self.pad(max(n, 1))
         res = list(resources) + [{} for _ in range(padded - n)]
         ops = (list(operations) + [""] * (padded - n)) if operations else None
-        rows = encode_resources(res, self.cps.encode_cfg, self.cps.byte_paths,
-                                self.cps.key_byte_paths)
+        vb = encode_resources_vocab(res, self.cps.encode_cfg, self.cps.byte_paths,
+                                    self.cps.key_byte_paths)
         meta = encode_metadata(res, namespace_labels, ops, cfg=self.cps.meta_cfg)
-        return batch_to_device(rows, meta), n
+        while self._vbucket < vb.vocab_size:
+            self._vbucket *= 2
+        while self._sbucket < len(vb.strs):
+            self._sbucket *= 2
+        return vb.to_host(meta, self._vbucket, self._sbucket), n
 
     def scan_device(self, resources, namespace_labels=None, operations=None) -> Tuple[np.ndarray, np.ndarray]:
         """Device layer only: (verdicts (device_rules, n), counts).
@@ -132,7 +141,7 @@ class ShardedScanner:
         caps, and host-fallback rules are absent — use scan() for the
         complete, resolved result."""
         batch, n = self.encode(resources, namespace_labels, operations)
-        verdicts, counts = self._step(batch)
+        verdicts, counts = self._step(self.put(batch))
         return np.asarray(verdicts)[:, :n], np.asarray(counts)
 
     def scan(self, resources, namespace_labels=None, operations=None):
@@ -146,10 +155,16 @@ class ShardedScanner:
         return eng.assemble(device_table, resources, namespace_labels, operations)
 
     def put(self, batch: Dict[str, Any]) -> Dict[str, jnp.ndarray]:
-        """Place a host batch on the mesh with the step's data sharding
-        (resident across repeated steps — no per-step H2D transfer)."""
-        sh = NamedSharding(self.mesh, P(self.axes))
-        return {k: jax.device_put(jnp.asarray(v), sh) for k, v in batch.items()}
+        """Place a host batch on the mesh — per-resource lanes sharded
+        over the mesh axes, vocabulary lanes replicated — in ONE async
+        device_put over the whole lane dict (per-lane puts pay a link
+        round-trip each; the batched put streams at full H2D bandwidth
+        and overlaps with in-flight compute)."""
+        data = NamedSharding(self.mesh, P(self.axes))
+        repl = NamedSharding(self.mesh, P())
+        return jax.device_put(
+            batch,
+            {k: (repl if self._replicated_key(k) else data) for k in batch})
 
     def scan_stream(
         self,
@@ -217,7 +232,9 @@ class ShardedScanner:
                     ops = list(operations[sl]) + [""] * (tile - nv)
                 batch, _ = self.encode(padded, namespace_labels, ops)
             stats["encode_s"] += time.perf_counter() - t0
-            verdicts, _ = self._step(batch)  # async dispatch
+            # async sharded put then dispatch: the H2D copy of tile k+1
+            # overlaps the device compute of tiles k, k-1, ...
+            verdicts, _ = self._step(self.put(batch))
             pending.append((verdicts, sl, nv))
             stats["tiles"] += 1
             while len(pending) > max(in_flight, 1):

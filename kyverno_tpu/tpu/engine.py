@@ -26,7 +26,7 @@ from ..engine.match import RequestInfo
 from ..engine.policycontext import PolicyContext
 from ..engine.response import EngineResponse
 from .compiler import CompiledPolicySet, compile_policy_set
-from .evaluator import ERROR, FAIL, HOST, NOT_MATCHED, PASS, SKIP, batch_to_device
+from .evaluator import ERROR, FAIL, HOST, NOT_MATCHED, PASS, SKIP, batch_to_host
 from .flatten import EncodeConfig, encode_resources
 from .metadata import MetaConfig, encode_metadata
 
@@ -153,7 +153,7 @@ class TpuEngine:
                                 self.cps.key_byte_paths)
         meta = encode_metadata(resources, namespace_labels, operations,
                                admission_infos, self.cps.meta_cfg)
-        return batch_to_device(rows, meta), rows, meta
+        return batch_to_host(rows, meta), rows, meta
 
     # -- evaluation
 
@@ -182,7 +182,11 @@ class TpuEngine:
         infos = (list(admission_infos) + [None] * (padded_n - n)) \
             if admission_infos else None
         batch, rows, meta = self.encode(padded, namespace_labels, ops, infos)
-        device_table = np.asarray(self.cps.device_fn()(batch))[:, :n]  # (D, N)
+        import jax
+
+        # one batched H2D put for the whole lane dict — per-lane
+        # transfer pays a link round-trip per array (see batch_to_host)
+        device_table = np.asarray(self.cps.device_fn()(jax.device_put(batch)))[:, :n]  # (D, N)
         return self.assemble(
             device_table, resources, namespace_labels, operations, admission_infos
         )

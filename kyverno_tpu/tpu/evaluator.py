@@ -82,10 +82,42 @@ PASS, SKIP, FAIL, NOT_MATCHED, ERROR, HOST = 0, 1, 2, 3, 4, 5
 # batch assembly
 
 
-def batch_to_device(rows: RowBatch, meta: MetaBatch) -> Dict[str, jnp.ndarray]:
-    out = {k: jnp.asarray(v) for k, v in rows.arrays().items()}
+def batch_to_host(rows: RowBatch, meta: MetaBatch) -> Dict[str, Any]:
+    """Assemble the flat lane dict on host (numpy views — no copy, no
+    transfer). Device placement happens in ONE ``jax.device_put`` on the
+    whole dict: per-array ``jnp.asarray`` pays a round-trip per lane
+    over the (possibly tunneled) PCIe/ICI link and was the dominant
+    scan cost (~30x slower than a single batched put)."""
+    out = dict(rows.arrays())
     for k, v in meta.arrays().items():
-        out["meta_" + k] = jnp.asarray(v)
+        out["meta_" + k] = v
+    return out
+
+
+def batch_to_device(rows: RowBatch, meta: MetaBatch, sharding=None) -> Dict[str, jnp.ndarray]:
+    host = batch_to_host(rows, meta)
+    return jax.device_put(host, sharding) if sharding is not None else jax.device_put(host)
+
+
+def densify(batch: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:
+    """Materialize dense (n, max_rows) lanes from a vocabulary batch
+    (flatten.VocabBatch.to_host) via device-side gathers — the
+    embedding-lookup layout that keeps H2D transfer at ~1KB/resource.
+    Dense batches pass through untouched. Runs under jit; XLA fuses
+    each gather into the lane's consumers."""
+    if "row_idx" not in batch:
+        return batch
+    from .flatten import _ROW_LANES
+
+    idx = batch["row_idx"]
+    out = {k: v for k, v in batch.items() if k.startswith("meta_")}
+    for name in _ROW_LANES:
+        out[name] = jnp.take(batch["vocab_" + name], idx, axis=0)
+    sidx = batch["pool_sidx"]
+    out["pool"] = jnp.take(batch["pool_svocab"], sidx, axis=0)
+    out["pool_len"] = jnp.take(batch["pool_slen"], sidx, axis=0)
+    out["n_rows"] = batch["n_rows"]
+    out["fallback"] = batch["fallback"]
     return out
 
 
@@ -1309,7 +1341,7 @@ def build_program(programs: Sequence[RuleProgram], max_instances: int) -> Callab
     """Returns a jittable fn(batch dict) -> (num_rules, N) int32."""
 
     def run(batch: Dict[str, jnp.ndarray]) -> jnp.ndarray:
-        ctx = Ctx(batch, max_instances)
+        ctx = Ctx(densify(batch), max_instances)
         outs = [eval_rule(ctx, p) for p in programs]
         if not outs:
             return jnp.zeros((0, ctx.N), dtype=jnp.int32)
