@@ -1,0 +1,49 @@
+"""Native (C) components, compiled lazily with the system toolchain.
+
+The runtime around the XLA compute path is allowed to be native; the
+resource encoder is the scan pipeline's serial host bottleneck, so its
+hot walk lives in fastencode.c (see that file's header for the parity
+contract with the Python oracle). Build failures or
+KYVERNO_TPU_NATIVE=0 degrade silently to the Python encoder —
+correctness never depends on the toolchain."""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import subprocess
+import sysconfig
+from typing import Optional
+
+_mod = None
+_tried = False
+
+
+def load() -> Optional[object]:
+    """Compile (if stale) and import the _fastencode extension."""
+    global _mod, _tried
+    if _tried:
+        return _mod
+    _tried = True
+    if os.environ.get("KYVERNO_TPU_NATIVE", "1") == "0":
+        return None
+    here = os.path.dirname(os.path.abspath(__file__))
+    src = os.path.join(here, "fastencode.c")
+    so = os.path.join(here, "_fastencode.so")
+    try:
+        if (not os.path.exists(so)
+                or os.path.getmtime(so) < os.path.getmtime(src)):
+            inc = sysconfig.get_paths()["include"]
+            cc = os.environ.get("CC", "gcc")
+            tmp = so + f".tmp{os.getpid()}"
+            subprocess.run(
+                [cc, "-O2", "-shared", "-fPIC", f"-I{inc}", src, "-o", tmp],
+                check=True, capture_output=True, timeout=120)
+            os.replace(tmp, so)  # atomic: concurrent builders race safely
+        spec = importlib.util.spec_from_file_location("_fastencode", so)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)  # type: ignore[union-attr]
+        _mod = mod
+    except Exception:
+        _mod = None
+    return _mod

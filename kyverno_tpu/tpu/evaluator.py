@@ -99,26 +99,76 @@ def batch_to_device(rows: RowBatch, meta: MetaBatch, sharding=None) -> Dict[str,
     return jax.device_put(host, sharding) if sharding is not None else jax.device_put(host)
 
 
-def densify(batch: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:
-    """Materialize dense (n, max_rows) lanes from a vocabulary batch
-    (flatten.VocabBatch.to_host) via device-side gathers — the
-    embedding-lookup layout that keeps H2D transfer at ~1KB/resource.
-    Dense batches pass through untouched. Runs under jit; XLA fuses
-    each gather into the lane's consumers."""
+class LaneView:
+    """Lazy dense view over a vocabulary batch (flatten.VocabBatch
+    .to_host): dense (n, max_rows) lanes materialize on first access
+    via device-side gathers — the embedding-lookup layout that keeps
+    H2D transfer at ~1KB/resource. Laziness matters twice: unused
+    lanes are never gathered (XLA sees no use), and recording which
+    lanes a program touches (see ``record`` / used_keys) lets callers
+    PRUNE untouched lanes from the host dict before transfer.
+
+    A vocabulary lane absent from the (pruned) batch densifies to
+    zeros — sound only because pruning is driven by a recording trace
+    of the same program, which by construction never reads them."""
+
+    def __init__(self, batch: Dict[str, jnp.ndarray], record: bool = False):
+        from .flatten import _ROW_LANE_DTYPES, _ROW_LANES
+
+        self._b = batch
+        self._cache: Dict[str, jnp.ndarray] = {}
+        self._row_lanes = set(_ROW_LANES)
+        self._dtypes = _ROW_LANE_DTYPES
+        self.used_keys: Optional[set] = set() if record else None
+        self._shape = batch["row_idx"].shape  # (n, max_rows)
+
+    def _note(self, *keys: str) -> None:
+        if self.used_keys is not None:
+            self.used_keys.update(keys)
+
+    def __getitem__(self, name: str) -> jnp.ndarray:
+        out = self._cache.get(name)
+        if out is not None:
+            return out
+        b = self._b
+        if name in self._row_lanes:
+            vkey = "vocab_" + name
+            self._note("row_idx", vkey)
+            if vkey in b:
+                out = jnp.take(b[vkey], b["row_idx"].astype(jnp.int32), axis=0)
+            else:
+                out = jnp.zeros(self._shape, dtype=self._dtypes[name])
+        elif name == "pool":
+            self._note("pool_sidx", "pool_svocab")
+            out = jnp.take(b["pool_svocab"], b["pool_sidx"].astype(jnp.int32), axis=0)
+        elif name == "pool_len":
+            self._note("pool_sidx", "pool_slen")
+            out = jnp.take(b["pool_slen"], b["pool_sidx"].astype(jnp.int32), axis=0)
+        else:  # meta_*, n_rows, fallback — pass through
+            self._note(name)
+            out = b[name]
+        self._cache[name] = out
+        return out
+
+    def __contains__(self, name: str) -> bool:
+        return (name in self._row_lanes or name in ("pool", "pool_len")
+                or name in self._b)
+
+    def items(self):
+        """Materialize every lane (dense-dict compatibility for tests)."""
+        from .flatten import _ROW_LANES
+
+        names = list(_ROW_LANES) + ["pool", "pool_len", "n_rows", "fallback"]
+        names += [k for k in self._b if k.startswith("meta_")]
+        return [(n, self[n]) for n in names]
+
+
+def densify(batch: Dict[str, jnp.ndarray], record: bool = False):
+    """Dense batches pass through; vocabulary batches wrap in a lazy
+    LaneView (gather-on-access, see LaneView docstring)."""
     if "row_idx" not in batch:
         return batch
-    from .flatten import _ROW_LANES
-
-    idx = batch["row_idx"]
-    out = {k: v for k, v in batch.items() if k.startswith("meta_")}
-    for name in _ROW_LANES:
-        out[name] = jnp.take(batch["vocab_" + name], idx, axis=0)
-    sidx = batch["pool_sidx"]
-    out["pool"] = jnp.take(batch["pool_svocab"], sidx, axis=0)
-    out["pool_len"] = jnp.take(batch["pool_slen"], sidx, axis=0)
-    out["n_rows"] = batch["n_rows"]
-    out["fallback"] = batch["fallback"]
-    return out
+    return LaneView(batch, record=record)
 
 
 # ---------------------------------------------------------------------------

@@ -737,17 +737,29 @@ class VocabBatch:
         return int(next(iter(self.lanes.values())).shape[0]) if self.lanes else 1
 
     def to_host(self, meta, v_bucket: Optional[int] = None,
-                s_bucket: Optional[int] = None) -> Dict[str, np.ndarray]:
+                s_bucket: Optional[int] = None,
+                r_bucket: Optional[int] = None) -> Dict[str, np.ndarray]:
         """Flat lane dict for device_put. Vocabulary axes pad to
-        ``v_bucket`` / ``s_bucket`` so tile-to-tile vocabulary size
-        changes never re-trigger XLA compilation (shapes stay fixed;
-        callers grow buckets monotonically)."""
+        ``v_bucket`` / ``s_bucket`` and the rows axis trims to
+        ``r_bucket`` so tile-to-tile size changes never re-trigger XLA
+        compilation (shapes stay fixed; callers grow buckets
+        monotonically). ``r_bucket`` must cover max(n_rows): typical
+        resources use well under half of max_rows, and every dense
+        lane — transfer AND device compute — scales with it."""
         V = self.vocab_size
         vb = v_bucket or V
         if vb < V:
             raise ValueError(f"v_bucket {vb} < vocabulary {V}")
-        out: Dict[str, np.ndarray] = {"row_idx": self.row_idx,
-                                      "pool_sidx": self.pool_sidx,
+        rb = r_bucket or self.cfg.max_rows
+        if rb < int(self.n_rows.max(initial=0)):
+            raise ValueError(f"r_bucket {rb} < max n_rows {int(self.n_rows.max())}")
+        # index tables are the biggest per-resource lanes: use the
+        # narrowest uint that addresses the padded vocabulary
+        idx_t = np.uint8 if vb <= 0xFF else np.uint16 if vb <= 0xFFFF else np.int32
+        sid_t = np.uint8 if (s_bucket or len(self.strs)) <= 0xFF else np.uint16 \
+            if (s_bucket or len(self.strs)) <= 0xFFFF else np.int32
+        out: Dict[str, np.ndarray] = {"row_idx": self.row_idx[:, :rb].astype(idx_t),
+                                      "pool_sidx": self.pool_sidx.astype(sid_t),
                                       "n_rows": self.n_rows,
                                       "fallback": self.fallback}
         for name, arr in self.lanes.items():
@@ -783,6 +795,33 @@ class _CfgShell:
         self.cfg = cfg
 
 
+# vocab-row tuple order emitted by the native encoder (fastencode.c
+# row_tuple) — _ROW_LANES minus the implicit "valid"
+_VOCAB_TUPLE_ORDER = tuple(n for n in _ROW_LANES if n != "valid")
+
+
+def _encode_vocab_native(native, resources, cfg, byte_paths, key_byte_paths) -> VocabBatch:
+    vb = VocabBatch(len(resources), cfg)
+    bp = np.array(sorted(set(byte_paths or ())), dtype=np.uint64)
+    kbp = np.array(sorted(set(key_byte_paths or ())), dtype=np.uint64)
+    vrows, pool_strs = native.encode_vocab(
+        list(resources), cfg.max_rows, cfg.max_instances,
+        cfg.byte_pool_slots, cfg.byte_pool_width, bp, kbp, _scalar_rec,
+        vb.row_idx, vb.n_rows, vb.fallback, vb.pool_sidx)
+    V = len(vrows) + 1
+    lanes = {name: np.zeros((V,), dtype=_ROW_LANE_DTYPES[name]) for name in _ROW_LANES}
+    for l in ("scope1", "scope2", "byte_slot", "key_byte_slot"):
+        lanes[l][0] = -1
+    if vrows:
+        cols = tuple(zip(*vrows))
+        for idx, name in enumerate(_VOCAB_TUPLE_ORDER):
+            lanes[name][1:] = np.asarray(cols[idx], dtype=_ROW_LANE_DTYPES[name])
+        lanes["valid"][1:] = 1
+    vb.lanes = lanes
+    vb.strs = list(pool_strs)
+    return vb
+
+
 def encode_resources_vocab(
     resources: Sequence[Dict[str, Any]],
     cfg: Optional[EncodeConfig] = None,
@@ -790,8 +829,15 @@ def encode_resources_vocab(
     key_byte_paths: Optional[Iterable[int]] = None,
 ) -> VocabBatch:
     """Vocabulary-form twin of encode_resources (same walk, same
-    semantics — parity-tested against it lane by lane)."""
+    semantics — parity-tested against it lane by lane). Uses the
+    native C walk when the extension builds; Python otherwise."""
     cfg = cfg or EncodeConfig()
+    from ..native import load as _load_native
+
+    native = _load_native()
+    if native is not None:
+        res = resources if isinstance(resources, list) else list(resources)
+        return _encode_vocab_native(native, res, cfg, byte_paths, key_byte_paths)
     enc = _FastEncoder(_CfgShell(cfg), set(byte_paths or ()), set(key_byte_paths or ()))
     vb = VocabBatch(len(resources), cfg)
     for i, res in enumerate(resources):

@@ -113,13 +113,31 @@ def encode_metadata(
     operations: Optional[Sequence[str]] = None,
     admission_infos: Optional[Sequence[Optional[RequestInfo]]] = None,
     cfg: Optional[MetaConfig] = None,
+    need: Optional[set] = None,
 ) -> MetaBatch:
     """namespace_labels: namespace name -> labels map (cluster snapshot).
-    operations: per-resource admission operation ("" for background)."""
+    operations: per-resource admission operation ("" for background).
+
+    ``need``: lane names the consuming device program actually reads
+    (ShardedScanner's recording trace) — lanes outside it skip their
+    per-resource encode work. Sound because an unread lane can affect
+    neither verdicts nor the fallback decisions any reader observes."""
     cfg = cfg or MetaConfig()
     ns_labels = namespace_labels or {}
     batch = MetaBatch(len(resources), cfg)
     b = batch
+
+    def want(*lanes: str) -> bool:
+        return need is None or any(l in need for l in lanes)
+
+    w_name_b = want("name_bytes", "name_len")
+    w_ns_b = want("ns_bytes", "ns_len")
+    w_labels = want("labels_kh", "labels_vh", "labels_n")
+    w_ann = want("ann_kh", "ann_vh", "ann_n")
+    w_nsl = want("nsl_kh", "nsl_vh", "nsl_n")
+    w_user = want("user_h", "user_bytes", "user_len", "groups_h", "groups_n",
+                  "roles_h", "roles_n", "croles_h", "croles_n",
+                  "admission_empty")
     for i, res in enumerate(resources):
         ok = True
         group, version, kind = kube.gvk_from_resource(res)
@@ -128,23 +146,28 @@ def encode_metadata(
         b.kind_h[i] = _h2(kind, "K")
         b.is_namespace_kind[i] = 1 if kind == "Namespace" else 0
         name = kube.get_name(res) or kube.get_generate_name(res)
-        ok &= _put_bytes(b.name_bytes, b.name_len, i, name)
+        if w_name_b:
+            ok &= _put_bytes(b.name_bytes, b.name_len, i, name)
         b.name_h[i] = _h2(name, "m")
         # Namespace resources compare their *name* for namespaces lists
         # (match.go:18-31); the match program picks via is_namespace_kind
         ns = kube.get_namespace(res)
-        ok &= _put_bytes(b.ns_bytes, b.ns_len, i, ns)
+        if w_ns_b:
+            ok &= _put_bytes(b.ns_bytes, b.ns_len, i, ns)
         b.ns_h[i] = _h2(ns, "N")
-        ok &= _put_pairs(b.labels_kh, b.labels_vh, b.labels_n, i,
-                         kube.get_labels(res), "lk", "lv")
-        ok &= _put_pairs(b.ann_kh, b.ann_vh, b.ann_n, i,
-                         kube.get_annotations(res), "ak", "av")
-        nsl = ns_labels.get(kube.get_name(res) if kind == "Namespace" else ns, {})
-        ok &= _put_pairs(b.nsl_kh, b.nsl_vh, b.nsl_n, i, nsl, "lk", "lv")
+        if w_labels:
+            ok &= _put_pairs(b.labels_kh, b.labels_vh, b.labels_n, i,
+                             kube.get_labels(res), "lk", "lv")
+        if w_ann:
+            ok &= _put_pairs(b.ann_kh, b.ann_vh, b.ann_n, i,
+                             kube.get_annotations(res), "ak", "av")
+        if w_nsl:
+            nsl = ns_labels.get(kube.get_name(res) if kind == "Namespace" else ns, {})
+            ok &= _put_pairs(b.nsl_kh, b.nsl_vh, b.nsl_n, i, nsl, "lk", "lv")
         op = (operations[i] if operations else "") or ""
         b.op_code[i] = OP_CODES.get(op, 0)
         info = admission_infos[i] if admission_infos else None
-        if info is not None and not info.is_empty():
+        if w_user and info is not None and not info.is_empty():
             b.admission_empty[i] = 0
             b.user_h[i] = _h2(info.username, "u")
             ok &= _put_bytes(b.user_bytes, b.user_len, i, info.username)

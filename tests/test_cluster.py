@@ -133,13 +133,13 @@ def test_clean_rescan_skips_encode(monkeypatch):
         snap.upsert(pod(f"p{i}", True))
 
     calls = {"n": 0}
-    real = sharding.encode_resources
+    real = sharding.encode_resources_vocab
 
     def counting(*a, **kw):
         calls["n"] += 1
         return real(*a, **kw)
 
-    monkeypatch.setattr(sharding, "encode_resources", counting)
+    monkeypatch.setattr(sharding, "encode_resources_vocab", counting)
     assert svc.scan_once() == 4
     first = calls["n"]
     assert first > 0

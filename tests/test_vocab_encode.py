@@ -128,6 +128,64 @@ def test_vocab_dedup_is_effective():
         f"vocab {vb.vocab_size} rows vs {n_rows_total} total — dedup ineffective")
 
 
+def test_native_encoder_parity():
+    """The C walk (native/fastencode.c) must agree with the Python
+    vocab encoder on densified output, n_rows and fallback for every
+    adversarial shape (vocab internals may order rows differently)."""
+    from kyverno_tpu.native import load
+    from kyverno_tpu.tpu import flatten as F
+
+    native = load()
+    if native is None:
+        pytest.skip("native toolchain unavailable")
+
+    def py_encode(res, cfg, bp, kbp):
+        enc = F._FastEncoder(F._CfgShell(cfg), set(bp), set(kbp))
+        vb = F.VocabBatch(len(res), cfg)
+        for i, r in enumerate(res):
+            enc.begin(i)
+            enc.walk(r, F._ROOT_REC, 0, 0, -1, -1, 0)
+            vb.n_rows[i] = enc.row
+            vb.fallback[i] = 0 if enc.ok else 1
+        F._finish_vocab(enc, vb)
+        return vb
+
+    cases = [
+        (_pods(23), EncodeConfig(), (), ()),
+        ([{}, {"a": None, "b": True, "c": 0, "d": -1.5, "e": "s",
+               "n": [1, "2", "10Mi", "3h", "0x10", "-0.0", 2**40, 1e20]}],
+         EncodeConfig(), (), ()),
+        ([{1: "intkey", "m": {2.5: "floatkey"}}], EncodeConfig(), (), ()),
+        ([{"metadata": {"annotations": {"k*y": "v?l", "a": "runtime/default"}}},
+          {"v": "g*b"}], EncodeConfig(),
+         {hash_path(("v",))}, {hash_path(("metadata", "annotations"))}),
+        ([{"a": {f"k{i}": i for i in range(20)}}, {"b": 1}],
+         EncodeConfig(max_rows=8), (), ()),
+        ([{"spec": {"containers": [{"n": i} for i in range(4)]}},
+          {"spec": {"containers": [{"env": [{"v": i} for i in range(4)]}]}}],
+         EncodeConfig(max_instances=2), (), ()),
+        ([{"a": "xy", "b": "zw"}, {"a": "toolongvalue"}],
+         EncodeConfig(byte_pool_slots=1, byte_pool_width=4),
+         {hash_path(("a",)), hash_path(("b",))}, ()),
+        # memo tables grow mid-call: entries must stay pointer-stable
+        # (regression for use-after-free on scalar/path table growth)
+        ([{"x": f"u{i}"} for i in range(9000)], EncodeConfig(), (), ()),
+        ([{"arr": [{f"uniquekey{i}": 1} for i in range(600)]}],
+         EncodeConfig(max_rows=2048, max_instances=1024), (), ()),
+    ]
+    for res, cfg, bp, kbp in cases:
+        nat = F._encode_vocab_native(native, list(res), cfg, bp, kbp)
+        pyv = py_encode(res, cfg, bp, kbp)
+        assert np.array_equal(nat.n_rows, pyv.n_rows)
+        assert np.array_equal(nat.fallback, pyv.fallback)
+        meta = encode_metadata(res)
+        got = {k: np.asarray(v) for k, v in densify(nat.to_host(meta)).items()}
+        want = {k: np.asarray(v) for k, v in densify(pyv.to_host(meta)).items()}
+        assert set(got) == set(want)
+        for k in sorted(want):
+            assert np.array_equal(got[k], want[k]), f"lane {k} diverges"
+
+
 def test_bucket_padding_shapes():
     res = _pods(5)
     vb = encode_resources_vocab(res)
