@@ -20,9 +20,10 @@ list/dict) plus Optional / CelType wrappers."""
 from __future__ import annotations
 
 import math
-import re as _re
 from typing import Any, Callable, Dict, List
 
+from .re2 import Re2Error
+from .re2 import search as _re2_search
 from .errors import CelError, no_such_overload, type_name
 
 INT_MIN, INT_MAX = -(2**63), 2**63 - 1
@@ -500,9 +501,12 @@ def _method(target, name: str, args: List[Any]):
         raise no_such_overload("endsWith", target, *args)
     if name == "matches":
         if isinstance(target, str) and len(args) == 1 and isinstance(args[0], str):
+            # linear-time RE2-subset engine (re2.py): cel-go parity and
+            # no backtracking blowup holding the GIL past the webhook
+            # timeout — Python's re cannot be interrupted mid-match
             try:
-                return _re.search(args[0], target) is not None
-            except _re.error as e:
+                return _re2_search(args[0], target)
+            except Re2Error as e:
                 raise CelError(f"invalid regex: {e}")
         raise no_such_overload("matches", target, *args)
     if name in ("lowerAscii", "upperAscii"):

@@ -231,4 +231,10 @@ class Parser:
 
 
 def parse(src: str):
-    return Parser(tokenize(src)).parse()
+    try:
+        return Parser(tokenize(src)).parse()
+    except RecursionError:
+        # thousands of nested parens must surface as a per-expression
+        # compile error (CelValidator's eager-compile catch), not
+        # escape as a whole-request exception handled by failurePolicy
+        raise CelSyntaxError("expression nesting too deep")

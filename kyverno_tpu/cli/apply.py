@@ -182,17 +182,23 @@ class _VapShim:
         self.name = name
 
 
-def _vap_rows(vap_docs, resources):
+def _vap_rows(vap_docs, resources, ns_labels=None):
     """Evaluate ValidatingAdmissionPolicy objects in-process
     (commands/apply/command.go:213 -> validatingadmissionpolicy
-    Validate)."""
+    Validate). namespaceSelector constraints resolve against labels of
+    Namespace resources supplied alongside (the reference CLI resolves
+    selectors the same way) — without them a selector-bearing VAP
+    would silently never apply."""
     from ..vap import validate_vap
 
+    ns_labels = ns_labels or {}
     rows = []
     for doc in vap_docs:
         shim = _VapShim((doc.get("metadata") or {}).get("name", "vap"))
         for ci, res in enumerate(resources):
-            results = validate_vap(doc, res)
+            ns = (res.get("metadata") or {}).get("namespace", "")
+            results = validate_vap(doc, res,
+                                   namespace_labels=ns_labels.get(ns, {}))
             if results is None:
                 continue
             for r in results:
@@ -230,10 +236,16 @@ def run(args: argparse.Namespace) -> int:
             registry_client = StaticRegistry(yaml.safe_load(f) or {})
     resource_docs, vi_rows = _apply_image_verification(
         policies, resource_docs, registry_client)
+    # namespace labels come from Namespace resources in the input set
+    # (the reference CLI resolves namespaceSelector the same way)
+    ns_labels = {(d.get("metadata") or {}).get("name", ""):
+                 ((d.get("metadata") or {}).get("labels") or {})
+                 for d in resource_docs if d.get("kind") == "Namespace"}
     rows = (mutate_rows + vi_rows
-            + (_verdict_rows(policies, resource_docs, None, args.engine)
+            + (_verdict_rows(policies, resource_docs, ns_labels or None,
+                             args.engine)
                if policies else [])
-            + _vap_rows(vap_docs, resource_docs))
+            + _vap_rows(vap_docs, resource_docs, ns_labels))
 
     counts = {"pass": 0, "fail": 0, "warn": 0, "error": 0, "skip": 0}
     failures: List[Tuple[str, str, str, str]] = []

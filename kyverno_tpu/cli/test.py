@@ -204,7 +204,7 @@ def _run_case(case: TestCase) -> List[Tuple[Dict[str, Any], str, bool]]:
     # a policy row carries "scored": fail maps to warn for policies
     # annotated policies.kyverno.io/scored=false (cli report.go:40-45
     # ComputePolicyReportResult)
-    responses: List[Tuple[str, str, Dict[str, Any], str]] = []
+    responses: List[Tuple[str, str, Dict[str, Any], str, str]] = []
     evaluated: set = set()  # (policy, resource-id) pairs that ran
     patched: Dict[int, Dict[str, Any]] = {}
     expanded = [expand_policy(p) for p in case.policies]
@@ -225,7 +225,8 @@ def _run_case(case: TestCase) -> List[Tuple[Dict[str, Any], str, bool]]:
             if any(r.has_mutate() for r in policy.get_rules()):
                 m = eng.mutate(pctx)
                 for rr in m.policy_response.rules:
-                    responses.append((policy.name, rr.name, current, rr.status))
+                    responses.append((policy.name, rr.name, current, rr.status,
+                                      policy.namespace))
                 if m.patched_resource is not None:
                     patched[ri] = m.patched_resource
                     current = m.patched_resource
@@ -233,14 +234,16 @@ def _run_case(case: TestCase) -> List[Tuple[Dict[str, Any], str, bool]]:
             if any(r.has_verify_images() for r in policy.get_rules()):
                 iv = eng.verify_and_patch_images(pctx)
                 for rr in iv.policy_response.rules:
-                    responses.append((policy.name, rr.name, current, rr.status))
+                    responses.append((policy.name, rr.name, current, rr.status,
+                                      policy.namespace))
                 if iv.patched_resource is not None:
                     patched[ri] = iv.patched_resource
                     current = iv.patched_resource
                     pctx = build_ctx(policy, current, key)
             v = eng.validate(pctx)
             for rr in v.policy_response.rules:
-                responses.append((policy.name, rr.name, current, rr.status))
+                responses.append((policy.name, rr.name, current, rr.status,
+                              policy.namespace))
     # ValidatingAdmissionPolicy documents evaluate via the in-process
     # VAP engine (vap_processor.go; rule name stays empty for non-
     # Kyverno policies, report.go:52-54)
@@ -271,7 +274,7 @@ def _run_case(case: TestCase) -> List[Tuple[Dict[str, Any], str, bool]]:
                 status = "skip"
             else:
                 status = "pass"
-            responses.append((vname, "", current, status))
+            responses.append((vname, "", current, status, ""))
 
     # final mutated form per (kind, resource id), for patchedResource
     # checks — kind disambiguates same-named resources of two kinds
@@ -285,14 +288,21 @@ def _run_case(case: TestCase) -> List[Tuple[Dict[str, Any], str, bool]]:
         if meta.get("namespace"):
             final_patched[(rkind, f"{meta['namespace']}/{rid}")] = doc
 
-    def policy_matches(expected: str, actual_name: str) -> bool:
+    def policy_matches(expected: str, actual_name: str,
+                       actual_ns: str = "") -> bool:
         # result rows may namespace-qualify a namespaced Policy
         # ("default/test-jmespath", cache.MetaObjectToName); an empty
         # expected policy matches nothing (the reference filters on
-        # exact equality)
+        # exact equality after namespace qualification — a bare-name
+        # fallback would let ns1/p satisfy a row declaring ns2/p)
         if not expected:
             return False
-        return expected == actual_name or expected.split("/")[-1] == actual_name
+        if expected == actual_name:
+            return True
+        if "/" in expected:
+            ns, _, name = expected.rpartition("/")
+            return name == actual_name and (not actual_ns or ns == actual_ns)
+        return False
 
     out = []
     base = os.path.dirname(case.path)
@@ -306,8 +316,8 @@ def _run_case(case: TestCase) -> List[Tuple[Dict[str, Any], str, bool]]:
         # resources of each declared result independently)
         for res_name in names or [None]:
             matching = []
-            for pname, rname, res, status in responses:
-                if not policy_matches(exp.get("policy", ""), pname):
+            for pname, rname, res, status, pns in responses:
+                if not policy_matches(exp.get("policy", ""), pname, pns):
                     continue
                 if exp.get("rule") and not _rule_names_match(exp["rule"], rname):
                     continue

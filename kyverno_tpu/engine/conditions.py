@@ -133,13 +133,20 @@ def _value_as_string_list(value: Any) -> Optional[List[str]]:
         return [_go_sprint(v) for v in value]
     if isinstance(value, str):
         try:
-            arr = json.loads(value)
+            # Go's json rejects NaN/Infinity literals; Python accepts
+            # them by default, which would misclassify e.g. "Infinity"
+            # as valid-JSON-but-not-array (None) instead of a singleton
+            arr = json.loads(value, parse_constant=_reject_constant)
         except ValueError:
             return [value]
         if isinstance(arr, list) and all(isinstance(x, str) for x in arr):
             return arr
         return None
     return None
+
+
+def _reject_constant(name: str):
+    raise ValueError(f"invalid JSON constant {name}")
 
 
 def _key_exists_in_array(key: str, value: Any) -> Optional[bool]:

@@ -751,6 +751,15 @@ def _fn_x509_decode(fn, args):
         "1.2.840.113549.1.1.10": 13, "1.3.101.112": 16,
     }
     sig_alg = sig_algs.get(cert.signature_algorithm_oid.dotted_string, 0)
+    if sig_alg == 13:
+        # the RSA-PSS OID (1.2.840.113549.1.1.10) is hash-agnostic; Go
+        # distinguishes SHA256/384/512-RSAPSS (13/14/15) by the PSS
+        # hash parameters (x509.go signatureAlgorithmDetails)
+        try:
+            hname = (cert.signature_hash_algorithm.name or "").lower()
+        except Exception:
+            hname = ""
+        sig_alg = {"sha256": 13, "sha384": 14, "sha512": 15}.get(hname, 13)
     out = {
         "PublicKey": {"N": str(numbers.n), "E": numbers.e},
         "PublicKeyAlgorithm": 1,  # x509.RSA

@@ -498,14 +498,25 @@ static int64_t voc_intern(Vocab *v, const TmpRow *t) {
     if (v->len >= v->cap) {
         size_t ncap = v->cap ? v->cap * 2 : 4096;
         TmpRow *nr = realloc(v->rows, ncap * sizeof(TmpRow));
+        if (!nr) return -1;
+        v->rows = nr;
         uint64_t *nh = realloc(v->hashes, ncap * sizeof(uint64_t));
-        if (!nr || !nh) { free(nr); return -1; }
-        v->rows = nr; v->hashes = nh; v->cap = ncap;
+        if (!nh) return -1;
+        v->hashes = nh; v->cap = ncap;
     }
     size_t ri = v->len++;
     v->rows[ri] = *t;
-    /* inline scalar recs move: repoint sc into the vocab copy */
-    if (t->sc && t->sc_inline) v->rows[ri].sc = &v->rows[ri].inl;
+    /* unhashable scalars carry an inline rec in the (reused) TmpRow;
+     * the vocab copy needs its own heap-stable rec — v->rows itself
+     * moves on realloc, so pointing into the array would dangle.
+     * Ownership of rec.rep moves to the heap copy; freed (with a
+     * rep decref) at encode teardown via the sc_inline marker. */
+    if (t->sc && t->sc_inline) {
+        ScalarRec *cp = malloc(sizeof(ScalarRec));
+        if (!cp) { v->len--; return -1; }
+        *cp = *t->sc;
+        v->rows[ri].sc = cp;
+    }
     v->hashes[ri] = h;
     v->idx_tab[j] = ri;
     return (int64_t)ri + 1;
@@ -613,6 +624,10 @@ done:
     Py_XDECREF(e.pool_strs);
     Py_XDECREF(e.pool_sid_map);
     free(e.tmp);
+    for (size_t ri = 0; ri < e.voc.len; ri++) {
+        TmpRow *t = &e.voc.rows[ri];
+        if (t->sc_inline && t->sc) { Py_XDECREF(t->sc->rep); free(t->sc); }
+    }
     free(e.voc.rows); free(e.voc.hashes); free(e.voc.idx_tab);
     PyBuffer_Release(&bp_buf); PyBuffer_Release(&kbp_buf);
     PyBuffer_Release(&row_idx_buf); PyBuffer_Release(&n_rows_buf);

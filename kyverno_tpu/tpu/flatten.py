@@ -800,14 +800,22 @@ class _CfgShell:
 _VOCAB_TUPLE_ORDER = tuple(n for n in _ROW_LANES if n != "valid")
 
 
+# the native encoder's memo tables are process-global and mutated
+# without locks; the GIL can switch threads inside the scalar callback,
+# so concurrent admission-server threads must serialize native encodes
+# (encode is GIL-bound CPU work anyway — serialization costs nothing)
+_NATIVE_LOCK = __import__("threading").Lock()
+
+
 def _encode_vocab_native(native, resources, cfg, byte_paths, key_byte_paths) -> VocabBatch:
     vb = VocabBatch(len(resources), cfg)
     bp = np.array(sorted(set(byte_paths or ())), dtype=np.uint64)
     kbp = np.array(sorted(set(key_byte_paths or ())), dtype=np.uint64)
-    vrows, pool_strs = native.encode_vocab(
-        list(resources), cfg.max_rows, cfg.max_instances,
-        cfg.byte_pool_slots, cfg.byte_pool_width, bp, kbp, _scalar_rec,
-        vb.row_idx, vb.n_rows, vb.fallback, vb.pool_sidx)
+    with _NATIVE_LOCK:
+        vrows, pool_strs = native.encode_vocab(
+            list(resources), cfg.max_rows, cfg.max_instances,
+            cfg.byte_pool_slots, cfg.byte_pool_width, bp, kbp, _scalar_rec,
+            vb.row_idx, vb.n_rows, vb.fallback, vb.pool_sidx)
     V = len(vrows) + 1
     lanes = {name: np.zeros((V,), dtype=_ROW_LANE_DTYPES[name]) for name in _ROW_LANES}
     for l in ("scope1", "scope2", "byte_slot", "key_byte_slot"):

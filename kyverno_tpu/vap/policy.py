@@ -102,9 +102,34 @@ def match_constraints_match(
             return False
     ns_sel = constraints.get("namespaceSelector")
     if ns_sel is not None and ns_sel != {}:
-        if not matches_selector(ns_sel, namespace_labels or {}):
-            return False
+        # apiserver semantics (matchesResourceRules / rules.go): a
+        # Namespace object evaluates the selector against its OWN
+        # labels; other cluster-scoped KINDS match unconditionally;
+        # namespaced objects (even with the namespace field implicit)
+        # use their namespace's labels
+        meta = resource.get("metadata") or {}
+        kind = resource.get("kind", "")
+        if kind == "Namespace":
+            if not matches_selector(ns_sel, meta.get("labels") or {}):
+                return False
+        elif kind not in _CLUSTER_SCOPED_KINDS:
+            if not matches_selector(ns_sel, namespace_labels or {}):
+                return False
     return True
+
+
+# well-known cluster-scoped kinds (scope is a schema property; without
+# an apiserver, kind identity is the available signal)
+_CLUSTER_SCOPED_KINDS = frozenset({
+    "Namespace", "Node", "PersistentVolume", "ClusterRole",
+    "ClusterRoleBinding", "CustomResourceDefinition", "StorageClass",
+    "PriorityClass", "RuntimeClass", "IngressClass", "APIService",
+    "MutatingWebhookConfiguration", "ValidatingWebhookConfiguration",
+    "ValidatingAdmissionPolicy", "ValidatingAdmissionPolicyBinding",
+    "CertificateSigningRequest", "ClusterPolicy", "PolicyException",
+    "GlobalContextEntry", "VolumeAttachment", "CSIDriver", "CSINode",
+    "FlowSchema", "PriorityLevelConfiguration",
+})
 
 
 def validate_vap(

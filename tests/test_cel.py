@@ -237,3 +237,34 @@ def test_cyclic_variables_is_cel_error():
                      variables=[{"name": "a", "expression": "variables.a"}])
     [r] = v.validate(object={})
     assert r.status == "error" and "cyclic" in r.message
+
+
+# -- RE2 parity (cel-go matches() is RE2): matches() runs on the
+# linear-time NFA engine (cel/re2.py) — non-RE2 constructs error,
+# catastrophic patterns terminate promptly (full suite: test_re2.py)
+
+def test_matches_rejects_re2_incompatible():
+    for pat in (r"(a)\1", r"a(?=b)", r"a(?!b)", r"(?<=a)b", r"(?<!a)b"):
+        with pytest.raises(CelError):
+            ev(f'"aa".matches("{pat}")'.replace("\\", "\\\\"))
+
+
+def test_matches_catastrophic_pattern_terminates():
+    import time
+
+    t0 = time.perf_counter()
+    assert ev(f'"{"a" * 200}b".matches("(a+)+c$")') is False
+    assert time.perf_counter() - t0 < 2.0
+
+
+def test_matches_accepts_normal_patterns():
+    assert ev('"pod-123".matches("^pod-[0-9]+$")') is True
+    assert ev('"abc".matches("(ab)c")') is True
+    assert ev('"aab".matches("a+b")') is True
+    assert ev('"10.1.2.3".matches("^(\\\\d{1,3}\\\\.){3}\\\\d{1,3}$")') is True
+
+
+def test_deep_nesting_is_syntax_error():
+    src = "(" * 100000 + "1" + ")" * 100000
+    with pytest.raises(CelSyntaxError):
+        compile(src)

@@ -349,3 +349,31 @@ class TestGoDurationFormat:
         assert format_go_duration(-(2 * 60 + 30) * 10**9) == "-2m30s"
         assert format_go_duration(3600 * 10**9) == "1h0m0s"
         assert format_go_duration(1_500_000_000) == "1.5s"
+
+
+def test_x509_decode_rsapss_hash_distinguished():
+    """Go maps the hash-agnostic RSA-PSS OID to 13/14/15 by PSS hash
+    params (x509.go signatureAlgorithmDetails); SHA384-PSS must decode
+    as 14, not 13."""
+    import datetime
+
+    from cryptography import x509 as cx
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import padding, rsa
+    from cryptography.x509.oid import NameOID
+
+    from kyverno_tpu.engine.jmespath import compile as jp_compile
+
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    name = cx.Name([cx.NameAttribute(NameOID.COMMON_NAME, "t")])
+    builder = (cx.CertificateBuilder().subject_name(name).issuer_name(name)
+               .public_key(key.public_key()).serial_number(1)
+               .not_valid_before(datetime.datetime(2020, 1, 1))
+               .not_valid_after(datetime.datetime(2030, 1, 1)))
+    for halg, want in ((hashes.SHA256(), 13), (hashes.SHA384(), 14),
+                       (hashes.SHA512(), 15)):
+        cert = builder.sign(key, halg, rsa_padding=padding.PSS(
+            mgf=padding.MGF1(halg), salt_length=halg.digest_size))
+        pem = cert.public_bytes(serialization.Encoding.PEM).decode()
+        out = jp_compile("x509_decode(@)").search(pem)
+        assert out["SignatureAlgorithm"] == want, (halg.name, out["SignatureAlgorithm"])

@@ -1,0 +1,111 @@
+"""RE2-subset engine (cel/re2.py): differential parity against Python
+re on the compatible subset, RE2-specific semantics where the two
+diverge, rejection of non-RE2 constructs, and linear-time behavior on
+patterns that detonate a backtracking engine."""
+
+import re as pyre
+import time
+
+import pytest
+
+from kyverno_tpu.cel.re2 import Re2Error, search
+
+# (pattern, subjects) — RE2-compatible, same semantics as Python re
+DIFFERENTIAL = [
+    (r"abc", ["abc", "xabcx", "ab", ""]),
+    (r"^abc$", ["abc", "xabc", "abcx"]),
+    (r"a.c", ["abc", "a\nc", "ac", "axc"]),
+    (r"(?s)a.c", ["a\nc", "abc"]),
+    (r"a*", ["", "aaa", "b"]),
+    (r"a+b", ["b", "ab", "aaab", "aa"]),
+    (r"colou?r", ["color", "colour", "colr"]),
+    (r"a{3}", ["aa", "aaa", "aaaa"]),
+    (r"a{2,}", ["a", "aa", "aaaa"]),
+    (r"a{2,4}$", ["a", "aa", "aaaa", "aaaaa"]),
+    (r"[abc]+", ["cab", "d", ""]),
+    (r"[^abc]+", ["xyz", "abc", "axb"]),
+    (r"[a-fA-F0-9]{2}", ["3F", "g1", "a0"]),
+    (r"[-a]b", ["-b", "ab", "cb"]),
+    (r"(ab|cd)+ef", ["abef", "cdabef", "adef"]),
+    (r"^(GET|POST|PUT)\s", ["GET /x", "POST y", "PATCH z"]),
+    (r"\d+\.\d+", ["3.14", "a.b", "10.2.3"]),
+    (r"\w+@\w+\.\w+", ["a@b.co", "a@b", "x y@z.io w"]),
+    (r"\s", [" ", "\t", "a"]),
+    (r"\bfoo\b", ["foo", "foobar", "a foo b", "xfoo"]),
+    (r"\Bar", ["bar", "ar", "car"]),
+    (r"(?i)hello", ["HELLO", "HeLLo", "help"]),
+    (r"(?i:ab)c", ["ABc", "ABC", "abc"]),
+    (r"(?m)^b$", ["a\nb\nc", "ab"]),
+    (r"^(\d{1,3}\.){3}\d{1,3}$", ["10.0.0.1", "255.255.255.255", "1.2.3",
+                                  "1.2.3.4.5", "a.b.c.d"]),
+    (r"nginx:[0-9.]+", ["nginx:1.25", "nginx:latest"]),
+    (r"^[a-z0-9]([-a-z0-9]*[a-z0-9])?$", ["pod-1", "-pod", "a", "Pod"]),
+    (r"\x41+", ["AAA", "B"]),
+    (r"(a+)+$", ["aaab", "aaa"]),   # catastrophic for backtrackers
+    (r"(a*)*b", ["aaab", "c"]),
+    (r"x|", ["x", "y", ""]),
+    (r"()", ["", "a"]),
+    (r"a\0b", ["a\0b".replace(r"\0", "\0"), "ab"]),
+    (r"\Az", ["z", "az"]),
+]
+
+
+def test_differential_vs_python_re():
+    for pat, subjects in DIFFERENTIAL:
+        ref = pyre.compile(pat)
+        for s in subjects:
+            assert search(pat, s) == (ref.search(s) is not None), (pat, s)
+
+
+def test_re2_divergences_from_python_re():
+    # $ is end-of-TEXT in RE2 (Python re matches before a trailing \n)
+    assert search(r"abc$", "abc\n") is False
+    assert pyre.search(r"abc$", "abc\n") is not None
+    # \d, \w, \s are ASCII in RE2 (Python re is Unicode by default)
+    assert search(r"^\d$", "٣") is False       # Arabic-Indic digit
+    assert pyre.search(r"^\d$", "٣") is not None
+    assert search(r"^\w$", "é") is False
+    assert pyre.search(r"^\w$", "é") is not None
+    # \x{...} is RE2 syntax Python re lacks
+    assert search(r"\x{1F600}", "\U0001F600") is True
+    assert search(r"\x{1F600}", "x") is False
+    # POSIX classes are RE2 syntax Python re lacks
+    assert search(r"[[:alpha:]]+[[:digit:]]", "ab3") is True
+    assert search(r"[[:alpha:]]+[[:digit:]]", "3a") is False
+    assert search(r"[[:^digit:]]", "a") is True
+    assert search(r"[[:^digit:]]", "7") is False
+
+
+def test_rejects_non_re2_constructs():
+    for pat in (r"(a)\1", r"a(?=b)", r"a(?!b)", r"(?<=a)b", r"(?<!a)b",
+                r"(?P=name)", r"a*+", r"a**", r"a{2}{3}", r"\p{Greek}",
+                r"a{1001}", r"(?(1)a|b)"):
+        with pytest.raises(Re2Error):
+            search(pat, "x")
+
+
+def test_linear_time_on_catastrophic_patterns():
+    subject = "a" * 2000 + "b" * 5
+    for pat in (r"(a+)+c$", r"(a*)*c", r"(a|aa)+c", r"([a-z]+)*c$"):
+        t0 = time.perf_counter()
+        assert search(pat, subject) is False
+        assert time.perf_counter() - t0 < 2.0, pat
+
+
+def test_named_groups_and_nesting():
+    assert search(r"(?P<y>\d{4})-(?P<m>\d{2})", "2026-07-30")
+    assert search(r"((a|b)(c|d))+e", "acbde")
+    assert not search(r"((a|b)(c|d))+e", "abe")
+
+
+def test_matches_via_cel():
+    from kyverno_tpu.cel import CelError, eval_expression
+
+    assert eval_expression('"10.0.0.1".matches("^(\\\\d{1,3}\\\\.){3}\\\\d{1,3}$")', {}) is True
+    assert eval_expression('"a-b".matches("^[a-z]([-a-z]*[a-z])?$")', {}) is True
+    with pytest.raises(CelError):
+        eval_expression('"aa".matches("(a)\\\\1")', {})
+    # catastrophic pattern: returns (quickly) instead of hanging
+    t0 = time.perf_counter()
+    assert eval_expression(f'"{"a" * 500}b".matches("(a+)+c$")', {}) is False
+    assert time.perf_counter() - t0 < 2.0

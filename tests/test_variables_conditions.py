@@ -276,3 +276,16 @@ class TestEvaluateConditions:
         conds = {"all": [{"key": "{{ nonexistent.thing || `null` }}",
                           "operator": "Equals", "value": ""}]}
         assert not evaluate_conditions(ctx, conds)
+
+
+def test_any_in_go_json_constant_parity():
+    """Go's json rejects NaN/Infinity literals (anyin.go unmarshal), so
+    the string "Infinity" is an invalid-JSON singleton — AnyNotIn of a
+    non-member list against it must be True, not invalid-type False."""
+    from kyverno_tpu.engine.conditions import evaluate_conditions
+
+    for lit in ("Infinity", "-Infinity", "NaN"):
+        conds = [{"key": ["a"], "operator": "AnyNotIn", "value": lit}]
+        assert evaluate_conditions(None, conds) is True, lit
+        conds = [{"key": [lit], "operator": "AnyIn", "value": lit}]
+        assert evaluate_conditions(None, conds) is True, lit
