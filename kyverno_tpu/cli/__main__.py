@@ -1,4 +1,8 @@
-"""CLI entry: python -m kyverno_tpu.cli <command>."""
+"""CLI entry: python -m kyverno_tpu.cli <command>.
+
+Command surface mirrors cmd/cli/kubectl-kyverno/commands: apply, test,
+jp, serve, version, json scan, fix, create, docs, oci.
+"""
 
 from __future__ import annotations
 
@@ -9,11 +13,33 @@ from . import apply as apply_cmd
 from . import jp as jp_cmd
 from . import serve as serve_cmd
 from . import test as test_cmd
+from . import tools as tools_cmd
 
-VERSION = "0.1.0"
+VERSION = "0.4.0"
 
 
-def main(argv=None) -> int:
+def _version(args) -> int:
+    import os
+    import subprocess
+
+    # commands/version/command.go output shape. The commit comes from
+    # the CLI's OWN checkout (git -C <package dir>), never from
+    # whatever repository the user happens to run inside; installed
+    # copies without git metadata report '---'.
+    print(f"Version: {VERSION}")
+    pkg_dir = os.path.dirname(os.path.abspath(__file__))
+    try:
+        commit = subprocess.run(
+            ["git", "-C", pkg_dir, "rev-parse", "HEAD"],
+            capture_output=True, text=True, timeout=5).stdout.strip()
+    except Exception:
+        commit = ""
+    print("Time: ---")
+    print(f"Git commit ID: {commit or '---'}")
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
         prog="kyverno-tpu",
         description="TPU-native Kyverno-equivalent policy CLI",
@@ -23,9 +49,14 @@ def main(argv=None) -> int:
     jp_cmd.add_parser(sub)
     test_cmd.add_parser(sub)
     serve_cmd.add_parser(sub)
+    tools_cmd.add_parsers(sub)
     v = sub.add_parser("version", help="print version")
-    v.set_defaults(func=lambda a: (print(f"kyverno-tpu {VERSION}"), 0)[1])
-    args = parser.parse_args(argv)
+    v.set_defaults(func=_version)
+    return parser
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
     return args.func(args)
 
 

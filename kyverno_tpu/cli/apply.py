@@ -74,6 +74,9 @@ def add_parser(sub: argparse._SubParsersAction) -> None:
                         "for verifyImages rules")
     p.add_argument("--output-json", action="store_true",
                    help="machine-readable summary on stdout")
+    p.add_argument("--output", "-o", default=None,
+                   help="write (mutated) resources to this file or "
+                        "directory (the reference's forceMutate output)")
     p.set_defaults(func=run)
 
 
@@ -207,6 +210,26 @@ def _vap_rows(vap_docs, resources, ns_labels=None):
     return rows
 
 
+def _write_output(target: str, resources) -> None:
+    """Dump post-mutation resources (apply --output / forceMutate)."""
+    import os
+
+    if target.endswith(("/", os.sep)) or os.path.isdir(target):
+        os.makedirs(target, exist_ok=True)
+        for res in resources:
+            meta = res.get("metadata") or {}
+            # namespace is part of identity: same-kind same-name
+            # resources in two namespaces must not overwrite each other
+            parts = [res.get("kind", "resource"),
+                     meta.get("namespace", ""), meta.get("name", "unnamed")]
+            name = "-".join(p for p in parts if p) + ".yaml"
+            with open(os.path.join(target, name.lower()), "w") as f:
+                yaml.safe_dump(res, f, sort_keys=False)
+    else:
+        with open(target, "w") as f:
+            yaml.safe_dump_all(resources, f, sort_keys=False)
+
+
 def run(args: argparse.Namespace) -> int:
     from ..vap.policy import is_vap_document
 
@@ -236,6 +259,8 @@ def run(args: argparse.Namespace) -> int:
             registry_client = StaticRegistry(yaml.safe_load(f) or {})
     resource_docs, vi_rows = _apply_image_verification(
         policies, resource_docs, registry_client)
+    if getattr(args, "output", None):
+        _write_output(args.output, resource_docs)
     # namespace labels come from Namespace resources in the input set
     # (the reference CLI resolves namespaceSelector the same way)
     ns_labels = {(d.get("metadata") or {}).get("name", ""):

@@ -56,6 +56,29 @@ class ControlPlane:
         self.toggles = toggles or Toggles()
         self.scan_service = BackgroundScanService(
             self.snapshot, self.cache, self.aggregator)
+        # Kyverno->VAP generation: eligible CEL policies materialize a
+        # ValidatingAdmissionPolicy + binding pair in the snapshot
+        # (controllers/validatingadmissionpolicy-generate/controller.go)
+        from ..vap import VapGenerateController
+
+        self.vap_generator = VapGenerateController(self.snapshot)
+        for p in policies:
+            self.vap_generator.reconcile(p)
+        # webhook-config lifecycle: desired configurations materialize
+        # in the snapshot; the startup janitor clears state stale from
+        # prior runs, and stop() deregisters (server.go:243 cleanup —
+        # a dead endpoint must not keep a Fail webhook registered)
+        from ..cluster.leaderelection import LeaseStore
+        from ..cluster.lifecycle import InitJanitor, cleanup_on_shutdown
+        from ..cluster.webhookconfig import WebhookConfigGenerator
+
+        self.lease_store = LeaseStore()
+        self._cleanup_on_shutdown = cleanup_on_shutdown
+        InitJanitor(self.snapshot, self.lease_store).run()
+        self.webhook_config = WebhookConfigGenerator(
+            self.cache,
+            sink=lambda _name, cfg: self.snapshot.upsert(cfg))
+        self.webhook_config.reconcile()
         self.handlers = build_handlers(
             self.cache, self.snapshot, self.aggregator,
             configuration=self.configuration, toggles=self.toggles)
@@ -77,6 +100,7 @@ class ControlPlane:
         self._stop.set()
         self.admission.stop()
         self.metrics_server.shutdown()
+        self._cleanup_on_shutdown(self.snapshot, self.lease_store)
 
 
 def _metrics_server(cp: "ControlPlane", port: int) -> ThreadingHTTPServer:

@@ -29,6 +29,23 @@ from .snapshot import ClusterSnapshot
 _CODE_TO_RESULT = {PASS: "pass", SKIP: "skip", FAIL: "fail", ERROR: "error"}
 
 
+_INFRA_KINDS = frozenset({
+    "ValidatingWebhookConfiguration", "MutatingWebhookConfiguration",
+    "ValidatingAdmissionPolicy", "ValidatingAdmissionPolicyBinding",
+})
+
+
+def _is_kyverno_infrastructure(res: Dict[str, Any]) -> bool:
+    """Only kyverno's own materialized admission plumbing is excluded
+    from scans — keyed by kind AND managed-by label, so user resources
+    that happen to carry a managed-by label still background-scan."""
+    if res.get("kind") not in _INFRA_KINDS:
+        return False
+    labels = (res.get("metadata") or {}).get("labels") or {}
+    return ("kyverno" in (labels.get("webhooks.kyverno.io/managed-by", ""),
+                          labels.get("app.kubernetes.io/managed-by", "")))
+
+
 class BackgroundScanService:
     def __init__(
         self,
@@ -115,6 +132,11 @@ class BackgroundScanService:
         items = self.snapshot.items()
         todo: List[Tuple[str, Dict[str, Any], str]] = []
         for uid, res, h in items:
+            if _is_kyverno_infrastructure(res):
+                # kyverno's own materialized objects (webhook configs,
+                # generated VAPs) never background-scan — the reference
+                # excludes them via the default resourceFilters
+                continue
             if full or uid in dirty or self._needs_scan(uid, h, revision):
                 todo.append((uid, res, h))
             else:

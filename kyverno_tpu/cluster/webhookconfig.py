@@ -42,6 +42,7 @@ _CLUSTER_KINDS = {"Namespace", "Node", "PersistentVolume", "ClusterRole",
                   "ClusterRoleBinding", "CustomResourceDefinition"}
 
 FINE_GRAINED_ANNOTATION = "kyverno.io/custom-webhook-configuration"
+MANAGED_BY_LABEL = "webhooks.kyverno.io/managed-by"
 
 
 def _parse_kind(kind: str) -> Tuple[str, str, str]:
@@ -179,7 +180,11 @@ class WebhookConfigGenerator:
             "apiVersion": "admissionregistration.k8s.io/v1",
             "kind": ("ValidatingWebhookConfiguration" if "validate" in path_base
                      else "MutatingWebhookConfiguration"),
-            "metadata": {"name": f"kyverno-{kind_name}-webhook-cfg"},
+            # managed-by label is the cleanup selector: shutdown and the
+            # init janitor delete collections by it (server.go:252,
+            # kyverno.LabelWebhookManagedBy)
+            "metadata": {"name": f"kyverno-{kind_name}-webhook-cfg",
+                         "labels": {MANAGED_BY_LABEL: "kyverno"}},
             "webhooks": webhooks,
         }
 

