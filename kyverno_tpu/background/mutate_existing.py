@@ -44,24 +44,31 @@ class MutateExistingController:
             ctx = pctx.json_context
             if not evaluate_conditions(ctx, rule.preconditions):
                 continue
+            # per-target preconditions reference {{ target.* }}, which
+            # only binds once a concrete target is selected — strip
+            # them before selector substitution, evaluate them inside
+            # _patch after add_target_resource
+            raw_targets = copy.deepcopy(m["targets"])
+            target_pres = [t.pop("preconditions", None) for t in raw_targets]
             try:
-                targets = substitute_all(ctx, copy.deepcopy(m["targets"]))
+                targets = substitute_all(ctx, raw_targets)
             except SubstitutionError as e:
                 raise MutateExistingError(f"target substitution failed: {e}")
-            for tsel in targets:
+            for tsel, pre in zip(targets, target_pres):
                 for uid, res, _ in self.snapshot.items():
                     if not self._target_matches(tsel, res):
                         continue
-                    patched = self._patch(ctx, rule, res)
+                    patched = self._patch(ctx, rule, res, pre)
                     if patched is not None and patched != res:
                         self.snapshot.upsert(patched)
 
     @staticmethod
     def _target_matches(tsel: Dict[str, Any], res: Dict[str, Any]) -> bool:
         meta = res.get("metadata") or {}
-        if tsel.get("kind") and tsel["kind"] != res.get("kind"):
+        if tsel.get("kind") and not wildcard_match(tsel["kind"], res.get("kind", "")):
             return False
-        if tsel.get("apiVersion") and tsel["apiVersion"] != res.get("apiVersion"):
+        if tsel.get("apiVersion") and not wildcard_match(
+                tsel["apiVersion"], res.get("apiVersion", "")):
             return False
         if tsel.get("name") and not wildcard_match(tsel["name"], meta.get("name", "")):
             return False
@@ -70,11 +77,15 @@ class MutateExistingController:
             return False
         return True
 
-    def _patch(self, ctx, rule: Rule, target: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    def _patch(self, ctx, rule: Rule, target: Dict[str, Any],
+               preconditions=None) -> Optional[Dict[str, Any]]:
         m = rule.mutation or {}
         ctx.checkpoint()
         try:
             ctx.add_target_resource(target)
+            if preconditions is not None and not evaluate_conditions(
+                    ctx, preconditions):
+                return None
             try:
                 if m.get("patchStrategicMerge") is not None:
                     overlay = substitute_all(ctx, copy.deepcopy(m["patchStrategicMerge"]))

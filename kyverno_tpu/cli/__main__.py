@@ -10,6 +10,7 @@ import argparse
 import sys
 
 from . import apply as apply_cmd
+from . import chainsaw as chainsaw_cmd
 from . import jp as jp_cmd
 from . import serve as serve_cmd
 from . import test as test_cmd
@@ -50,6 +51,7 @@ def build_parser() -> argparse.ArgumentParser:
     test_cmd.add_parser(sub)
     serve_cmd.add_parser(sub)
     tools_cmd.add_parsers(sub)
+    chainsaw_cmd.add_parser(sub)
     v = sub.add_parser("version", help="print version")
     v.set_defaults(func=_version)
     return parser

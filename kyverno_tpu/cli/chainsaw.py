@@ -1,0 +1,568 @@
+"""Chainsaw e2e scenario runner (test/conformance/chainsaw replay).
+
+The reference ships 440 chainsaw end-to-end scenarios: declarative
+Test documents whose steps apply/delete/assert cluster state while the
+kyverno controllers react. This runner replays the no-script subset
+against the in-memory control plane — PolicyCache semantics + scalar
+engine for admission, ClusterSnapshot as the apiserver stand-in,
+UpdateRequest/Generate executors for generate rules, CleanupController
+for cleanup policies — so the conformance corpus exercises the same
+component wiring a cluster would.
+
+Step operations (chainsaw.kyverno.io/v1alpha1):
+- ``apply``: admit each doc (mutate -> validate, Enforce blocks);
+  policies/exceptions/cleanup policies install into their controllers;
+  an ``expect`` block with ``($error != null): true`` inverts.
+- ``delete``: DELETE-operation admission gate, then removal plus
+  generate-downstream cleanup.
+- ``assert`` / ``error``: kyverno-json subset-match of each doc
+  against live state (must match / must not match).
+- ``script``/``sleep``: unsupported — the scenario reports SKIP.
+
+Admitted policies carry a synthesized Ready condition so the corpus'
+policy-assert.yaml (status.conditions Ready=True) matches.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+import yaml
+
+from ..api.policy import ClusterPolicy
+from ..background.generate import GenerateController
+from ..background.updaterequest import UpdateRequest, UpdateRequestQueue
+from ..cluster.cleanup import CleanupController, TtlController
+from ..cluster.snapshot import ClusterSnapshot
+from ..engine.engine import Engine as ScalarEngine
+from ..engine.jsonassert import AssertionError_, assert_tree
+from ..policy.autogen import expand_policy
+from ..policy.validation import validate_policy
+from ..tpu.engine import build_scan_context
+
+
+def _ctx(policy, resource, ns_labels, op):
+    from ..engine.match import RequestInfo
+
+    return build_scan_context(policy, resource, ns_labels, op,
+                              RequestInfo(username=_ADMIN["username"],
+                                          groups=list(_ADMIN["groups"])))
+
+POLICY_KINDS = ("ClusterPolicy", "Policy")
+
+# chainsaw talks to the cluster as its admin kubeconfig user; subject-
+# scoped exceptions/rules must not silently match an anonymous request
+_ADMIN = {"username": "kubernetes-admin",
+          "groups": ["system:masters", "system:authenticated"]}
+EXCEPTION_KINDS = ("PolicyException",)
+CLEANUP_KINDS = ("ClusterCleanupPolicy", "CleanupPolicy")
+
+READY_STATUS = {"conditions": [
+    {"reason": "Succeeded", "status": "True", "type": "Ready"}]}
+
+
+def _synthesize_status(res: Dict[str, Any]) -> Dict[str, Any]:
+    """Stand in for the kube controllers chainsaw relies on: workload
+    kinds report their spec'd replica count; pods report Running."""
+    import datetime as dt
+
+    kind = res.get("kind", "")
+    out = dict(res)
+    # the apiserver stamps creationTimestamp; TTL expiry depends on it
+    meta = dict(out.get("metadata") or {})
+    if "creationTimestamp" not in meta:
+        meta["creationTimestamp"] = dt.datetime.now(
+            dt.timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")
+        out["metadata"] = meta
+    if "status" in res:
+        return out
+    if kind in ("Deployment", "StatefulSet", "ReplicaSet"):
+        n = (res.get("spec") or {}).get("replicas", 1)
+        out["status"] = {"replicas": n, "readyReplicas": n,
+                         "availableReplicas": n, "updatedReplicas": n}
+    elif kind == "Pod":
+        out["status"] = {"phase": "Running",
+                         "conditions": [{"type": "Ready", "status": "True"}]}
+    return out
+
+
+class StepError(Exception):
+    pass
+
+
+class Skip(Exception):
+    pass
+
+
+def _snapshot_find(snapshot: ClusterSnapshot, kind: str, namespace: str,
+                   name: str) -> Optional[Dict[str, Any]]:
+    """Single lookup-by-identity over the snapshot (shared by the
+    runner, the configMap source and the apiCall resolver)."""
+    for _, res, _ in snapshot.items():
+        meta = res.get("metadata") or {}
+        if (res.get("kind") == kind and meta.get("name") == name
+                and (meta.get("namespace") or "") == (namespace or "")):
+            return res
+    return None
+
+
+class _SnapshotApiCall:
+    """Minimal apiserver GET resolver over the snapshot: serves
+    /api/v1/namespaces/<ns>[/<plural>[/<name>]] and
+    /apis/<group>/<version>/... style urlPaths for apiCall context
+    entries (the runner's in-memory dclient)."""
+
+    _PLURALS = {"pods": "Pod", "configmaps": "ConfigMap",
+                "secrets": "Secret", "services": "Service",
+                "deployments": "Deployment", "namespaces": "Namespace"}
+
+    def __init__(self, snapshot: ClusterSnapshot):
+        self._snapshot = snapshot
+
+    def __call__(self, entry: Dict[str, Any]):
+        path = (entry.get("urlPath") or "").strip("/")
+        parts = path.split("/") if path else []
+        if parts[:2] == ["api", "v1"]:
+            parts = parts[2:]
+        elif parts and parts[0] == "apis" and len(parts) >= 3:
+            parts = parts[3:]
+        if parts and parts[0] == "namespaces":
+            if len(parts) == 2:  # a namespace object itself
+                return self._get("Namespace", "", parts[1])
+            ns = parts[1]
+            kind = self._PLURALS.get(parts[2] if len(parts) > 2 else "", "")
+            if len(parts) == 3:
+                return {"items": self._list(kind, ns)}
+            if len(parts) == 4:
+                return self._get(kind, ns, parts[3])
+        elif parts:
+            kind = self._PLURALS.get(parts[0], "")
+            if len(parts) == 1:
+                return {"items": self._list(kind, None)}
+            if len(parts) == 2:
+                return self._get(kind, "", parts[1])
+        raise ValueError(f"unsupported apiCall urlPath {entry.get('urlPath')!r}")
+
+    def _list(self, kind, ns):
+        return [r for _, r, _ in self._snapshot.items()
+                if r.get("kind") == kind
+                and (ns is None
+                     or (r.get("metadata") or {}).get("namespace", "") == ns)]
+
+    def _get(self, kind, ns, name):
+        res = _snapshot_find(self._snapshot, kind, ns, name)
+        if res is None:
+            raise ValueError(f"{kind} {ns}/{name} not found")
+        return res
+
+
+class _SnapshotConfigMaps:
+    """Live 'namespace/name' -> ConfigMap view over the snapshot (the
+    cluster-backed configMap context source)."""
+
+    def __init__(self, snapshot: ClusterSnapshot):
+        self._snapshot = snapshot
+
+    def get(self, key: str):
+        ns, _, name = key.partition("/")
+        return _snapshot_find(self._snapshot, "ConfigMap", ns, name)
+
+
+class ScenarioRunner:
+    def __init__(self, scenario_dir: str):
+        self.dir = scenario_dir
+        self.snapshot = ClusterSnapshot()
+        # every real cluster has these; scenarios rely on them as
+        # match triggers and namespace targets
+        for ns in ("default", "kube-system"):
+            self.snapshot.upsert({"apiVersion": "v1", "kind": "Namespace",
+                                  "metadata": {"name": ns}})
+        self.policies: Dict[str, ClusterPolicy] = {}
+        self.policy_docs: Dict[Tuple[str, str], Dict[str, Any]] = {}
+        self.exceptions: List[Dict[str, Any]] = []
+        from ..engine.contextloaders import DataSources
+
+        self.cleanup = CleanupController(
+            self.snapshot,
+            data_sources=DataSources(
+                configmaps=_SnapshotConfigMaps(self.snapshot),
+                api_call=_SnapshotApiCall(self.snapshot)))
+        self.ttl = TtlController(self.snapshot)
+        self.urq = UpdateRequestQueue()
+        self.generate = GenerateController(self.snapshot, self.policies)
+        from ..background.mutate_existing import MutateExistingController
+
+        self.mutate_existing = MutateExistingController(self.snapshot,
+                                                        self.policies)
+        self._virtual_now = None  # monotone controller clock (op_assert)
+        self.log: List[str] = []
+
+    # -- engine (rebuilt when exceptions change)
+
+    def _engine(self) -> ScalarEngine:
+        from ..engine.contextloaders import DataSources
+
+        return ScalarEngine(
+            data_sources=DataSources(
+                configmaps=_SnapshotConfigMaps(self.snapshot),
+                api_call=_SnapshotApiCall(self.snapshot)),
+            exceptions=list(self.exceptions))
+
+    # -- admission
+
+    def _admit(self, doc: Dict[str, Any]) -> Dict[str, Any]:
+        """mutate -> validate; raises StepError when an Enforce policy
+        denies. Returns the (possibly mutated) resource."""
+        eng = self._engine()
+        ns_labels = self.snapshot.namespace_labels()
+        meta = doc.get("metadata") or {}
+        ns = meta.get("namespace", "")
+        key = meta.get("name", "") if doc.get("kind") == "Namespace" else ns
+        exists = self._find(doc.get("kind", ""), ns, meta.get("name", ""))
+        op = "UPDATE" if exists is not None else "CREATE"
+        current = doc
+        for policy in self.policies.values():
+            if any(r.has_mutate() for r in policy.get_rules()):
+                pctx = _ctx(policy, current, ns_labels.get(key, {}), op)
+                m = eng.mutate(pctx)
+                if m.patched_resource is not None:
+                    current = m.patched_resource
+        for policy in self.policies.values():
+            if not any(r.has_validate() for r in policy.get_rules()):
+                continue
+            enforce = (policy.spec.validation_failure_action
+                       or "Audit").lower().startswith("enforce")
+            pctx = _ctx(policy, current, ns_labels.get(key, {}), op)
+            resp = eng.validate(pctx)
+            for rr in resp.policy_response.rules:
+                if rr.status in ("fail", "error") and enforce:
+                    raise StepError(
+                        f"admission denied by {policy.name}/{rr.name}: "
+                        f"{rr.message}")
+        return current
+
+    def _gate_delete(self, doc: Dict[str, Any]) -> None:
+        eng = self._engine()
+        ns_labels = self.snapshot.namespace_labels()
+        meta = doc.get("metadata") or {}
+        key = meta.get("name", "") if doc.get("kind") == "Namespace" \
+            else meta.get("namespace", "")
+        for policy in self.policies.values():
+            if not any(r.has_validate() for r in policy.get_rules()):
+                continue
+            enforce = (policy.spec.validation_failure_action
+                       or "Audit").lower().startswith("enforce")
+            pctx = _ctx(policy, doc, ns_labels.get(key, {}), "DELETE")
+            resp = eng.validate(pctx)
+            for rr in resp.policy_response.rules:
+                if rr.status in ("fail", "error") and enforce:
+                    raise StepError(
+                        f"delete denied by {policy.name}/{rr.name}")
+
+    # -- state helpers
+
+    def _find(self, kind: str, namespace: str, name: str) -> Optional[Dict[str, Any]]:
+        return _snapshot_find(self.snapshot, kind, namespace, name)
+
+    def _run_generate(self, trigger: Dict[str, Any], op: str,
+                      only_policy: Optional[str] = None,
+                      mutate_existing: bool = True) -> None:
+        for name, policy in self.policies.items():
+            if only_policy is not None and name != only_policy:
+                continue
+            if any(r.has_generate() for r in policy.get_rules()):
+                self.urq.add(UpdateRequest(policy=name, rule_type="generate",
+                                           trigger=trigger, operation=op))
+            if op != "DELETE" and mutate_existing:
+                from ..engine.match import matches_resource_description
+
+                # matches_resource_description returns mismatch REASONS
+                # (empty list = the rule matches the trigger)
+                if any(not matches_resource_description(trigger, r, operation=op)
+                       for r in policy.get_rules()
+                       if (r.mutation or {}).get("targets")):
+                    self.urq.add(UpdateRequest(
+                        policy=name, rule_type="mutate", trigger=trigger,
+                        operation=op))
+        self.urq.process(
+            lambda ur: (self.generate.process_ur(ur)
+                        if ur.rule_type == "generate"
+                        else self.mutate_existing.process_ur(ur)))
+
+    # -- ops
+
+    def op_apply(self, path: str, expect_error: bool) -> None:
+        for doc in self._load(path):
+            kind = doc.get("kind", "")
+            try:
+                if kind in POLICY_KINDS:
+                    self._install_policy(doc)
+                elif kind in EXCEPTION_KINDS:
+                    self._install_exception(doc)
+                elif kind in CLEANUP_KINDS:
+                    self._install_cleanup(doc)
+                else:
+                    admitted = self._admit(doc)
+                    self.snapshot.upsert(_synthesize_status(admitted))
+                    self._run_generate(admitted, "CREATE")
+            except StepError:
+                if expect_error:
+                    self.log.append(f"apply {os.path.basename(path)}: "
+                                    f"denied as expected")
+                    continue
+                raise
+            if expect_error:
+                raise StepError(
+                    f"apply {os.path.basename(path)}: expected denial, "
+                    f"but {kind} was admitted")
+
+    def _install_cleanup(self, doc: Dict[str, Any]) -> None:
+        from ..cluster.cleanup import validate_cleanup_policy
+
+        errors = validate_cleanup_policy(doc)
+        if errors:
+            raise StepError(f"cleanup policy rejected: {errors[0]}")
+        self.cleanup.set_policy(doc)
+        meta = doc.get("metadata") or {}
+        self.policy_docs[(doc.get("kind", ""), meta.get("name", ""))] = dict(doc)
+
+    def _install_exception(self, doc: Dict[str, Any]) -> None:
+        from ..api.exception import PolicyException
+
+        errors = PolicyException.from_dict(doc).validate()
+        if errors:
+            raise StepError(f"exception rejected: {errors[0]}")
+        self.exceptions.append(doc)
+
+    def _install_policy(self, doc: Dict[str, Any]) -> None:
+        parsed = ClusterPolicy.from_dict(doc)
+        errors, _ = validate_policy(parsed)
+        if errors:
+            raise StepError(f"policy rejected: {errors[0]}")
+        policy = expand_policy(parsed)
+        self.policies[policy.name] = policy
+        stored = dict(doc)
+        stored["status"] = dict(READY_STATUS)
+        meta = doc.get("metadata") or {}
+        self.policy_docs[(doc.get("kind", ""), meta.get("name", ""))] = stored
+        # replay existing triggers for THIS policy only: generate rules
+        # reconcile in background; mutate-existing replays at install
+        # only when spec.mutateExistingOnPolicyUpdate is set
+        mutate_on_update = bool((doc.get("spec") or {})
+                                .get("mutateExistingOnPolicyUpdate"))
+        for _, res, _ in self.snapshot.items():
+            self._run_generate(res, "UPDATE", only_policy=policy.name,
+                               mutate_existing=mutate_on_update)
+
+    def op_delete(self, ref: Dict[str, Any]) -> None:
+        kind = ref.get("kind", "")
+        meta = ref.get("metadata") or ref
+        name = meta.get("name", "")
+        namespace = meta.get("namespace", "")
+        if kind in POLICY_KINDS:
+            self.policies.pop(name, None)
+            self.policy_docs.pop((kind, name), None)
+            return
+        if kind in CLEANUP_KINDS:
+            self.cleanup.unset_policy(name)
+            self.policy_docs.pop((kind, name), None)
+            return
+        if kind in EXCEPTION_KINDS:
+            self.exceptions = [
+                e for e in self.exceptions
+                if (e.get("metadata") or {}).get("name") != name]
+            return
+        obj = self._find(kind, namespace, name)
+        if obj is None:
+            return  # chainsaw delete tolerates absent objects
+        self._gate_delete(obj)
+        self.snapshot.delete(obj)
+        self._run_generate(obj, "DELETE")
+
+    def op_assert(self, path: str, want_match: bool) -> None:
+        if not want_match:
+            # chainsaw `error` asserts eventual ABSENCE within its
+            # timeout; the ttl/cleanup controllers get to act first.
+            # The virtual clock advances MONOTONICALLY past each
+            # policy's next cron slot, so consecutive error-asserts
+            # each get a fresh controller pass
+            import datetime as dt
+
+            base = self._virtual_now or dt.datetime.now(dt.timezone.utc)
+            self._virtual_now = base + dt.timedelta(hours=2)
+            self.ttl.run_once(now=self._virtual_now)
+            self.cleanup.run_due(now=self._virtual_now)
+        for doc in self._load(path):
+            ok = self._doc_matches(doc)
+            if want_match and not ok:
+                raise StepError(f"assert {os.path.basename(path)}: no object "
+                                f"matches {doc.get('kind')}/"
+                                f"{(doc.get('metadata') or {}).get('name')}")
+            if not want_match and ok:
+                raise StepError(f"error {os.path.basename(path)}: object "
+                                f"unexpectedly matches")
+
+    def _doc_matches(self, doc: Dict[str, Any]) -> bool:
+        kind = doc.get("kind", "")
+        meta = doc.get("metadata") or {}
+        name = meta.get("name", "")
+        tree = {k: v for k, v in doc.items() if k != "apiVersion"}
+        if kind in POLICY_KINDS + EXCEPTION_KINDS + CLEANUP_KINDS:
+            if kind in EXCEPTION_KINDS:
+                target = next((e for e in self.exceptions
+                               if (e.get("metadata") or {}).get("name") == name),
+                              None)
+            else:
+                target = self.policy_docs.get((kind, name)) \
+                    or self.policy_docs.get(("ClusterPolicy", name)) \
+                    or self.policy_docs.get(("Policy", name))
+            if target is None:
+                return False
+            return self._subset(tree, target)
+        if kind in ("PolicyReport", "ClusterPolicyReport"):
+            return any(self._subset(tree, rep)
+                       for rep in self._materialize_reports(kind))
+        if name:
+            target = self._find(kind, meta.get("namespace", ""), name)
+            return target is not None and self._subset(tree, target)
+        # no name: any live object of the kind may satisfy the tree
+        return any(self._subset(tree, res) for _, res, _ in self.snapshot.items()
+                   if res.get("kind") == kind)
+
+    @staticmethod
+    def _subset(tree: Dict[str, Any], target: Dict[str, Any]) -> bool:
+        try:
+            return not assert_tree(tree, target)
+        except AssertionError_:
+            return False
+
+    def _materialize_reports(self, kind: str) -> List[Dict[str, Any]]:
+        """Background-scan the snapshot and shape per-resource
+        PolicyReports the way the reports controller writes them
+        (scope + results rows + summary, managed-by label)."""
+        eng = self._engine()
+        ns_labels = self.snapshot.namespace_labels()
+        reports: List[Dict[str, Any]] = []
+        for _, res, _ in self.snapshot.items():
+            meta = res.get("metadata") or {}
+            ns = meta.get("namespace", "")
+            if (kind == "PolicyReport") != bool(ns):
+                continue
+            rows: List[Dict[str, Any]] = []
+            for policy in self.policies.values():
+                if not policy.spec.background:
+                    continue
+                if not any(r.has_validate() for r in policy.get_rules()):
+                    continue
+                key = meta.get("name", "") if res.get("kind") == "Namespace" else ns
+                pctx = build_scan_context(policy, res, ns_labels.get(key, {}))
+                resp = eng.validate(pctx)
+                for rr in resp.policy_response.rules:
+                    rows.append({"policy": policy.name, "rule": rr.name,
+                                 "result": rr.status,
+                                 "message": rr.message})
+            if not rows:
+                continue
+            summary = {s: sum(1 for r in rows if r["result"] == s)
+                       for s in ("pass", "fail", "warn", "error", "skip")}
+            reports.append({
+                "apiVersion": "wgpolicyk8s.io/v1alpha2", "kind": kind,
+                "metadata": {"namespace": ns,
+                             "labels": {"app.kubernetes.io/managed-by": "kyverno"}},
+                "scope": {"apiVersion": res.get("apiVersion", ""),
+                          "kind": res.get("kind", ""),
+                          "name": meta.get("name", ""),
+                          **({"namespace": ns} if ns else {})},
+                "results": rows,
+                "summary": summary,
+            })
+        return reports
+
+    # -- scenario loop
+
+    def _load(self, path: str) -> List[Dict[str, Any]]:
+        with open(os.path.join(self.dir, path)) as f:
+            return [d for d in yaml.safe_load_all(f) if isinstance(d, dict)]
+
+    def run(self) -> List[str]:
+        """Raises StepError on failure, Skip for unsupported steps;
+        returns the step log on success."""
+        with open(os.path.join(self.dir, "chainsaw-test.yaml")) as f:
+            test = yaml.safe_load(f)
+        steps = ((test.get("spec") or {}).get("steps")) or []
+        for si, step in enumerate(steps):
+            ops = list(step.get("try") or [])
+            for op in ops:
+                if "script" in op or "sleep" in op or "command" in op:
+                    raise Skip(f"step {si}: script/sleep unsupported")
+                if "apply" in op:
+                    a = op["apply"]
+                    expect_error = any(
+                        (c.get("check") or {}).get("($error != null)") is True
+                        for c in (a.get("expect") or []))
+                    self.op_apply(a["file"], expect_error)
+                    self.log.append(f"applied {a['file']}")
+                elif "create" in op:
+                    a = op["create"]
+                    self.op_apply(a["file"], any(
+                        (c.get("check") or {}).get("($error != null)") is True
+                        for c in (a.get("expect") or [])))
+                    self.log.append(f"created {a['file']}")
+                elif "assert" in op:
+                    self.op_assert(op["assert"]["file"], want_match=True)
+                    self.log.append(f"asserted {op['assert']['file']}")
+                elif "error" in op:
+                    self.op_assert(op["error"]["file"], want_match=False)
+                    self.log.append(f"errored {op['error']['file']}")
+                elif "delete" in op:
+                    d = op["delete"]
+                    refs = []
+                    if "ref" in d:
+                        refs = [d["ref"]]
+                    elif "file" in d:
+                        refs = self._load(d["file"])
+                    for ref in refs:
+                        self.op_delete(ref)
+                    self.log.append(f"deleted step {si}")
+                else:
+                    raise Skip(f"step {si}: unsupported op {sorted(op)}")
+        return self.log
+
+
+def run_scenario(scenario_dir: str) -> Tuple[str, str]:
+    """(status, detail): pass | fail | skip."""
+    try:
+        ScenarioRunner(scenario_dir).run()
+        return "pass", ""
+    except Skip as e:
+        return "skip", str(e)
+    except StepError as e:
+        return "fail", str(e)
+    except Exception as e:  # noqa: BLE001 — a crash is a failing scenario
+        return "fail", f"{type(e).__name__}: {e}"
+
+
+def run_tree(root: str) -> List[Tuple[str, str, str]]:
+    out = []
+    for dirpath, _, files in sorted(os.walk(root)):
+        if "chainsaw-test.yaml" in files:
+            status, detail = run_scenario(dirpath)
+            out.append((os.path.relpath(dirpath, root), status, detail))
+    return out
+
+
+def add_parser(sub) -> None:
+    p = sub.add_parser("chainsaw", help="replay chainsaw e2e scenarios")
+    p.add_argument("paths", nargs="+", help="scenario directories (trees)")
+    p.set_defaults(func=run_cmd)
+
+
+def run_cmd(args: argparse.Namespace) -> int:
+    failed = 0
+    for root in args.paths:
+        for rel, status, detail in run_tree(root):
+            print(f"{status.upper():5} {rel}" + (f"  ({detail})" if detail else ""))
+            failed += status == "fail"
+    return 1 if failed else 0

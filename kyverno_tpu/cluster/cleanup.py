@@ -20,7 +20,7 @@ from ..api.policy import ClusterPolicy, Rule
 from ..engine.conditions import evaluate_conditions
 from ..engine.match import matches_resource_description
 from ..tpu.engine import build_scan_context
-from ..utils.cron import Cron
+from ..utils.cron import Cron, CronError
 from ..utils.duration import parse_duration
 from .snapshot import ClusterSnapshot
 
@@ -49,7 +49,8 @@ class CleanupPolicy:
     def next_execution(self, after: dt.datetime) -> dt.datetime:
         return self.schedule.next_after(after)
 
-    def matches(self, resource: Dict[str, Any], ns_labels: Dict[str, str]) -> bool:
+    def matches(self, resource: Dict[str, Any], ns_labels: Dict[str, str],
+                data_sources=None) -> bool:
         if self.namespace and (resource.get("metadata") or {}).get("namespace") != self.namespace:
             return False
         reasons = matches_resource_description(
@@ -60,13 +61,55 @@ class CleanupPolicy:
             pctx = build_scan_context(
                 ClusterPolicy.from_dict({"metadata": {"name": self.name}, "spec": {}}),
                 resource, ns_labels)
+            # cleanup conditions address the candidate as {{ target.* }}
+            # (cleanup handlers.go: the target resource binds there)
+            pctx.json_context.add_json({"target": resource})
+            context_entries = (self.raw.get("spec") or {}).get("context")
+            if context_entries:
+                from ..engine.contextloaders import load_context_entries
+
+                load_context_entries(pctx.json_context, context_entries,
+                                     sources=data_sources)
             return evaluate_conditions(pctx.json_context, self.conditions)
         return True
 
 
+def validate_cleanup_policy(doc: Dict[str, Any]) -> List[str]:
+    """Admission-time (Cluster)CleanupPolicy validation
+    (pkg/validation/cleanuppolicy): schedule must be a valid cron,
+    match/exclude may not carry user info (there is no requester at
+    cleanup time), and context entries are restricted — imageRegistry
+    is not supported for cleanup policies."""
+    errors: List[str] = []
+    spec = doc.get("spec") or {}
+    schedule = spec.get("schedule")
+    if not schedule:
+        errors.append("spec.schedule is required")
+    else:
+        try:
+            Cron(schedule)
+        except CronError as e:
+            errors.append(f"invalid cron schedule {schedule!r}: {e}")
+    for block_name in ("match", "exclude"):
+        block = spec.get(block_name) or {}
+        for entry in list(block.get("any") or []) + list(block.get("all") or []):
+            if any(entry.get(k) for k in ("subjects", "roles", "clusterRoles")):
+                errors.append(
+                    f"{block_name} may not contain subjects/roles/clusterRoles")
+    # cleanup_policy_types.go:180 ValidateContext: imageRegistry and
+    # configMap context entries are not allowed in cleanup policies
+    for entry in spec.get("context") or []:
+        if "imageRegistry" in entry:
+            errors.append("ImageRegistry is not allowed in CleanUp Policy")
+        if "configMap" in entry:
+            errors.append("ConfigMap is not allowed in CleanUp Policy")
+    return errors
+
+
 class CleanupController:
-    def __init__(self, snapshot: ClusterSnapshot):
+    def __init__(self, snapshot: ClusterSnapshot, data_sources=None):
         self.snapshot = snapshot
+        self.data_sources = data_sources  # context-entry backends
         self.policies: Dict[str, CleanupPolicy] = {}
         self.deleted_total = 0
 
@@ -98,7 +141,7 @@ class CleanupController:
         for uid, res, _ in self.snapshot.items():
             meta = res.get("metadata") or {}
             key = meta.get("name", "") if res.get("kind") == "Namespace" else meta.get("namespace", "")
-            if policy.matches(res, ns_labels.get(key, {})):
+            if policy.matches(res, ns_labels.get(key, {}), self.data_sources):
                 doomed.append(uid)
         for uid in doomed:
             self.snapshot.delete(uid)

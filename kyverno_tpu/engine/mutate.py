@@ -176,12 +176,18 @@ def _pointer_segments(pointer: str) -> List[str]:
     return [seg.replace("~1", "/").replace("~0", "~") for seg in pointer.split("/")[1:]]
 
 
-def _resolve_parent(doc: Any, segments: List[str]) -> Tuple[Any, str]:
+def _resolve_parent(doc: Any, segments: List[str],
+                    ensure: bool = False) -> Tuple[Any, str]:
     node = doc
-    for seg in segments[:-1]:
+    for i, seg in enumerate(segments[:-1]):
         if isinstance(node, dict):
             if seg not in node:
-                raise PatchError(f"path not found: {seg}")
+                if not ensure:
+                    raise PatchError(f"path not found: {seg}")
+                # create the missing container: a list when the NEXT
+                # segment is an index / "-", else a map
+                nxt = segments[i + 1]
+                node[seg] = [] if (nxt == "-" or nxt.lstrip("-").isdigit()) else {}
             node = node[seg]
         elif isinstance(node, list):
             try:
@@ -224,29 +230,45 @@ def apply_json6902(resource: Dict[str, Any], patches: List[Dict[str, Any]]) -> D
             if not segments:
                 doc = value
                 continue
-            parent, last = _resolve_parent(doc, segments)
+            # EnsurePathExistsOnAdd (patchJSON6902.go:25): the engine
+            # applies adds with missing intermediate containers created
+            # on the way (maps for name segments, lists for indices)
+            parent, last = _resolve_parent(doc, segments, ensure=True)
             if isinstance(parent, list):
                 if last == "-":
                     parent.append(value)
                 else:
                     try:
-                        parent.insert(int(last), value)
+                        idx = int(last)
                     except ValueError:
                         raise PatchError(f"bad array index {last}")
+                    if idx < 0:  # SupportNegativeIndices
+                        idx += len(parent) + 1
+                    if not 0 <= idx <= len(parent):
+                        # list.insert would silently clamp; the
+                        # reference engine rejects out-of-bounds adds
+                        raise PatchError(f"index {last} out of bounds")
+                    parent.insert(idx, value)
             elif isinstance(parent, dict):
                 parent[last] = value
             else:
                 raise PatchError(f"cannot add into {type(parent).__name__}")
         elif op == "remove":
-            parent, last = _resolve_parent(doc, segments)
+            # AllowMissingPathOnRemove: absent paths are a no-op
+            try:
+                parent, last = _resolve_parent(doc, segments)
+            except PatchError:
+                continue
             if isinstance(parent, list):
                 try:
                     del parent[int(last)]
-                except (ValueError, IndexError):
+                except ValueError:
                     raise PatchError(f"bad array index {last}")
+                except IndexError:
+                    continue
             elif isinstance(parent, dict):
                 if last not in parent:
-                    raise PatchError(f"path not found: {path}")
+                    continue
                 del parent[last]
         elif op == "replace":
             if not segments:

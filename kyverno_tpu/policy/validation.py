@@ -187,6 +187,31 @@ def _check_context_entries(rule: Dict[str, Any], errs: List[str]) -> None:
                             f"{ename!r} cannot have both urlPath and service")
 
 
+def _check_mutate_existing(raw_spec: Dict[str, Any], rule: Dict[str, Any],
+                           errs: List[str]) -> None:
+    """Mutate-existing validation (pkg/validation/policy):
+    - mutateExistingOnPolicyUpdate requires every mutate rule to
+      declare targets (there is no admission object to mutate);
+    - target selectors may not reference {{ target.* }} — the target
+      is not resolved until after selection."""
+    name = rule.get("name") or ""
+    mutate = rule.get("mutate") or {}
+    if not mutate:
+        return
+    targets = mutate.get("targets")
+    if raw_spec.get("mutateExistingOnPolicyUpdate") and not targets:
+        errs.append(f"rule {name!r}: mutateExistingOnPolicyUpdate requires "
+                    f"mutate.targets")
+    for i, t in enumerate(targets or []):
+        for field_name in ("name", "namespace", "apiVersion", "kind"):
+            val = t.get(field_name)
+            if isinstance(val, str) and ("{{target." in val.replace(" ", "")):
+                errs.append(
+                    f"rule {name!r}: mutate.targets[{i}].{field_name} may "
+                    f"not reference target.* variables (unresolved at "
+                    f"target selection)")
+
+
 def _check_json_patch(rule: Dict[str, Any], errs: List[str]) -> None:
     """validateJSONPatch (validate.go:87): op/path shape, no variables
     in the path section (validate.go:590)."""
@@ -304,6 +329,7 @@ def validate_policy(policy: ClusterPolicy,
         errors.extend(_check_match_block(rule))
         _check_context_entries(rule, errors)
         _check_json_patch(rule, errors)
+        _check_mutate_existing(spec, rule, errors)
         _check_forbidden_variables(rule, errors)
         _check_generate(rule, errors, auth_checker)
         _check_conditions(rule.get("preconditions"),

@@ -1,0 +1,71 @@
+"""Chainsaw conformance replay (test/conformance/chainsaw): the
+reference's e2e scenarios run against the in-memory control plane via
+the scenario runner (cli/chainsaw.py). The pinned list spans
+validate / mutate (incl. mutate-existing) / generate / exceptions /
+cleanup / ttl — 39 scenarios, all required green."""
+
+import os
+
+import pytest
+
+from kyverno_tpu.cli.chainsaw import run_scenario
+
+ROOT = "/root/reference/test/conformance/chainsaw"
+
+SCENARIOS = [
+    "exceptions/allows-rejects-creation",
+    "exceptions/applies-to-delete",
+    "exceptions/background-mode/standard",
+    "exceptions/conditions",
+    "exceptions/exclude-capabilities",
+    "exceptions/exclude-host-ports",
+    "exceptions/exclude-host-process-and-host-namespaces",
+    "exceptions/only-for-specific-user",
+    "exceptions/with-wildcard",
+    "validate/clusterpolicy/standard/audit/configmap-context-lookup",
+    "validate/clusterpolicy/standard/enforce/csr",
+    "validate/clusterpolicy/standard/enforce/failure-policy-ignore-anchor",
+    "validate/clusterpolicy/standard/enforce/ns-selector-with-wildcard-kind",
+    "validate/clusterpolicy/standard/enforce/operator-anyin-boolean",
+    "validate/clusterpolicy/standard/enforce/resource-apply-block",
+    "cleanup/clusterpolicy/context-cleanup-pod",
+    "cleanup/policy/cleanup-pod",
+    "cleanup/validation/cron-format",
+    "cleanup/validation/no-user-info-in-match",
+    "cleanup/validation/not-supported-attributes-in-context",
+    "ttl/delete-twice",
+    "ttl/invalid-label",
+    "ttl/past-timestamp",
+    "rangeoperators/standard",
+    "mutate/clusterpolicy/standard/basic-check-output",
+    "mutate/clusterpolicy/standard/existing/background-false",
+    "mutate/clusterpolicy/standard/existing/basic-create",
+    "mutate/clusterpolicy/standard/existing/basic-create-patchesJson6902",
+    "mutate/clusterpolicy/standard/existing/basic-update",
+    "mutate/clusterpolicy/standard/existing/onpolicyupdate/basic-create-policy",
+    "mutate/clusterpolicy/standard/existing/preconditions",
+    "mutate/clusterpolicy/standard/existing/validation/mutate-existing-require-targets",
+    "mutate/clusterpolicy/standard/existing/validation/target-variable-validation",
+    "generate/clusterpolicy/standard/data/nosync/cpol-data-nosync-delete-rule",
+    "generate/clusterpolicy/standard/data/nosync/cpol-data-nosync-modify-downstream",
+    "generate/clusterpolicy/standard/data/nosync/cpol-data-nosync-modify-rule",
+    "generate/clusterpolicy/standard/data/sync/cpol-data-sync-create",
+    "generate/clusterpolicy/standard/data/sync/cpol-data-sync-modify-rule",
+    "generate/clusterpolicy/standard/data/sync/cpol-data-sync-orphan-downstream-delete-policy",
+]
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(ROOT), reason="reference chainsaw corpus not present")
+
+
+@pytest.mark.parametrize("scenario", SCENARIOS)
+def test_chainsaw_scenario(scenario):
+    status, detail = run_scenario(os.path.join(ROOT, scenario))
+    assert status == "pass", f"{scenario}: {status} {detail}"
+
+
+def test_pinned_breadth():
+    areas = {s.split("/")[0] for s in SCENARIOS}
+    assert {"validate", "mutate", "generate", "exceptions",
+            "cleanup", "ttl"} <= areas
+    assert len(SCENARIOS) >= 30
