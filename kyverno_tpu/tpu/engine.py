@@ -217,6 +217,8 @@ class TpuEngine:
                 for ci in np.nonzero(row == HOST)[0]:
                     host_cells[(entry.policy_idx, int(ci))] = None
 
+        from ..engine.match import matches_resource_description
+
         cache: Dict[Tuple[int, int], Dict[str, int]] = {}
         for (pi, ci) in host_cells:
             policy = self.cps.policies[pi]
@@ -226,14 +228,30 @@ class TpuEngine:
             nsl = ns_labels.get((res.get("metadata") or {}).get("name", "") if kind == "Namespace" else ns, {})
             op = (operations[ci] if operations else "") or ""
             info = admission_infos[ci] if admission_infos else None
+            # pre-screen with the (cheap) matcher before paying for
+            # context construction + full validation: in a realistic
+            # mix most host (policy, resource) cells are simply not
+            # matched (kind/selector mismatch), making the fallback
+            # cost scale with MATCHED cells, not policies x resources
+            if not any(
+                    not matches_resource_description(
+                        res, rule, info, nsl,
+                        policy_namespace=policy.namespace,
+                        operation=op or "CREATE")
+                    for rule in policy.get_rules() if rule.has_validate()):
+                cache[(pi, ci)] = {}  # every rule NOT_MATCHED
+                continue
             pctx = build_scan_context(policy, res, nsl, op, info)
             cache[(pi, ci)] = _scalar_rule_verdicts(self.scalar, policy, pctx)
         for ri, entry in enumerate(self.cps.rules):
             for (pi, ci), verdicts in cache.items():
-                if pi == entry.policy_idx and entry.rule_name in verdicts:
-                    if (entry.device_row is None or ri in self._exception_rules
-                            or total[ri, ci] == HOST):
-                        total[ri, ci] = verdicts[entry.rule_name]
+                if pi != entry.policy_idx:
+                    continue
+                if (entry.device_row is None or ri in self._exception_rules
+                        or total[ri, ci] == HOST):
+                    # pre-screened cells carry no verdict rows: the
+                    # whole policy was unmatched (HOST must not escape)
+                    total[ri, ci] = verdicts.get(entry.rule_name, NOT_MATCHED)
 
         return ScanResult(
             verdicts=total,

@@ -540,23 +540,12 @@ class ConditionCompiler:
         op = str(cond.get("operator", "")).lower()
         if op not in _SUPPORTED_OPS:
             raise Unsupported(f"operator {op}")
-        value = self._compile_value(cond.get("value"))
         key = cond.get("key")
-        if not isinstance(key, str):
-            if self.element_mode and isinstance(key, (int, float, bool)):
-                self._guard_literal_key_value(op, value)
-                return CondIR(LiteralKey(key), op, value)
-            raise Unsupported("non-string condition key")
+        is_var_key = isinstance(key, str) and _VAR_RE.match(key.strip())
+        if not is_var_key:
+            return self._compile_literal_key_condition(cond, op, key)
+        value = self._compile_value(cond.get("value"))
         m = _VAR_RE.match(key.strip())
-        if not m:
-            if self.element_mode and "{{" not in key:
-                if contains_wildcard(key):
-                    raise Unsupported("glob literal key")
-                self._guard_literal_key_value(op, value)
-                return CondIR(LiteralKey(key), op, value)
-            # literal string key (no variable): constant-foldable, but
-            # rare — keep host
-            raise Unsupported("non-variable condition key")
         expr = m.group(1).strip()
         if "{{" in expr:
             raise Unsupported("nested variables in key")
@@ -581,20 +570,65 @@ class ConditionCompiler:
             raise Unsupported("element value with non-literal key")
         return CondIR(key_ir, op, value)
 
+    def _compile_literal_key_condition(self, cond: Dict[str, Any], op: str,
+                                       key: Any) -> CondIR:
+        """Non-variable keys: with a literal value the whole condition
+        is a compile/eval-time CONSTANT the evaluator folds via the
+        scalar oracle (evaluator.eval_cond LiteralKey branch) — any key
+        and value types, globs included, exactly the oracle's
+        semantics. With an {{element...}} value (foreach bodies), the
+        key joins the collected list on device, which needs hashable
+        exact keys (no globs)."""
+        if isinstance(key, str):
+            if "{{" in key:
+                raise Unsupported("partial/nested variable in key")
+        elif not (isinstance(key, (int, float, bool, list, dict)) or key is None):
+            raise Unsupported("non-literal condition key")
+        value = self._compile_value_lenient(cond.get("value"))
+        if isinstance(value, ElementCollect):
+            if isinstance(key, str) and contains_wildcard(key):
+                raise Unsupported("glob literal key")
+            if not isinstance(key, (str, int, float, bool)):
+                raise Unsupported("non-scalar key with element value")
+            self._guard_literal_key_value(op, value)
+        return CondIR(LiteralKey(key), op, value)
+
+    def _try_element_value(self, value: Any) -> Optional["ElementCollect"]:
+        """{{ element... }} string value in foreach bodies -> the
+        collected projection; None when not an element value."""
+        if not (self.element_mode and isinstance(value, str)):
+            return None
+        m = _VAR_RE.match(value.strip())
+        if m is None:
+            return None
+        expr = m.group(1).strip()
+        if "{{" in expr:
+            raise Unsupported("nested variables in value")
+        ast = self._parser.parse(expr)
+        ec = self._compile_element_key(ast)
+        if not isinstance(ec, ElementCollect):
+            raise Unsupported("non-element variable value")
+        return ec
+
+    def _compile_value_lenient(self, value: Any) -> Any:
+        """Value for a literal-key condition: ElementCollect in foreach
+        bodies, otherwise any reference-free literal (the constant fold
+        handles all types)."""
+        import json as _json
+
+        ec = self._try_element_value(value)
+        if ec is not None:
+            return ec
+        if "{{" in _json.dumps(value, default=str):
+            raise Unsupported("variable in condition value")
+        return value
+
     def _compile_value(self, value: Any) -> Any:
         """Literal passthrough, or an {{ element... }} ElementCollect in
         foreach bodies."""
-        if self.element_mode and isinstance(value, str):
-            m = _VAR_RE.match(value.strip())
-            if m is not None:
-                expr = m.group(1).strip()
-                if "{{" in expr:
-                    raise Unsupported("nested variables in value")
-                ast = self._parser.parse(expr)
-                ec = self._compile_element_key(ast)
-                if not isinstance(ec, ElementCollect):
-                    raise Unsupported("non-element variable value")
-                return ec
+        ec = self._try_element_value(value)
+        if ec is not None:
+            return ec
         self._check_literal_value(value)
         return value
 
@@ -958,13 +992,99 @@ class RuleProgram:
     fallback_reason: Optional[str] = None
 
 
+_FOLD_VAR_RE = re.compile(r"\{\{\s*([^{}]+?)\s*\}\}")
+_FOLD_ROOT_RE = re.compile(r"^([A-Za-z_][A-Za-z_0-9]*)")
+
+
+def _fold_static_context(rule: Rule) -> Optional[Rule]:
+    """Constant-fold `variable` context entries whose specs contain no
+    references: their values are compile-time constants, so every
+    {{ name... }} occurrence in the rule body substitutes away and the
+    rule lowers like a context-free one. Entries of any other kind (or
+    with references) return None — dynamic context stays host-only."""
+    import json as _json
+
+    from ..engine.contextloaders import _load_variable
+    from ..engine.context import Context
+    from ..engine.jmespath import compile as jp_compile
+
+    env: Dict[str, Any] = {}
+    for entry in rule.context:
+        if not isinstance(entry, dict):
+            return None
+        spec = entry.get("variable")
+        if not isinstance(spec, dict) or not entry.get("name"):
+            return None
+        # static iff an explicit literal `value` is present: the
+        # loader then evaluates any jmesPath against THAT value. A
+        # jmesPath-only spec reads the live context (request.*) — on
+        # an empty Context it would silently collapse to its default
+        # arm and bake a WRONG constant in — so it stays dynamic.
+        if spec.get("value") is None:
+            return None
+        if "{{" in _json.dumps(spec, default=str):
+            return None  # references other context -> dynamic
+        try:
+            env[entry["name"]] = _load_variable(Context(), spec)
+        except Exception:
+            return None
+
+    def subst(node: Any) -> Any:
+        if isinstance(node, dict):
+            return {subst(k) if isinstance(k, str) else k: subst(v)
+                    for k, v in node.items()}
+        if isinstance(node, list):
+            return [subst(x) for x in node]
+        if not isinstance(node, str):
+            return node
+        matches = list(_FOLD_VAR_RE.finditer(node))
+        if not matches:
+            return node
+        def resolve(expr: str):
+            root = _FOLD_ROOT_RE.match(expr)
+            if root is None or root.group(1) not in env:
+                return _UNFOLDED
+            rest = expr[root.end():]
+            if rest and not rest.startswith((".", "[")):
+                return _UNFOLDED  # functions etc. stay dynamic
+            try:
+                return jp_compile(expr).search(env)
+            except Exception:
+                return _UNFOLDED
+        if len(matches) == 1 and matches[0].span() == (0, len(node)):
+            val = resolve(matches[0].group(1))
+            return node if val is _UNFOLDED else val
+        out = node
+        for m in reversed(matches):
+            val = resolve(m.group(1))
+            if val is _UNFOLDED:
+                continue
+            if isinstance(val, bool):
+                s = "true" if val else "false"
+            elif val is None or isinstance(val, (dict, list)):
+                return node  # composite interpolation stays dynamic
+            else:
+                s = str(val)
+            out = out[:m.start()] + s + out[m.end():]
+        return out
+
+    raw = subst({k: v for k, v in rule.raw.items() if k != "context"})
+    return Rule.from_dict(raw)
+
+
+_UNFOLDED = object()
+
+
 def compile_rule(policy: ClusterPolicy, rule: Rule) -> RuleProgram:
     """Compile one validate rule; raises Unsupported for host-only rules."""
-    v = rule.validation
-    if v is None:
+    if rule.validation is None:
         raise Unsupported("not a validate rule")
     if rule.context:
-        raise Unsupported("rule context entries")
+        folded = _fold_static_context(rule)
+        if folded is None or folded.validation is None:
+            raise Unsupported("rule context entries")
+        rule = folded
+    v = rule.validation
     match_ir, exclude_ir = compile_match(rule)
     cc = ConditionCompiler()
     pre_ir = cc.compile_tree(rule.preconditions)

@@ -398,3 +398,79 @@ def test_engine_buckets_batch_shapes(monkeypatch):
     assert r1.verdicts.shape[1] == 3 and r2.verdicts.shape[1] == 13
     r3 = eng.scan([mk(i) for i in range(17)])
     assert shapes[-1] == 32 and r3.verdicts.shape[1] == 17
+
+
+def test_static_context_folding():
+    """Literal `variable` context entries constant-fold at compile so
+    the rule lowers to device; jmesPath-only (request-reading) entries
+    must NOT fold — an empty compile context would bake their default
+    arm in as a wrong constant."""
+    from kyverno_tpu.api.policy import ClusterPolicy
+    from kyverno_tpu.tpu.compiler import compile_policy_set
+
+    def policy(context, conditions):
+        return ClusterPolicy.from_dict({
+            "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+            "metadata": {"name": "p"},
+            "spec": {"rules": [{
+                "name": "r", "context": context,
+                "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+                "validate": {"message": "m",
+                             "deny": {"conditions": {"any": conditions}}},
+            }]}})
+
+    static = policy(
+        [{"name": "maxmem", "variable": {"value": "1Gi"}}],
+        [{"key": "{{ request.object.spec.mem }}", "operator": "GreaterThan",
+          "value": "{{ maxmem }}"}])
+    cps = compile_policy_set([static])
+    assert cps.coverage() == (1, 1), cps.rules[0].fallback_reason
+
+    dynamic = policy(
+        [{"name": "replicas", "variable": {
+            "jmesPath": "request.object.spec.replicas", "default": 1}}],
+        [{"key": "{{ replicas }}", "operator": "GreaterThan", "value": 10}])
+    cps = compile_policy_set([dynamic])
+    assert cps.coverage() == (0, 1)
+    assert "context" in cps.rules[0].fallback_reason
+
+    # folded constants evaluate correctly end to end
+    from kyverno_tpu.tpu.engine import TpuEngine
+
+    eng = TpuEngine([static])
+    res = eng.scan([
+        {"apiVersion": "v1", "kind": "Pod",
+         "metadata": {"name": "big"}, "spec": {"mem": "2Gi"}},
+        {"apiVersion": "v1", "kind": "Pod",
+         "metadata": {"name": "ok"}, "spec": {"mem": "512Mi"}},
+    ])
+    assert res.verdicts[0, 0] == 2 and res.verdicts[0, 1] == 0  # FAIL, PASS
+
+
+def test_literal_key_condition_constant_folds():
+    """Non-variable condition keys (e.g. folded constants) lower as
+    compile-time constants via the scalar oracle."""
+    from kyverno_tpu.api.policy import ClusterPolicy
+    from kyverno_tpu.tpu.engine import TpuEngine
+
+    p = ClusterPolicy.from_dict({
+        "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+        "metadata": {"name": "p"},
+        "spec": {"rules": [{
+            "name": "r",
+            "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+            "validate": {"message": "m", "deny": {"conditions": {"all": [
+                {"key": "prod", "operator": "Equals", "value": "prod"},
+                {"key": "{{ request.object.spec.bad }}", "operator": "Equals",
+                 "value": True},
+            ]}}},
+        }]}})
+    eng = TpuEngine(p if isinstance(p, list) else [p])
+    assert eng.coverage() == (1, 1), eng.cps.rules[0].fallback_reason
+    res = eng.scan([
+        {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "a"},
+         "spec": {"bad": True}},
+        {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "b"},
+         "spec": {"bad": False}},
+    ])
+    assert res.verdicts[0, 0] == 2 and res.verdicts[0, 1] == 0
