@@ -666,7 +666,12 @@ def _op_canon(op: str) -> str:
 
 
 _IN_MODES = {"anyin": "any_in", "allin": "all_in",
-             "anynotin": "any_not_in", "allnotin": "all_not_in"}
+             "anynotin": "any_not_in", "allnotin": "all_not_in",
+             # deprecated In/NotIn (in.go): scalar keys behave like
+             # AnyIn/AnyNotIn; list keys are strict (all-in / any-not-
+             # in with non-string elements forcing false — see the
+             # strict handling in _eval_path_cond)
+             "in": "in_strict", "notin": "notin_strict"}
 _NUM_OPS = {"greaterthan": "gt", "greaterthanorequals": "ge",
             "lessthan": "lt", "lessthanorequals": "le"}
 
@@ -798,7 +803,9 @@ def _eval_op_cond(ctx: Ctx, key: OpKey, op: str, value: Any) -> jnp.ndarray:
             hit = hit | (key_code == np.int32(vc))
         hit = key_present & hit
         mode = _IN_MODES[op]
-        if mode in ("any_in", "all_in"):
+        # request.operation is a scalar string: deprecated In/NotIn
+        # behave exactly like AnyIn/AnyNotIn on it
+        if mode in ("any_in", "all_in", "in_strict"):
             return hit
         return key_present & ~hit
     # numeric on operation strings never succeeds
@@ -878,8 +885,11 @@ def _scalar_falsy(ctx: Ctx, mask: jnp.ndarray, scope) -> jnp.ndarray:
 def _scalar_membership_const(default: Any, literals: List[Any], mode: str) -> bool:
     """Host-computed membership result when the || default kicks in
     (exact conditions.py semantics via the scalar oracle)."""
-    from ..engine.conditions import _membership
+    from ..engine.conditions import _deprecated_in, _membership
 
+    if mode in ("in_strict", "notin_strict"):
+        return _deprecated_in(default, list(literals),
+                              not_in=(mode == "notin_strict"))
     return _membership(default, literals, mode)
 
 
@@ -889,6 +899,14 @@ def _eval_path_cond(
     scope = scope if scope is not None else Depth0()
     shape = _cond_shape(ctx, scope)
     err = _keys_errors(ctx, pc.keys_error_states, scope, prefix)
+    # a bare {{ request.object... }} chain with NO || default raises
+    # VariableNotFoundError when the path is absent (forked go-jmespath
+    # behavior pinned by the reference corpus) -> rule ERROR. A null
+    # VALUE is a present row (T_NULL) and does not error.
+    if (pc.default is None and not pc.is_projection
+            and len(pc.states) == 1 and pc.states[0].mode == "value"):
+        exists = scope.any(ctx.rows_at(prefix + pc.states[0].segs))
+        err = err | ~exists
     if op in _IN_MODES:
         mode = _IN_MODES[op]
         literals = value if isinstance(value, list) else [value]
@@ -922,11 +940,18 @@ def _eval_path_cond(
         em = ctx.rows_at(prefix + st.segs + (ARRAY_SEG,))
         e_any_in = scope.any(em & in_set)
         e_any_not = scope.any(em & ~in_set)
+        # deprecated In/NotIn list-key strictness (in.go:35-43): any
+        # non-string element makes the whole condition false
+        e_nonstr = scope.any(em & ~ctx.type_is(T_STR))
         res = {
             "any_in": jnp.where(is_arr, e_any_in, is_scalar & hit),
             "all_in": jnp.where(is_arr, ~e_any_not, is_scalar & hit),
             "any_not_in": jnp.where(is_arr, e_any_not, is_scalar & ~hit),
             "all_not_in": jnp.where(is_arr, ~e_any_in, is_scalar & ~hit),
+            "in_strict": jnp.where(is_arr, ~e_any_not & ~e_nonstr,
+                                   is_scalar & hit),
+            "notin_strict": jnp.where(is_arr, e_any_not & ~e_nonstr,
+                                      is_scalar & ~hit),
         }[mode]
         if pc.default is not None:
             falsy = _scalar_falsy(ctx, mask, scope)

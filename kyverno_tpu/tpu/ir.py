@@ -482,11 +482,15 @@ def _mentions_element(ast: Any) -> bool:
         return any(_mentions_element(x) for x in ast)
     return False
 
-# deprecated In/NotIn have strict invalid-type semantics dependent on
-# runtime key element types (in.go:35-43) -> host only
+# deprecated In/NotIn lower for scalar-chain keys with literal LIST
+# values: scalar keys behave like AnyIn/AnyNotIn, list keys evaluate
+# strict all-in with non-string elements forcing false (in.go:35-43,
+# modeled by the evaluator's in_strict/notin_strict modes). String-
+# encoded values (wildcard / JSON forms) and projection keys keep
+# their richer host semantics.
 _SUPPORTED_OPS = {
     "equals", "equal", "notequals", "notequal",
-    "anyin", "allin", "anynotin", "allnotin",
+    "anyin", "allin", "anynotin", "allnotin", "in", "notin",
     "greaterthan", "greaterthanorequals", "lessthan", "lessthanorequals",
 }
 
@@ -568,6 +572,16 @@ class ConditionCompiler:
                     raise Unsupported("possible semver comparison value")
         if isinstance(value, ElementCollect):
             raise Unsupported("element value with non-literal key")
+        if op in ("in", "notin"):
+            if not isinstance(value, list):
+                # string values carry wildcard/JSON-decode semantics
+                raise Unsupported("deprecated In/NotIn with non-list value")
+            if not all(isinstance(v, str) for v in value):
+                # list keys invalidType on non-string VALUE elements
+                # (in.go) while device literals sprint-coerce — host
+                raise Unsupported("deprecated In/NotIn with non-string values")
+            if getattr(key_ir, "is_projection", False):
+                raise Unsupported("deprecated In/NotIn with projection key")
         return CondIR(key_ir, op, value)
 
     def _compile_literal_key_condition(self, cond: Dict[str, Any], op: str,

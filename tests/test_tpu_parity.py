@@ -474,3 +474,101 @@ def test_literal_key_condition_constant_folds():
          "spec": {"bad": False}},
     ])
     assert res.verdicts[0, 0] == 2 and res.verdicts[0, 1] == 0
+
+
+def test_deprecated_in_notin_device_parity():
+    """Deprecated In/NotIn lower to device for scalar-chain keys with
+    list values; verdicts must match the scalar engine exactly,
+    including the strict list-key semantics (in.go:35-43: non-string
+    elements force false for both directions)."""
+    from kyverno_tpu.api.policy import ClusterPolicy
+    from kyverno_tpu.engine.engine import Engine
+    from kyverno_tpu.tpu.engine import TpuEngine, build_scan_context
+
+    def policy(op, value):
+        return ClusterPolicy.from_dict({
+            "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+            "metadata": {"name": "p"},
+            "spec": {"rules": [{
+                "name": "r",
+                "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+                "validate": {"message": "m", "deny": {"conditions": {"any": [
+                    {"key": "{{ request.object.spec.val }}",
+                     "operator": op, "value": value}]}}},
+            }]}})
+
+    pods = [
+        {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "scalar-hit"},
+         "spec": {"val": "a"}},
+        {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "scalar-miss"},
+         "spec": {"val": "z"}},
+        {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "num-key"},
+         "spec": {"val": 2}},
+        {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "missing"},
+         "spec": {}},
+        {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "map-key"},
+         "spec": {"val": {"m": 1}}},
+        {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "list-all-in"},
+         "spec": {"val": ["a", "b"]}},
+        {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "list-partial"},
+         "spec": {"val": ["a", "z"]}},
+        {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "list-nonstr"},
+         "spec": {"val": ["a", 2]}},
+        {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "list-empty"},
+         "spec": {"val": []}},
+    ]
+    scalar = Engine()
+    code = {"pass": 0, "skip": 1, "fail": 2, "error": 4}
+    for op in ("In", "NotIn"):
+        for value in (["a", "b", "2"], ["z"]):
+            p = policy(op, value)
+            eng = TpuEngine([p])
+            assert eng.coverage() == (1, 1), eng.cps.rules[0].fallback_reason
+            res = eng.scan(pods)
+            for ci, pod in enumerate(pods):
+                resp = scalar.validate(build_scan_context(p, pod, {}))
+                want = code[resp.policy_response.rules[0].status]
+                got = int(res.verdicts[0, ci])
+                assert got == want, (op, value, pod["metadata"]["name"], got, want)
+
+
+def test_deprecated_in_operation_key_and_nonstring_values():
+    """Regressions: {{request.operation}} In [...] must not invert on
+    device; non-string literal values force host fallback (in.go
+    invalidType vs device sprint-coercion)."""
+    from kyverno_tpu.api.policy import ClusterPolicy
+    from kyverno_tpu.tpu.engine import TpuEngine
+
+    op_pol = ClusterPolicy.from_dict({
+        "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+        "metadata": {"name": "p"},
+        "spec": {"rules": [{
+            "name": "r",
+            "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+            "preconditions": {"all": [{
+                "key": "{{ request.operation }}", "operator": "In",
+                "value": ["CREATE", "UPDATE"]}]},
+            "validate": {"message": "m",
+                         "pattern": {"metadata": {"name": "allowed"}}},
+        }]}})
+    eng = TpuEngine([op_pol])
+    assert eng.coverage() == (1, 1), eng.cps.rules[0].fallback_reason
+    pod = {"apiVersion": "v1", "kind": "Pod",
+           "metadata": {"name": "other"}, "spec": {}}
+    res = eng.scan([pod], operations=["CREATE"])
+    assert int(res.verdicts[0, 0]) == 2  # precondition held -> pattern FAIL
+    res = eng.scan([pod], operations=["DELETE"])
+    assert int(res.verdicts[0, 0]) == 1  # precondition false -> SKIP
+
+    mixed = ClusterPolicy.from_dict({
+        "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+        "metadata": {"name": "p2"},
+        "spec": {"rules": [{
+            "name": "r",
+            "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+            "validate": {"message": "m", "deny": {"conditions": {"any": [{
+                "key": "{{ request.object.spec.val }}", "operator": "In",
+                "value": ["a", 2]}]}}},
+        }]}})
+    eng = TpuEngine([mixed])
+    assert eng.coverage() == (0, 1)  # non-string values stay host
