@@ -196,6 +196,10 @@ class ScenarioRunner:
 
         self.mutate_existing = MutateExistingController(self.snapshot,
                                                         self.policies)
+        from ..vap import VapGenerateController
+
+        self.vap_generator = VapGenerateController(self.snapshot)
+        self._parsed_policies: Dict[str, ClusterPolicy] = {}
         self._virtual_now = None  # monotone controller clock (op_assert)
         self.log: List[str] = []
 
@@ -335,6 +339,17 @@ class ScenarioRunner:
         if errors:
             raise StepError(f"exception rejected: {errors[0]}")
         self.exceptions.append(doc)
+        # an exception arriving AFTER a policy retracts its VAP pair
+        # (controller.go: exceptions suppress generation)
+        self.vap_generator.exceptions = list(self.exceptions)
+        for parsed in self._parsed_policies.values():
+            self.vap_generator.reconcile(parsed)
+            stored = self.policy_docs.get(("ClusterPolicy", parsed.name))
+            if stored is not None:
+                generated, _ = self.vap_generator.status.get(
+                    parsed.name, (False, ""))
+                stored["status"]["validatingadmissionpolicy"] = {
+                    "generated": generated}
 
     def _install_policy(self, doc: Dict[str, Any]) -> None:
         parsed = ClusterPolicy.from_dict(doc)
@@ -346,6 +361,18 @@ class ScenarioRunner:
         stored = dict(doc)
         stored["status"] = dict(READY_STATUS)
         meta = doc.get("metadata") or {}
+        # Kyverno->VAP generation reconciles on ClusterPolicy events
+        # only (the reference controller watches ClusterPolicies); the
+        # policy status records the outcome
+        # (controller.go updateClusterPolicyStatus)
+        if doc.get("kind") == "ClusterPolicy":
+            self._parsed_policies[policy.name] = parsed
+            self.vap_generator.exceptions = list(self.exceptions)
+            self.vap_generator.reconcile(parsed)
+            generated, _msg = self.vap_generator.status.get(policy.name,
+                                                            (False, ""))
+            stored["status"]["validatingadmissionpolicy"] = {
+                "generated": generated}
         self.policy_docs[(doc.get("kind", ""), meta.get("name", ""))] = stored
         # replay existing triggers for THIS policy only: generate rules
         # reconcile in background; mutate-existing replays at install
@@ -364,6 +391,9 @@ class ScenarioRunner:
         if kind in POLICY_KINDS:
             self.policies.pop(name, None)
             self.policy_docs.pop((kind, name), None)
+            if kind == "ClusterPolicy":
+                self._parsed_policies.pop(name, None)
+                self.vap_generator.on_policy_deleted(name)
             return
         if kind in CLEANUP_KINDS:
             self.cleanup.unset_policy(name)

@@ -10,6 +10,7 @@ error strings; empty means valid. Warnings are returned separately.
 
 from __future__ import annotations
 
+import json
 import re
 from typing import Any, Dict, List, Set, Tuple
 
@@ -79,7 +80,48 @@ def _check_match_block(rule: Dict[str, Any]) -> List[str]:
             "kinds", "name", "names", "namespaces", "annotations",
             "selector", "namespaceSelector", "operations")) for b in blocks):
         errs.append(f"rule {rule.get('name')!r}: match block cannot be empty")
+    # subject kinds (user_info_types.go:38 ValidateSubjects) — match
+    # and exclude both carry UserInfo, at top level and per any/all
+    exclude = rule.get("exclude") or {}
+    for b in user_blocks + [exclude] + list(exclude.get("any") or []) \
+            + list(exclude.get("all") or []):
+        for subject in b.get("subjects") or []:
+            kind = subject.get("kind", "")
+            if kind not in ("User", "Group", "ServiceAccount"):
+                errs.append(f"rule {rule.get('name')!r}: subject kind must be "
+                            f"'User', 'Group', or 'ServiceAccount', got {kind!r}")
+            elif kind == "ServiceAccount" and not subject.get("namespace"):
+                errs.append(f"rule {rule.get('name')!r}: namespace is required "
+                            f"when subject kind is ServiceAccount")
     return errs
+
+
+# bare kinds that only exist as subresources (discovery would report
+# them with a parent resource; validate.go:1462 rejects them for
+# background scans — there is no parent object to scan)
+_SUBRESOURCE_ONLY_KINDS = frozenset({
+    "Scale", "Eviction", "PodExecOptions", "PodAttachOptions",
+    "PodPortForwardOptions", "PodProxyOptions", "NodeProxyOptions",
+    "ServiceProxyOptions", "TokenRequest", "Binding",
+    "LocalSubjectAccessReview",
+})
+
+
+def _check_background_subresources(rule: Dict[str, Any],
+                                   errs: List[str]) -> None:
+    """validate.go:1447 checkForScanSubresource: background scans
+    cannot target subresources."""
+    from ..utils.kube import parse_kind_selector
+
+    match = rule.get("match") or {}
+    blocks = ([match.get("resources") or {}]
+              + [rf.get("resources") or {} for rf in match.get("any") or []]
+              + [rf.get("resources") or {} for rf in match.get("all") or []])
+    for b in blocks:
+        for k in b.get("kinds") or []:
+            _, _, kind, subresource = parse_kind_selector(str(k))
+            if subresource or kind in _SUBRESOURCE_ONLY_KINDS:
+                errs.append(f"background scan enabled with subresource {k}")
 
 
 def _check_pattern_anchors(pattern: Any, path: str, errs: List[str]) -> None:
@@ -312,6 +354,22 @@ def validate_policy(policy: ClusterPolicy,
         errors.append("policy has no rules")
     seen: Set[str] = set()
     background = spec.get("background", True)
+    admission = spec.get("admission", True)
+    # spec-level gates (pkg/validation/policy/validate.go:211-218,
+    # api/kyverno/v1/spec_types.go:339)
+    if not admission and not background:
+        errors.append("disabling both admission and background processing "
+                      "is not allowed")
+    if not admission and any(
+            r.get("mutate") or r.get("generate") or r.get("verifyImages")
+            for r in rules):
+        errors.append("disabling admission processing is only allowed with "
+                      "validation policies")
+    timeout = spec.get("webhookTimeoutSeconds")
+    if timeout is not None and not (isinstance(timeout, int)
+                                    and not isinstance(timeout, bool)
+                                    and 1 <= timeout <= 30):
+        errors.append("the timeout value must be between 1 and 30 seconds")
     for rule in rules:
         name = rule.get("name") or ""
         if not name:
@@ -327,6 +385,16 @@ def validate_policy(policy: ClusterPolicy,
                 f"rule {name!r} must define exactly one of validate/mutate/"
                 f"generate/verifyImages, found {types or 'none'}")
         errors.extend(_check_match_block(rule))
+        if background:
+            _check_background_subresources(rule, errors)
+        # rule-level context entries and preconditions run before any
+        # target binds, so {{target.*}} references there can never
+        # resolve (validate.go:46 allowed-variable split for targets)
+        rule_scope = {"context": rule.get("context") or [],
+                      "preconditions": rule.get("preconditions")}
+        if "{{target." in json.dumps(rule_scope, default=str).replace(" ", ""):
+            errors.append(f"rule {name!r}: target.* variables are only "
+                          f"allowed inside mutate.targets")
         _check_context_entries(rule, errors)
         _check_json_patch(rule, errors)
         _check_mutate_existing(spec, rule, errors)

@@ -209,6 +209,8 @@ def build_vap(policy: ClusterPolicy) -> Dict[str, Any]:
     spec: Dict[str, Any] = {
         "matchConstraints": match,
         "validations": cel.get("expressions") or [],
+        # apiserver-defaulted field the conformance asserts observe
+        "failurePolicy": policy.spec.failure_policy or "Fail",
     }
     if cel.get("paramKind") is not None:
         spec["paramKind"] = cel["paramKind"]
@@ -290,6 +292,10 @@ class VapGenerateController:
 
     def reconcile(self, policy: ClusterPolicy) -> None:
         if not any(r.has_validate() for r in policy.get_rules()):
+            # a policy UPDATED away from validate rules must retract
+            # its previously generated pair, not keep stale state
+            self._delete_pair(policy.name)
+            self.status[policy.name] = (False, "no validate rules")
             return
         ok, msg = can_generate_vap(policy)
         if ok and self._has_exception(policy):

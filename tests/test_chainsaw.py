@@ -2,7 +2,7 @@
 reference's e2e scenarios run against the in-memory control plane via
 the scenario runner (cli/chainsaw.py). The pinned list spans
 validate / mutate (incl. mutate-existing) / generate / exceptions /
-cleanup / ttl — 39 scenarios, all required green."""
+cleanup / ttl — 85 scenarios, all required green."""
 
 import os
 
@@ -52,6 +52,52 @@ SCENARIOS = [
     "generate/clusterpolicy/standard/data/sync/cpol-data-sync-create",
     "generate/clusterpolicy/standard/data/sync/cpol-data-sync-modify-rule",
     "generate/clusterpolicy/standard/data/sync/cpol-data-sync-orphan-downstream-delete-policy",
+    "generate-validating-admission-policy/clusterpolicy/standard/generate/cpol-all-match-resource",
+    "generate-validating-admission-policy/clusterpolicy/standard/generate/cpol-any-match-multiple-resources",
+    "generate-validating-admission-policy/clusterpolicy/standard/generate/cpol-any-match-resource",
+    "generate-validating-admission-policy/clusterpolicy/standard/skip-generate/cpol-any-match-resources-with-different-namespace-selectors",
+    "generate-validating-admission-policy/clusterpolicy/standard/skip-generate/cpol-any-match-resources-with-different-object-selectors",
+    "generate-validating-admission-policy/clusterpolicy/standard/skip-generate/cpol-exclude",
+    "generate-validating-admission-policy/clusterpolicy/standard/skip-generate/cpol-exclude-namespace",
+    "generate-validating-admission-policy/clusterpolicy/standard/skip-generate/cpol-match-resource-created-by-user",
+    "generate-validating-admission-policy/clusterpolicy/standard/skip-generate/cpol-match-resource-in-specific-namespace",
+    "generate-validating-admission-policy/clusterpolicy/standard/skip-generate/cpol-match-resource-using-annotations",
+    "generate-validating-admission-policy/clusterpolicy/standard/skip-generate/cpol-multiple-all-match-resources",
+    "generate-validating-admission-policy/clusterpolicy/standard/skip-generate/cpol-multiple-rules",
+    "generate-validating-admission-policy/clusterpolicy/standard/skip-generate/cpol-multiple-validation-failure-action-overrides",
+    "generate-validating-admission-policy/clusterpolicy/standard/skip-generate/cpol-non-cel-rule",
+    "generate-validating-admission-policy/clusterpolicy/standard/skip-generate/cpol-validation-failure-action-overrides-with-namespace",
+    "policy-validation/cluster-policy/admission-disabled",
+    "policy-validation/cluster-policy/all-disabled",
+    "policy-validation/cluster-policy/background-subresource",
+    "policy-validation/cluster-policy/background-variables-update",
+    "policy-validation/cluster-policy/invalid-subject-kind",
+    "policy-validation/cluster-policy/invalid-timeout",
+    "policy-validation/cluster-policy/policy-exceptions-disabled",
+    "policy-validation/cluster-policy/schema-validation-crd",
+    "policy-validation/cluster-policy/success",
+    "policy-validation/cluster-policy/target-context",
+    "policy-validation/policy/admission-disabled",
+    "policy-validation/policy/all-disabled",
+    "policy-validation/policy/background-subresource",
+    "policy-validation/policy/invalid-timeout",
+    "filter/exclude/sa/no-wildcard",
+    "filter/exclude/sa/wildcard",
+    "filter/exclude/user/no-wildcard/block",
+    "filter/exclude/user/no-wildcard/pass",
+    "filter/exclude/user/wildcard/block",
+    "filter/exclude/user/wildcard/pass",
+    "filter/match/sa/no-wildcard",
+    "filter/match/sa/wildcard",
+    "filter/match/user/no-wildcard/block",
+    "filter/match/user/no-wildcard/pass",
+    "filter/match/user/wildcard/block",
+    "filter/match/user/wildcard/pass",
+    "deferred/dependencies",
+    "deferred/foreach",
+    "deferred/recursive",
+    "deferred/two-rules",
+    "events/clusterpolicy/no-events-upon-skip-generation",
 ]
 
 pytestmark = pytest.mark.skipif(
@@ -66,6 +112,7 @@ def test_chainsaw_scenario(scenario):
 
 def test_pinned_breadth():
     areas = {s.split("/")[0] for s in SCENARIOS}
-    assert {"validate", "mutate", "generate", "exceptions",
-            "cleanup", "ttl"} <= areas
-    assert len(SCENARIOS) >= 30
+    assert {"validate", "mutate", "generate", "exceptions", "cleanup",
+            "ttl", "policy-validation", "filter", "deferred",
+            "generate-validating-admission-policy"} <= areas
+    assert len(SCENARIOS) >= 80
