@@ -341,6 +341,9 @@ class ScenarioRunner:
         self.exceptions.append(doc)
         # an exception arriving AFTER a policy retracts its VAP pair
         # (controller.go: exceptions suppress generation)
+        self._reconcile_vaps()
+
+    def _reconcile_vaps(self) -> None:
         self.vap_generator.exceptions = list(self.exceptions)
         for parsed in self._parsed_policies.values():
             self.vap_generator.reconcile(parsed)
@@ -403,6 +406,9 @@ class ScenarioRunner:
             self.exceptions = [
                 e for e in self.exceptions
                 if (e.get("metadata") or {}).get("name") != name]
+            # a removed exception un-suppresses VAP generation
+            # (controller.go deleteException -> reconcile)
+            self._reconcile_vaps()
             return
         obj = self._find(kind, namespace, name)
         if obj is None:

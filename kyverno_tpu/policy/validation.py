@@ -87,6 +87,9 @@ def _check_match_block(rule: Dict[str, Any]) -> List[str]:
             + list(exclude.get("all") or []):
         for subject in b.get("subjects") or []:
             kind = subject.get("kind", "")
+            if not subject.get("name"):
+                errs.append(f"rule {rule.get('name')!r}: subject name is "
+                            f"required")
             if kind not in ("User", "Group", "ServiceAccount"):
                 errs.append(f"rule {rule.get('name')!r}: subject kind must be "
                             f"'User', 'Group', or 'ServiceAccount', got {kind!r}")
@@ -385,7 +388,9 @@ def validate_policy(policy: ClusterPolicy,
                 f"rule {name!r} must define exactly one of validate/mutate/"
                 f"generate/verifyImages, found {types or 'none'}")
         errors.extend(_check_match_block(rule))
-        if background:
+        # validate.go:1459: subresource kinds only invalid for VALIDATE
+        # rules under background scanning
+        if background and rule.get("validate") is not None:
             _check_background_subresources(rule, errors)
         # rule-level context entries and preconditions run before any
         # target binds, so {{target.*}} references there can never

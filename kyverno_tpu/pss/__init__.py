@@ -3,11 +3,15 @@
 Native implementation of the PSS controls the reference gets from
 k8s.io/pod-security-admission (wrapped in pkg/pss/evaluate.go):
 ``level: baseline|restricted`` (+ ``version``), with Kyverno
-``exclude`` entries (controlName + optional images globs) suppressing
-individual control failures.
+``exclude`` entries suppressing individual control failures.
 
-Controls implemented mirror the upstream check registry; each returns
-the list of violating (control, detail) pairs for a pod spec.
+Each violation records WHERE it came from — the canonical
+restrictedField path for its container section and the offending
+values — because exclusions are field-scoped: an entry with
+``restrictedField``/``values`` only exempts violations at that exact
+field whose offending values are all covered by the listed values
+(pkg/pss/evaluate.go ExemptProfile); ``images`` globs further scope
+container-level exclusions to matching images.
 """
 
 from __future__ import annotations
@@ -17,7 +21,9 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 from ..engine.response import RULE_TYPE_VALIDATION, RuleResponse
 from ..utils import wildcard
 
-Violation = Tuple[str, str, str]  # (control, detail, violating image; "" = pod-level)
+# (control, detail, violating image ("" = pod-level),
+#  restrictedField path, offending values)
+Violation = Tuple[str, str, str, str, List[Any]]
 
 
 def _pod_spec(resource: Dict[str, Any]) -> Optional[Dict[str, Any]]:
@@ -32,24 +38,26 @@ def _pod_spec(resource: Dict[str, Any]) -> Optional[Dict[str, Any]]:
     return template.get("spec") if template else None
 
 
-def _all_containers(spec: Dict[str, Any]) -> List[Dict[str, Any]]:
+def _sectioned(spec: Dict[str, Any]) -> List[Tuple[str, Dict[str, Any]]]:
+    """(section, container) pairs — the section names the
+    restrictedField root (spec.containers[*] vs spec.initContainers[*]
+    vs spec.ephemeralContainers[*])."""
     out = []
     for key in ("initContainers", "containers", "ephemeralContainers"):
-        out.extend(spec.get(key) or [])
+        out.extend((key, c) for c in spec.get(key) or [])
     return out
 
 
 # --------------------------------------------------------------------------
 # baseline controls
 
-_BASELINE_DISALLOWED_CAPS = {
-    "AUDIT_CONTROL", "AUDIT_READ", "AUDIT_WRITE", "BLOCK_SUSPEND", "BPF",
-    "CHECKPOINT_RESTORE", "DAC_READ_SEARCH", "IPC_LOCK", "IPC_OWNER",
-    "LEASE", "LINUX_IMMUTABLE", "MAC_ADMIN", "MAC_OVERRIDE", "MKNOD",
-    "NET_ADMIN", "NET_BROADCAST", "NET_RAW", "PERFMON", "SYS_ADMIN",
-    "SYS_BOOT", "SYS_MODULE", "SYS_NICE", "SYS_PACCT", "SYS_PTRACE",
-    "SYS_RAWIO", "SYS_RESOURCE", "SYS_TIME", "SYS_TTY_CONFIG", "SYSLOG",
-    "WAKE_ALARM",
+# pod-security-admission capabilities_baseline.go capabilities_allowed:
+# baseline is an ALLOWLIST — adding anything beyond it (including
+# unknown capability names) is a violation
+_BASELINE_ALLOWED_CAPS = {
+    "AUDIT_WRITE", "CHOWN", "DAC_OVERRIDE", "FOWNER", "FSETID", "KILL",
+    "MKNOD", "NET_BIND_SERVICE", "SETFCAP", "SETGID", "SETPCAP",
+    "SETUID", "SYS_CHROOT",
 }
 
 _ALLOWED_VOLUME_TYPES_RESTRICTED = {
@@ -58,82 +66,113 @@ _ALLOWED_VOLUME_TYPES_RESTRICTED = {
 }
 
 
-def _check_host_namespaces(spec, containers) -> List[Violation]:
+def _check_host_namespaces(spec, sections) -> List[Violation]:
     out = []
     for fieldname in ("hostNetwork", "hostPID", "hostIPC"):
         if spec.get(fieldname):
-            out.append(("Host Namespaces", f"{fieldname} is not allowed", ""))
+            out.append(("Host Namespaces", f"{fieldname} is not allowed", "",
+                        f"spec.{fieldname}", [True]))
     return out
 
 
-def _check_privileged(spec, containers) -> List[Violation]:
+def _check_privileged(spec, sections) -> List[Violation]:
     return [
-        ("Privileged Containers", f"container {c.get('name')!r} is privileged", c.get("image", ""))
-        for c in containers
+        ("Privileged Containers", f"container {c.get('name')!r} is privileged",
+         c.get("image", ""),
+         f"spec.{sec}[*].securityContext.privileged", [True])
+        for sec, c in sections
         if (c.get("securityContext") or {}).get("privileged")
     ]
 
 
-def _check_capabilities_baseline(spec, containers) -> List[Violation]:
+def _check_capabilities_baseline(spec, sections) -> List[Violation]:
     out = []
-    for c in containers:
+    for sec, c in sections:
         caps = ((c.get("securityContext") or {}).get("capabilities") or {}).get("add") or []
-        bad = [cap for cap in caps if cap in _BASELINE_DISALLOWED_CAPS or cap == "ALL"]
+        bad = [cap for cap in caps if cap not in _BASELINE_ALLOWED_CAPS]
         if bad:
-            out.append(("Capabilities", f"container {c.get('name')!r} adds {sorted(bad)}", c.get("image", "")))
+            out.append(("Capabilities", f"container {c.get('name')!r} adds {sorted(bad)}",
+                        c.get("image", ""),
+                        f"spec.{sec}[*].securityContext.capabilities.add", bad))
     return out
 
 
-def _check_host_path(spec, containers) -> List[Violation]:
+def _check_host_path(spec, sections) -> List[Violation]:
+    # map-valued restricted fields expose the map KEYS as bad values
+    # (conformance: exclusion restrictedField spec.volumes[*].hostPath
+    # with values ["path"] exempts a {path: ...} hostPath volume)
     return [
-        ("HostPath Volumes", f"volume {v.get('name')!r} uses hostPath", "")
+        ("HostPath Volumes", f"volume {v.get('name')!r} uses hostPath", "",
+         "spec.volumes[*].hostPath", sorted((v.get("hostPath") or {}).keys()) or [""])
         for v in spec.get("volumes") or []
         if "hostPath" in v
     ]
 
 
-def _check_host_ports(spec, containers) -> List[Violation]:
+def _check_host_ports(spec, sections) -> List[Violation]:
     out = []
-    for c in containers:
+    for sec, c in sections:
         for p in c.get("ports") or []:
             if p.get("hostPort"):
-                out.append(("Host Ports", f"container {c.get('name')!r} uses hostPort {p['hostPort']}", c.get("image", "")))
+                out.append(("Host Ports",
+                            f"container {c.get('name')!r} uses hostPort {p['hostPort']}",
+                            c.get("image", ""),
+                            f"spec.{sec}[*].ports[*].hostPort", [p["hostPort"]]))
     return out
 
 
-def _check_selinux(spec, containers) -> List[Violation]:
+def _check_selinux(spec, sections) -> List[Violation]:
     allowed = {"", "container_t", "container_init_t", "container_kvm_t", "container_engine_t"}
     out = []
-    for scope in [spec] + containers:
+    for sec, scope in [("", spec)] + list(sections):
         img = scope.get("image", "") if scope is not spec else ""
+        root = (f"spec.{sec}[*].securityContext" if scope is not spec
+                else "spec.securityContext")
         opts = (scope.get("securityContext") or {}).get("seLinuxOptions") or {}
         if opts.get("type") and opts["type"] not in allowed:
-            out.append(("SELinux", f"seLinuxOptions.type {opts['type']!r} is not allowed", img))
-        if opts.get("user") or opts.get("role"):
-            out.append(("SELinux", "seLinuxOptions user/role may not be set", img))
+            out.append(("SELinux", f"seLinuxOptions.type {opts['type']!r} is not allowed",
+                        img, f"{root}.seLinuxOptions.type", [opts["type"]]))
+        for f in ("user", "role"):
+            if opts.get(f):
+                out.append(("SELinux", f"seLinuxOptions {f} may not be set",
+                            img, f"{root}.seLinuxOptions.{f}", [opts[f]]))
     return out
 
 
-def _check_proc_mount(spec, containers) -> List[Violation]:
+def _check_proc_mount(spec, sections) -> List[Violation]:
+    # "default" is accepted case-insensitively (conformance: psa/
+    # test-exclusion-procmount admits procMount: default)
     return [
-        ("/proc Mount Type", f"container {c.get('name')!r} uses procMount={sc['procMount']}", c.get("image", ""))
-        for c in containers
+        ("/proc Mount Type", f"container {c.get('name')!r} uses procMount={sc['procMount']}",
+         c.get("image", ""),
+         f"spec.{sec}[*].securityContext.procMount", [sc["procMount"]])
+        for sec, c in sections
         for sc in [c.get("securityContext") or {}]
-        if sc.get("procMount") not in (None, "Default")
+        if sc.get("procMount") is not None
+        and str(sc["procMount"]).lower() != "default"
     ]
 
 
-def _check_seccomp_baseline(spec, containers) -> List[Violation]:
+def _check_seccomp_baseline(spec, sections) -> List[Violation]:
+    # baseline (v1.19+ seccompProfile_baseline): IF set, the type must
+    # be RuntimeDefault or Localhost — unknown types are forbidden too
     out = []
-    for scope, label in [(spec, "pod")] + [(c, c.get("name")) for c in containers]:
-        img = scope.get("image", "") if scope is not spec else ""
-        prof = ((scope.get("securityContext") or {}).get("seccompProfile") or {}).get("type")
-        if prof == "Unconfined":
-            out.append(("Seccomp", f"{label}: seccompProfile.type Unconfined is not allowed", img))
+    prof = ((spec.get("securityContext") or {}).get("seccompProfile") or {}).get("type")
+    if prof is not None and prof not in ("RuntimeDefault", "Localhost"):
+        out.append(("Seccomp", f"pod: seccompProfile.type {prof!r} is not allowed",
+                    "", "spec.securityContext.seccompProfile.type", [prof]))
+    for sec, c in sections:
+        prof = ((c.get("securityContext") or {}).get("seccompProfile") or {}).get("type")
+        if prof is not None and prof not in ("RuntimeDefault", "Localhost"):
+            out.append(("Seccomp",
+                        f"{c.get('name')}: seccompProfile.type {prof!r} is not allowed",
+                        c.get("image", ""),
+                        f"spec.{sec}[*].securityContext.seccompProfile.type",
+                        [prof]))
     return out
 
 
-def _check_sysctls(spec, containers) -> List[Violation]:
+def _check_sysctls(spec, sections) -> List[Violation]:
     safe = {
         "kernel.shm_rmid_forced", "net.ipv4.ip_local_port_range",
         "net.ipv4.ip_unprivileged_port_start", "net.ipv4.tcp_syncookies",
@@ -144,17 +183,24 @@ def _check_sysctls(spec, containers) -> List[Violation]:
     out = []
     for s in (spec.get("securityContext") or {}).get("sysctls") or []:
         if s.get("name") not in safe:
-            out.append(("Sysctls", f"sysctl {s.get('name')!r} is not allowed", ""))
+            out.append(("Sysctls", f"sysctl {s.get('name')!r} is not allowed", "",
+                        "spec.securityContext.sysctls[*].name", [s.get("name")]))
     return out
 
 
-def _check_windows_host_process(spec, containers) -> List[Violation]:
+def _check_windows_host_process(spec, sections) -> List[Violation]:
     out = []
-    for scope, label in [(spec, "pod")] + [(c, c.get("name")) for c in containers]:
-        img = scope.get("image", "") if scope is not spec else ""
-        opts = ((scope.get("securityContext") or {}).get("windowsOptions") or {})
+    opts = ((spec.get("securityContext") or {}).get("windowsOptions") or {})
+    if opts.get("hostProcess"):
+        out.append(("HostProcess", "pod: hostProcess is not allowed", "",
+                    "spec.securityContext.windowsOptions.hostProcess", [True]))
+    for sec, c in sections:
+        opts = ((c.get("securityContext") or {}).get("windowsOptions") or {})
         if opts.get("hostProcess"):
-            out.append(("HostProcess", f"{label}: hostProcess is not allowed", img))
+            out.append(("HostProcess", f"{c.get('name')}: hostProcess is not allowed",
+                        c.get("image", ""),
+                        f"spec.{sec}[*].securityContext.windowsOptions.hostProcess",
+                        [True]))
     return out
 
 
@@ -162,66 +208,95 @@ def _check_windows_host_process(spec, containers) -> List[Violation]:
 # restricted controls
 
 
-def _check_volume_types(spec, containers) -> List[Violation]:
+def _check_volume_types(spec, sections) -> List[Violation]:
     out = []
     for v in spec.get("volumes") or []:
         kinds = set(v.keys()) - {"name"}
         bad = kinds - _ALLOWED_VOLUME_TYPES_RESTRICTED
-        if bad:
-            out.append(("Volume Types", f"volume {v.get('name')!r} uses {sorted(bad)}", ""))
+        for t in sorted(bad):
+            # one violation per restricted type, keyed by its field
+            # with the type's map keys as bad values (see hostPath)
+            keys = sorted(v[t].keys()) if isinstance(v[t], dict) else [v[t]]
+            out.append(("Volume Types", f"volume {v.get('name')!r} uses {t}",
+                        "", f"spec.volumes[*].{t}", keys or [""]))
     return out
 
 
-def _check_privilege_escalation(spec, containers) -> List[Violation]:
+def _check_privilege_escalation(spec, sections) -> List[Violation]:
     return [
-        ("Privilege Escalation", f"container {c.get('name')!r} must set allowPrivilegeEscalation=false", c.get("image", ""))
-        for c in containers
+        ("Privilege Escalation",
+         f"container {c.get('name')!r} must set allowPrivilegeEscalation=false",
+         c.get("image", ""),
+         f"spec.{sec}[*].securityContext.allowPrivilegeEscalation",
+         [(c.get("securityContext") or {}).get("allowPrivilegeEscalation")])
+        for sec, c in sections
         if (c.get("securityContext") or {}).get("allowPrivilegeEscalation") is not False
     ]
 
 
-def _check_run_as_non_root(spec, containers) -> List[Violation]:
+def _check_run_as_non_root(spec, sections) -> List[Violation]:
     pod_level = (spec.get("securityContext") or {}).get("runAsNonRoot")
     out = []
-    for c in containers:
+    for sec, c in sections:
         c_level = (c.get("securityContext") or {}).get("runAsNonRoot")
         effective = c_level if c_level is not None else pod_level
         if effective is not True:
-            out.append(("Running as Non-root", f"container {c.get('name')!r} must set runAsNonRoot=true", c.get("image", "")))
+            # the violating field is the one actually set (container
+            # overrides pod; neither set -> the container field)
+            if c_level is None and pod_level is not None:
+                field = "spec.securityContext.runAsNonRoot"
+            else:
+                field = f"spec.{sec}[*].securityContext.runAsNonRoot"
+            out.append(("Running as Non-root",
+                        f"container {c.get('name')!r} must set runAsNonRoot=true",
+                        c.get("image", ""), field, [effective]))
     return out
 
 
-def _check_run_as_user(spec, containers) -> List[Violation]:
+def _check_run_as_user(spec, sections) -> List[Violation]:
     out = []
     if (spec.get("securityContext") or {}).get("runAsUser") == 0:
-        out.append(("Running as Non-root user", "pod runAsUser=0 is not allowed", ""))
-    for c in containers:
+        out.append(("Running as Non-root user", "pod runAsUser=0 is not allowed",
+                    "", "spec.securityContext.runAsUser", [0]))
+    for sec, c in sections:
         if (c.get("securityContext") or {}).get("runAsUser") == 0:
-            out.append(("Running as Non-root user", f"container {c.get('name')!r} runAsUser=0", c.get("image", "")))
+            out.append(("Running as Non-root user",
+                        f"container {c.get('name')!r} runAsUser=0",
+                        c.get("image", ""),
+                        f"spec.{sec}[*].securityContext.runAsUser", [0]))
     return out
 
 
-def _check_seccomp_restricted(spec, containers) -> List[Violation]:
+def _check_seccomp_restricted(spec, sections) -> List[Violation]:
     pod_prof = ((spec.get("securityContext") or {}).get("seccompProfile") or {}).get("type")
     out = []
-    for c in containers:
+    for sec, c in sections:
         prof = ((c.get("securityContext") or {}).get("seccompProfile") or {}).get("type")
         effective = prof if prof is not None else pod_prof
         if effective not in ("RuntimeDefault", "Localhost"):
-            out.append(("Seccomp", f"container {c.get('name')!r} must set seccompProfile", c.get("image", "")))
+            if prof is None and pod_prof is not None:
+                field = "spec.securityContext.seccompProfile.type"
+            else:
+                field = f"spec.{sec}[*].securityContext.seccompProfile.type"
+            out.append(("Seccomp", f"container {c.get('name')!r} must set seccompProfile",
+                        c.get("image", ""), field, [effective]))
     return out
 
 
-def _check_capabilities_restricted(spec, containers) -> List[Violation]:
+def _check_capabilities_restricted(spec, sections) -> List[Violation]:
     out = []
-    for c in containers:
+    for sec, c in sections:
         caps = (c.get("securityContext") or {}).get("capabilities") or {}
         drops = caps.get("drop") or []
         if "ALL" not in drops:
-            out.append(("Capabilities", f"container {c.get('name')!r} must drop ALL", c.get("image", "")))
-        adds = set(caps.get("add") or []) - {"NET_BIND_SERVICE"}
+            out.append(("Capabilities", f"container {c.get('name')!r} must drop ALL",
+                        c.get("image", ""),
+                        f"spec.{sec}[*].securityContext.capabilities.drop", drops))
+        adds = sorted(set(caps.get("add") or []) - {"NET_BIND_SERVICE"})
         if adds:
-            out.append(("Capabilities", f"container {c.get('name')!r} adds {sorted(adds)}", c.get("image", "")))
+            out.append(("Capabilities", f"container {c.get('name')!r} adds {adds}",
+                        c.get("image", ""),
+                        f"spec.{sec}[*].securityContext.capabilities.add", adds))
     return out
 
 
@@ -253,27 +328,48 @@ def evaluate_pss(level: str, resource: Dict[str, Any]) -> List[Violation]:
     spec = _pod_spec(resource)
     if spec is None:
         return []
-    containers = _all_containers(spec)
+    sections = _sectioned(spec)
     checks = _RESTRICTED_CHECKS if level == "restricted" else _BASELINE_CHECKS
     out: List[Violation] = []
     for _, check in checks:
-        out.extend(check(spec, containers))
+        out.extend(check(spec, sections))
     return out
 
 
-def _excluded(violation: Violation, resource: Dict[str, Any], excludes: List[Dict[str, Any]]) -> bool:
-    """pkg/pss exemptExclusions: an exclusion with image globs exempts
-    only violations from containers whose image matches; pod-level
-    violations need an exclusion without image globs."""
-    control, _, image = violation
+def _stringify(v: Any) -> str:
+    if v is True:
+        return "true"
+    if v is False:
+        return "false"
+    if v is None:
+        return "null"
+    return str(v)
+
+
+def _excluded(violation: Violation, resource: Dict[str, Any],
+              excludes: List[Dict[str, Any]]) -> bool:
+    """pkg/pss ExemptProfile semantics: controlName must match; images
+    globs scope container-level exclusions to matching images (a
+    glob-bearing exclusion never exempts pod-level violations); a
+    restrictedField-bearing exclusion only exempts violations at that
+    exact field whose offending values are ALL covered by the listed
+    values (wildcards allowed)."""
+    control, _, image, field, values = violation
     for ex in excludes:
         if ex.get("controlName") != control:
             continue
         globs = ex.get("images") or []
-        if not globs:
-            return True
-        if image and any(wildcard.match(g, image) for g in globs):
-            return True
+        if globs and not (image and any(wildcard.match(g, image) for g in globs)):
+            continue
+        rf = ex.get("restrictedField")
+        if rf:
+            if rf != field:
+                continue
+            exvals = [str(x) for x in ex.get("values") or []]
+            if not all(any(wildcard.match(p, _stringify(v)) for p in exvals)
+                       for v in values):
+                continue
+        return True
     return False
 
 
@@ -288,7 +384,7 @@ def validate_pod_security(rule_name: str, validation, resource: Dict[str, Any],
     violations = [v for v in evaluate_pss(level, resource) if not _excluded(v, resource, excludes)]
     if not violations:
         return RuleResponse.rule_pass(rule_name, RULE_TYPE_VALIDATION, "")
-    detail = "; ".join(f"{c}: {d}" for c, d, _ in violations)
+    detail = "; ".join(f"{c}: {d}" for c, d, *_ in violations)
     return RuleResponse.rule_fail(
         rule_name, RULE_TYPE_VALIDATION, f"pod security {level!r} checks failed: {detail}"
     )

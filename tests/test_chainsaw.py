@@ -2,7 +2,7 @@
 reference's e2e scenarios run against the in-memory control plane via
 the scenario runner (cli/chainsaw.py). The pinned list spans
 validate / mutate (incl. mutate-existing) / generate / exceptions /
-cleanup / ttl — 85 scenarios, all required green."""
+cleanup / ttl — 103 scenarios, all required green."""
 
 import os
 
@@ -98,6 +98,24 @@ SCENARIOS = [
     "deferred/recursive",
     "deferred/two-rules",
     "events/clusterpolicy/no-events-upon-skip-generation",
+    "validate/policy/standard/psa/test-exclusion-capabilities",
+    "validate/policy/standard/psa/test-exclusion-host-namespaces",
+    "validate/policy/standard/psa/test-exclusion-host-ports",
+    "validate/policy/standard/psa/test-exclusion-privilege-escalation",
+    "validate/policy/standard/psa/test-exclusion-privileged-containers",
+    "validate/policy/standard/psa/test-exclusion-restricted-capabilities",
+    "validate/policy/standard/psa/test-exclusion-restricted-seccomp",
+    "validate/policy/standard/psa/test-exclusion-running-as-nonroot",
+    "validate/policy/standard/psa/test-exclusion-running-as-nonroot-user",
+    "validate/policy/standard/psa/test-exclusion-selinux",
+    "validate/policy/standard/psa/test-exclusion-sysctls",
+    "validate/policy/standard/psa/test-exclusion-procmount",
+    "validate/policy/standard/psa/test-exclusion-seccomp",
+    "validate/policy/standard/psa/test-exclusion-hostpath-volume",
+    "validate/e2e/global-anchor",
+    "validate/e2e/x509-decode",
+    "validate/clusterpolicy/cornercases/external-metrics",
+    "validate/clusterpolicy/cornercases/schema-validation-for-mutateExisting",
 ]
 
 pytestmark = pytest.mark.skipif(
@@ -115,4 +133,4 @@ def test_pinned_breadth():
     assert {"validate", "mutate", "generate", "exceptions", "cleanup",
             "ttl", "policy-validation", "filter", "deferred",
             "generate-validating-admission-policy"} <= areas
-    assert len(SCENARIOS) >= 80
+    assert len(SCENARIOS) >= 100
