@@ -590,9 +590,24 @@ def mixed_corpus_coverage(corpus_root="/root/reference/test/cli/test"):
             key = (e.fallback_reason or "?").split(":")[0][:60]
             reasons[key] = reasons.get(key, 0) + 1
     top = dict(sorted(reasons.items(), key=lambda kv: -kv[1])[:8])
+    # capability ceiling when the cluster supplies the referenced
+    # configmaps (compile-time context specialization): every configMap
+    # context resolves, so those rules lower too
+    from kyverno_tpu.engine.contextloaders import DataSources
+
+    class _AnyCM:
+        def get(self, key):
+            ns, _, name = key.partition("/")
+            return {"apiVersion": "v1", "kind": "ConfigMap",
+                    "metadata": {"name": name, "namespace": ns}, "data": {}}
+
+    dev_ctx, _ = compile_policy_set(
+        policies, data_sources=DataSources(configmaps=_AnyCM())).coverage()
     return {"policies": len(policies), "device_rules": dev,
             "total_rules": total,
             "pct": round(100.0 * dev / max(total, 1), 1),
+            "device_rules_with_cluster_context": dev_ctx,
+            "pct_with_cluster_context": round(100.0 * dev_ctx / max(total, 1), 1),
             "top_fallback_reasons": top}
 
 

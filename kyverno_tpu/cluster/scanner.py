@@ -107,14 +107,48 @@ class BackgroundScanService:
         last = self._scanned.get(uid)
         return last is None or last != (h, revision)
 
-    def _get_scanner(self, revision: int):
-        if self._scanner is None or self._scanner_rev != revision:
+    def _configmap_sources(self):
+        from ..engine.contextloaders import DataSources
+
+        snapshot = self.snapshot
+
+        class _View:
+            def get(self, key):
+                ns, _, name = key.partition("/")
+                for _, res, _ in snapshot.items():
+                    meta = res.get("metadata") or {}
+                    if (res.get("kind") == "ConfigMap"
+                            and meta.get("name") == name
+                            and (meta.get("namespace") or "") == ns):
+                        return res
+                return None
+
+        return DataSources(configmaps=_View())
+
+    def _deps_moved(self) -> bool:
+        """Did any configmap folded into the compiled programs change?
+        (compile-time context specialization invalidation). Uses the
+        snapshot's STORED hashes — no rehash, one items() pass."""
+        cps = getattr(self._scanner, "cps", None)
+        if cps is None or not cps.context_deps:
+            return False
+        current: Dict[str, str] = {}
+        for _, res, h in self.snapshot.items():
+            if res.get("kind") == "ConfigMap":
+                meta = res.get("metadata") or {}
+                current[f"{meta.get('namespace', '')}/{meta.get('name', '')}"] = h
+        return any(current.get(key) != compiled_hash
+                   for key, compiled_hash in cps.context_deps.items())
+
+    def _get_scanner(self, revision: int, recompile: bool = False):
+        if self._scanner is None or self._scanner_rev != revision or recompile:
             from ..parallel.sharding import ShardedScanner, make_mesh
 
             _, policies = self.cache.snapshot()
             mesh = self.mesh if self.mesh is not None else make_mesh()
             self._scanner = ShardedScanner(policies, mesh=mesh,
-                                           exceptions=self.exceptions)
+                                           exceptions=self.exceptions,
+                                           data_sources=self._configmap_sources())
             self._scanner_rev = revision
         return self._scanner
 
@@ -124,6 +158,12 @@ class BackgroundScanService:
         """Scan dirty (or all, when full/revision changed) resources.
         Returns the number of resources evaluated."""
         revision = self.cache.revision
+        # ONE dep-movement decision per tick: it drives both the full
+        # rescan (stale verdicts) and the recompile, so a configmap
+        # change can never recompile without also rescanning
+        deps_moved = self._deps_moved()
+        if deps_moved:
+            full = True
         # swap the dirty set FIRST: changes arriving during this scan
         # land in the fresh set and are picked up next pass (no lost
         # invalidations between items() and processing)
@@ -143,7 +183,7 @@ class BackgroundScanService:
                 self.stats["skipped_clean"] += 1
         if not todo:
             return 0
-        scanner = self._get_scanner(revision)
+        scanner = self._get_scanner(revision, recompile=deps_moved)
         ns_labels = self.snapshot.namespace_labels()
         total = 0
         for start in range(0, len(todo), self.batch_size):

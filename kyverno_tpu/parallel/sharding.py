@@ -72,8 +72,10 @@ class ShardedScanner:
         encode_cfg: Optional[EncodeConfig] = None,
         meta_cfg=None,
         exceptions: Sequence = (),
+        data_sources=None,
     ):
-        self.cps: CompiledPolicySet = compile_policy_set(policies, encode_cfg, meta_cfg)
+        self.cps: CompiledPolicySet = compile_policy_set(
+            policies, encode_cfg, meta_cfg, data_sources)
         self.exceptions = list(exceptions)
         self.mesh = mesh if mesh is not None else make_mesh()
         # resources shard over ALL mesh axes jointly: on a 1-D mesh

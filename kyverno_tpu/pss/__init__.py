@@ -362,10 +362,12 @@ def _excluded(violation: Violation, resource: Dict[str, Any],
         if globs and not (image and any(wildcard.match(g, image) for g in globs)):
             continue
         rf = ex.get("restrictedField")
-        if rf:
-            if rf != field:
-                continue
-            exvals = [str(x) for x in ex.get("values") or []]
+        if rf and rf != field:
+            continue
+        if ex.get("values") is not None:
+            # values apply even without a restrictedField: every
+            # offending value must be covered (evaluate.go:104-113)
+            exvals = [str(x) for x in ex["values"]]
             if not all(any(wildcard.match(p, _stringify(v)) for p in exvals)
                        for v in values):
                 continue

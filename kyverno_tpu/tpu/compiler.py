@@ -43,6 +43,11 @@ class CompiledPolicySet:
     key_byte_paths: Set[int]
     encode_cfg: EncodeConfig
     meta_cfg: MetaConfig
+    # compile-time context specialization: configmaps folded into the
+    # programs, "namespace/name" -> content hash at compile. A program
+    # is only valid while every dep's hash is unchanged (scanner
+    # recompiles on movement).
+    context_deps: Dict[str, Optional[str]] = field(default_factory=dict)
     _fn: Optional[Callable] = field(default=None, repr=False)
 
     @property
@@ -67,17 +72,19 @@ def compile_policy_set(
     policies: Sequence[ClusterPolicy],
     encode_cfg: Optional[EncodeConfig] = None,
     meta_cfg: Optional[MetaConfig] = None,
+    data_sources=None,
 ) -> CompiledPolicySet:
     from ..observability.tracing import global_tracer
 
     with global_tracer.span("policy_set_compile", policies=len(policies)):
-        return _compile_policy_set(policies, encode_cfg, meta_cfg)
+        return _compile_policy_set(policies, encode_cfg, meta_cfg, data_sources)
 
 
 def _compile_policy_set(
     policies: Sequence[ClusterPolicy],
     encode_cfg: Optional[EncodeConfig] = None,
     meta_cfg: Optional[MetaConfig] = None,
+    data_sources=None,
 ) -> CompiledPolicySet:
     encode_cfg = encode_cfg or EncodeConfig()
     meta_cfg = meta_cfg or MetaConfig()
@@ -85,12 +92,13 @@ def _compile_policy_set(
     programs: List[RuleProgram] = []
     byte_paths: Set[int] = set()
     key_byte_paths: Set[int] = set()
+    deps: Dict[str, Optional[str]] = {}
     for pi, policy in enumerate(policies):
         for rule in policy.get_rules():
             if not rule.has_validate():
                 continue
             try:
-                prog = compile_rule(policy, rule)
+                prog = compile_rule(policy, rule, data_sources, deps)
                 row = len(programs)
                 programs.append(prog)
                 byte_paths |= prog.byte_paths
@@ -106,4 +114,5 @@ def _compile_policy_set(
         key_byte_paths=key_byte_paths,
         encode_cfg=encode_cfg,
         meta_cfg=meta_cfg,
+        context_deps=deps,
     )

@@ -118,9 +118,10 @@ class TpuEngine:
         meta_cfg: Optional[MetaConfig] = None,
         cps: Optional[CompiledPolicySet] = None,
         exceptions: Sequence[Any] = (),
+        data_sources=None,
     ):
         self.cps: CompiledPolicySet = cps if cps is not None \
-            else compile_policy_set(policies, encode_cfg, meta_cfg)
+            else compile_policy_set(policies, encode_cfg, meta_cfg, data_sources)
         self.scalar = ScalarEngine(exceptions=list(exceptions), background=True)
         # rules named by any PolicyException evaluate on the host: the
         # exception's match/conditions are per-resource dynamic state

@@ -1010,37 +1010,67 @@ _FOLD_VAR_RE = re.compile(r"\{\{\s*([^{}]+?)\s*\}\}")
 _FOLD_ROOT_RE = re.compile(r"^([A-Za-z_][A-Za-z_0-9]*)")
 
 
-def _fold_static_context(rule: Rule) -> Optional[Rule]:
-    """Constant-fold `variable` context entries whose specs contain no
-    references: their values are compile-time constants, so every
-    {{ name... }} occurrence in the rule body substitutes away and the
-    rule lowers like a context-free one. Entries of any other kind (or
-    with references) return None — dynamic context stays host-only."""
+def _fold_static_context(rule: Rule, data_sources=None,
+                         deps: Optional[Dict[str, Optional[str]]] = None) -> Optional[Rule]:
+    """Constant-fold context entries that are compile-time constants,
+    so every {{ name... }} occurrence in the rule body substitutes
+    away and the rule lowers like a context-free one:
+
+    - `variable` entries with an explicit literal `value`;
+    - `configMap` entries with literal name/namespace, resolved
+      against ``data_sources`` (compile-time context specialization —
+      the caller records each configmap consumed in ``deps`` and must
+      recompile when its content hash moves).
+
+    Anything else (request-reading jmesPath specs, referenced
+    entries, apiCall/imageRegistry) returns None — dynamic context
+    stays host-only."""
     import json as _json
 
-    from ..engine.contextloaders import _load_variable
     from ..engine.context import Context
+    from ..engine.contextloaders import _load_configmap, _load_variable
     from ..engine.jmespath import compile as jp_compile
 
     env: Dict[str, Any] = {}
+    local_deps: Dict[str, Optional[str]] = {}
     for entry in rule.context:
         if not isinstance(entry, dict):
             return None
+        name = entry.get("name")
+        if not name:
+            return None
         spec = entry.get("variable")
-        if not isinstance(spec, dict) or not entry.get("name"):
-            return None
-        # static iff an explicit literal `value` is present: the
-        # loader then evaluates any jmesPath against THAT value. A
-        # jmesPath-only spec reads the live context (request.*) — on
-        # an empty Context it would silently collapse to its default
-        # arm and bake a WRONG constant in — so it stays dynamic.
-        if spec.get("value") is None:
-            return None
-        if "{{" in _json.dumps(spec, default=str):
-            return None  # references other context -> dynamic
-        try:
-            env[entry["name"]] = _load_variable(Context(), spec)
-        except Exception:
+        cm_spec = entry.get("configMap")
+        if isinstance(spec, dict):
+            # static iff an explicit literal `value` is present: the
+            # loader then evaluates any jmesPath against THAT value. A
+            # jmesPath-only spec reads the live context (request.*) —
+            # on an empty Context it would silently collapse to its
+            # default arm and bake a WRONG constant in — so it stays
+            # dynamic.
+            if spec.get("value") is None:
+                return None
+            if "{{" in _json.dumps(spec, default=str):
+                return None  # references other context -> dynamic
+            try:
+                env[name] = _load_variable(Context(), spec)
+            except Exception:
+                return None
+        elif isinstance(cm_spec, dict):
+            if data_sources is None or data_sources.configmaps is None:
+                return None
+            if "{{" in _json.dumps(cm_spec, default=str):
+                return None  # per-request namespace/name -> dynamic
+            try:
+                env[name] = _load_configmap(Context(), cm_spec, data_sources)
+            except Exception:
+                return None
+            from ..cluster.snapshot import resource_hash
+
+            key = (f"{cm_spec.get('namespace', '') or 'default'}/"
+                   f"{cm_spec.get('name', '')}")
+            local_deps[key] = resource_hash(env[name])
+        else:
             return None
 
     def subst(node: Any) -> Any:
@@ -1083,21 +1113,35 @@ def _fold_static_context(rule: Rule) -> Optional[Rule]:
         return out
 
     raw = subst({k: v for k, v in rule.raw.items() if k != "context"})
+    if deps is not None:
+        deps.update(local_deps)
     return Rule.from_dict(raw)
 
 
 _UNFOLDED = object()
 
 
-def compile_rule(policy: ClusterPolicy, rule: Rule) -> RuleProgram:
-    """Compile one validate rule; raises Unsupported for host-only rules."""
+def compile_rule(policy: ClusterPolicy, rule: Rule, data_sources=None,
+                 deps: Optional[Dict[str, Optional[str]]] = None) -> RuleProgram:
+    """Compile one validate rule; raises Unsupported for host-only
+    rules. Context deps only merge into ``deps`` when the WHOLE rule
+    compiles — a host-fallback rule must not register invalidation
+    hooks for configmaps no device program folds."""
+    fold_deps: Dict[str, Optional[str]] = {}
     if rule.validation is None:
         raise Unsupported("not a validate rule")
     if rule.context:
-        folded = _fold_static_context(rule)
+        folded = _fold_static_context(rule, data_sources, fold_deps)
         if folded is None or folded.validation is None:
             raise Unsupported("rule context entries")
         rule = folded
+    prog = _compile_rule_body(policy, rule)
+    if deps is not None:
+        deps.update(fold_deps)
+    return prog
+
+
+def _compile_rule_body(policy: ClusterPolicy, rule: Rule) -> RuleProgram:
     v = rule.validation
     match_ir, exclude_ir = compile_match(rule)
     cc = ConditionCompiler()

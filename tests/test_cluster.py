@@ -182,3 +182,47 @@ def test_leader_election_single_holder_and_failover():
     store.release("ctl", "replica-b")
     assert store.holder("ctl") is None
     assert a.tick() is True
+
+
+def test_configmap_context_folds_to_device_and_invalidates():
+    """Compile-time context specialization: a configMap-backed context
+    entry folds into the device program when the scanner supplies
+    snapshot-backed sources; when the configmap's content changes, the
+    scanner recompiles AND rescans everything (stale verdicts)."""
+    snap = ClusterSnapshot()
+    snap.upsert({"apiVersion": "v1", "kind": "ConfigMap",
+                 "metadata": {"name": "dict", "namespace": "default"},
+                 "data": {"forbidden": "bad-name"}})
+    policy = ClusterPolicy.from_dict({
+        "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+        "metadata": {"name": "cm-policy"},
+        "spec": {"validationFailureAction": "Enforce", "rules": [{
+            "name": "r",
+            "context": [{"name": "dict",
+                         "configMap": {"name": "dict", "namespace": "default"}}],
+            "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+            "validate": {"message": "m", "deny": {"conditions": {"any": [{
+                "key": "{{ request.object.metadata.name }}",
+                "operator": "Equals",
+                "value": "{{ dict.data.forbidden }}"}]}}},
+        }]}})
+    cache = PolicyCache()
+    cache.set(policy)
+    svc = BackgroundScanService(snap, cache, mesh=make_mesh())
+    scanner = svc._get_scanner(cache.revision)
+    # every (autogen-expanded) rule lowered to device with a recorded dep
+    dev, total = scanner.cps.coverage()
+    assert dev == total and dev >= 1, scanner.cps.rules[0].fallback_reason
+    assert set(scanner.cps.context_deps) == {"default/dict"}
+    snap.upsert(pod("bad-name", False))
+    snap.upsert(pod("fine", False))
+    svc.scan_once()
+    assert svc.aggregator.summary()["fail"] == 1
+    # change the configmap: programs recompile, verdicts flip
+    snap.upsert({"apiVersion": "v1", "kind": "ConfigMap",
+                 "metadata": {"name": "dict", "namespace": "default"},
+                 "data": {"forbidden": "fine"}})
+    assert svc.scan_once() >= 2  # full rescan, not just the dirty cm
+    assert svc.aggregator.summary()["fail"] == 1
+    res = [r for _, r, _ in snap.items() if r.get("kind") == "Pod"]
+    assert len(res) == 2

@@ -95,3 +95,24 @@ def test_existence_anchor():
     assert match_pattern(ok, pattern) is None
     err = match_pattern(bad, pattern)
     assert err is not None and not err.skip
+
+
+def test_pss_exclusion_values_without_restricted_field():
+    """evaluate.go:104-113: exclusion `values` apply even when no
+    restrictedField is declared — uncovered offending values are NOT
+    exempted."""
+    from kyverno_tpu.pss import _excluded, evaluate_pss
+
+    pod = {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "p"},
+           "spec": {"containers": [{"name": "c", "image": "nginx",
+                                    "securityContext": {"capabilities": {
+                                        "add": ["SYS_ADMIN"]}}}]}}
+    [violation] = evaluate_pss("baseline", pod)
+    covered = [{"controlName": "Capabilities", "images": ["nginx"],
+                "values": ["SYS_ADMIN"]}]
+    uncovered = [{"controlName": "Capabilities", "images": ["nginx"],
+                  "values": ["NET_ADMIN"]}]
+    blanket = [{"controlName": "Capabilities", "images": ["nginx"]}]
+    assert _excluded(violation, pod, covered) is True
+    assert _excluded(violation, pod, uncovered) is False
+    assert _excluded(violation, pod, blanket) is True
