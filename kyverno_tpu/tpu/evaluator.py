@@ -1134,6 +1134,13 @@ def _eval_selector(ctx: Ctx, sel, kh_lane: str, vh_lane: str, n_lane: str) -> jn
     ok = jnp.ones((ctx.N,), dtype=bool)
     for k, v in sel.match_labels:
         ok = ok & _pairs_any(ctx, kh_lane, vh_lane, n_lane, k, v, "lk", "lv")
+    for k_pat, v_pat in getattr(sel, "wild_labels", ()):
+        # CheckSelector wildcard expansion: a label pair glob-matching
+        # (k_pat, v_pat) satisfies the entry. The '0'-substitution
+        # fallback pair is subsumed: the glob itself matches its own
+        # '0'-substitution, so no separate exact term is needed
+        # (wildcards.go:14 ReplaceInSelector)
+        ok = ok & _label_glob_pair_any(ctx, n_lane, k_pat, v_pat)
     for key, op, values in sel.expressions:
         if op == "In":
             hit = jnp.zeros((ctx.N,), dtype=bool)
@@ -1152,6 +1159,22 @@ def _eval_selector(ctx: Ctx, sel, kh_lane: str, vh_lane: str, n_lane: str) -> jn
         else:
             ok = jnp.zeros((ctx.N,), dtype=bool)
     return ok
+
+
+def _label_glob_pair_any(ctx: Ctx, n_lane: str, k_pat: str, v_pat: str) -> jnp.ndarray:
+    """Any live label slot whose KEY bytes glob-match k_pat AND VALUE
+    bytes glob-match v_pat (resource label byte lanes). Literal
+    patterns degrade to exact byte equality via the same NFA."""
+    kb = ctx.b["meta_labels_kb"]          # (N, L, KW) uint8
+    kb_len = ctx.b["meta_labels_kb_len"]  # (N, L)
+    vb = ctx.b["meta_labels_vb"]
+    vb_len = ctx.b["meta_labels_vb_len"]
+    n = ctx.b["meta_" + n_lane]
+    L = kb.shape[1]
+    live = jnp.arange(L, dtype=np.int32)[None, :] < n[:, None]
+    hit = (glob_match(k_pat, kb, kb_len)
+           & glob_match(v_pat, vb, vb_len) & live)
+    return hit.any(-1)
 
 
 def _hash_in_lanes(ctx: Ctx, lane: str, n_lane: str, values: List[str], tag: str) -> jnp.ndarray:

@@ -876,6 +876,10 @@ class SelectorIR:
     match_labels: List[Tuple[str, str]]
     expressions: List[Tuple[str, str, List[str]]]  # (key, op, values)
     invalid: bool  # malformed selector => constant "does not match"
+    # wildcard matchLabels entries (CheckSelector expands them against
+    # the actual labels): matched on device via the glob NFA over the
+    # label byte lanes, plus the '0'-substitution fallback pair
+    wild_labels: List[Tuple[str, str]] = field(default_factory=list)
 
 
 @dataclass
@@ -901,28 +905,55 @@ class MatchIR:
     filters: List[FilterIR]
 
 
-def _compile_selector(sel: Optional[Dict[str, Any]]) -> Optional[SelectorIR]:
+def _compile_selector(sel: Optional[Dict[str, Any]],
+                      allow_wild: bool = False) -> Optional[SelectorIR]:
     if sel is None:
         return None
-    from ..engine.selector import SelectorError, matches_selector
+    from ..engine.selector import SelectorError, check_selector, matches_selector
 
-    ml = [(str(k), str(v)) for k, v in (sel.get("matchLabels") or {}).items()]
-    for k, v in ml:
+    ml: List[Tuple[str, str]] = []
+    wild: List[Tuple[str, str]] = []
+    for k, v in (sel.get("matchLabels") or {}).items():
+        k, v = str(k), str(v)
         if contains_wildcard(k) or contains_wildcard(v):
-            raise Unsupported("wildcard label selector")
+            if not allow_wild:
+                raise Unsupported("wildcard label selector")
+            wild.append((k, v))
+        else:
+            ml.append((k, v))
+    # CheckSelector expands wildcard entries into a DICT, where an
+    # expanded key can overwrite another entry (last write wins). The
+    # device lowers entries as an independent conjunction, which is
+    # only equivalent when no collision can occur: at most one
+    # wildcard entry, whose key pattern cannot match any literal key.
+    if wild:
+        from ..utils.wildcard import match as _wmatch
+
+        if len(wild) > 1:
+            raise Unsupported("multiple wildcard matchLabels entries")
+        if any(_wmatch(wild[0][0], lit_k) for lit_k, _ in ml):
+            raise Unsupported("wildcard matchLabels key may collide with "
+                              "a literal entry")
     exprs: List[Tuple[str, str, List[str]]] = []
     for e in sel.get("matchExpressions") or []:
         exprs.append((str(e.get("key")), str(e.get("operator")), [str(v) for v in (e.get("values") or [])]))
     # malformed selectors become a constant no-match (scalar engine adds
-    # a "failed to parse selector" reason)
+    # a "failed to parse selector" reason); probe through the wildcard-
+    # expanding entry point so wildcard chars themselves don't trip the
+    # label-syntax validation
     try:
-        matches_selector(sel, {})
+        check_selector(sel, {})
         invalid = False
     except SelectorError:
+        if wild:
+            # validity is resource-dependent for wildcard selectors
+            # (the '0'-substitution probe fails, but a glob-matching
+            # label would substitute to a VALID actual key) — host
+            raise Unsupported("wildcard selector with invalid substitution")
         invalid = True
     except Exception:
         raise Unsupported("selector evaluation error")
-    return SelectorIR(ml, exprs, invalid)
+    return SelectorIR(ml, exprs, invalid, wild_labels=wild)
 
 
 def _compile_filter(rf: ResourceFilter) -> FilterIR:
@@ -952,7 +983,7 @@ def _compile_filter(rf: ResourceFilter) -> FilterIR:
         names=list(rd.names),
         namespaces=list(rd.namespaces),
         annotations=[(str(k), str(v)) for k, v in (rd.annotations or {}).items()],
-        selector=_compile_selector(rd.selector),
+        selector=_compile_selector(rd.selector, allow_wild=True),
         ns_selector=_compile_selector(rd.namespace_selector),
         operations=list(rd.operations),
         roles=list(ui.roles),

@@ -34,11 +34,17 @@ class MetaConfig:
         max_labels: int = MAX_LABELS,
         max_groups: int = MAX_GROUPS,
         max_roles: int = MAX_ROLES,
+        label_key_bytes: int = 320,  # max valid key: 253 prefix + / + 63
+        label_value_bytes: int = 64,
     ):
         self.name_bytes = name_bytes
         self.max_labels = max_labels
         self.max_groups = max_groups
         self.max_roles = max_roles
+        # byte lanes for wildcard matchLabels (glob NFA operands);
+        # lane pruning drops them when no selector needs globs
+        self.label_key_bytes = label_key_bytes
+        self.label_value_bytes = label_value_bytes
 
 
 def _h2(s: str, tag: str) -> tuple:
@@ -62,6 +68,12 @@ class MetaBatch:
         self.labels_kh = u32(cfg.max_labels, 2)
         self.labels_vh = u32(cfg.max_labels, 2)
         self.labels_n = np.zeros((n,), dtype=np.int32)
+        self.labels_kb = np.zeros((n, cfg.max_labels, cfg.label_key_bytes),
+                                  dtype=np.uint8)
+        self.labels_kb_len = np.zeros((n, cfg.max_labels), dtype=np.int32)
+        self.labels_vb = np.zeros((n, cfg.max_labels, cfg.label_value_bytes),
+                                  dtype=np.uint8)
+        self.labels_vb_len = np.zeros((n, cfg.max_labels), dtype=np.int32)
         self.ann_kh = u32(cfg.max_labels, 2)
         self.ann_vh = u32(cfg.max_labels, 2)
         self.ann_n = np.zeros((n,), dtype=np.int32)
@@ -156,8 +168,23 @@ def encode_metadata(
             ok &= _put_bytes(b.ns_bytes, b.ns_len, i, ns)
         b.ns_h[i] = _h2(ns, "N")
         if w_labels:
+            labels = kube.get_labels(res)
             ok &= _put_pairs(b.labels_kh, b.labels_vh, b.labels_n, i,
-                             kube.get_labels(res), "lk", "lv")
+                             labels, "lk", "lv")
+        if want("labels_kb", "labels_vb") and w_labels:
+            for j, (lk, lv) in enumerate((labels or {}).items()):
+                if j >= cfg.max_labels:
+                    break
+                kd = str(lk).encode("utf-8")
+                vd = str(lv).encode("utf-8")
+                if (len(kd) > cfg.label_key_bytes
+                        or len(vd) > cfg.label_value_bytes):
+                    ok = False
+                    continue
+                b.labels_kb[i, j, : len(kd)] = np.frombuffer(kd, dtype=np.uint8)
+                b.labels_kb_len[i, j] = len(kd)
+                b.labels_vb[i, j, : len(vd)] = np.frombuffer(vd, dtype=np.uint8)
+                b.labels_vb_len[i, j] = len(vd)
         if w_ann:
             ok &= _put_pairs(b.ann_kh, b.ann_vh, b.ann_n, i,
                              kube.get_annotations(res), "ak", "av")

@@ -572,3 +572,81 @@ def test_deprecated_in_operation_key_and_nonstring_values():
         }]}})
     eng = TpuEngine([mixed])
     assert eng.coverage() == (0, 1)  # non-string values stay host
+
+
+def test_wildcard_label_selector_device_parity():
+    """matchLabels with glob keys/values lower to device via the label
+    byte lanes; verdicts match the scalar engine, including the
+    '0'-substitution fallback when nothing glob-matches."""
+    from kyverno_tpu.api.policy import ClusterPolicy
+    from kyverno_tpu.engine.engine import Engine
+    from kyverno_tpu.tpu.engine import TpuEngine, build_scan_context
+
+    policy = ClusterPolicy.from_dict({
+        "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+        "metadata": {"name": "wild"},
+        "spec": {"rules": [{
+            "name": "r",
+            "match": {"any": [{"resources": {
+                "kinds": ["Pod"],
+                "selector": {"matchLabels": {"app*": "prod-?"}}}}]},
+            "validate": {"message": "m",
+                         "pattern": {"metadata": {"name": "!bad"}}},
+        }]}})
+    pods = [
+        {"apiVersion": "v1", "kind": "Pod",
+         "metadata": {"name": "bad", "labels": {"apptier": "prod-1"}},
+         "spec": {}},
+        {"apiVersion": "v1", "kind": "Pod",
+         "metadata": {"name": "ok", "labels": {"apptier": "prod-1"}},
+         "spec": {}},
+        {"apiVersion": "v1", "kind": "Pod",
+         "metadata": {"name": "bad", "labels": {"apptier": "staging"}},
+         "spec": {}},
+        {"apiVersion": "v1", "kind": "Pod",
+         "metadata": {"name": "bad", "labels": {"other": "prod-1"}},
+         "spec": {}},
+        {"apiVersion": "v1", "kind": "Pod",
+         "metadata": {"name": "bad", "labels": {"app0": "prod-0"}},
+         "spec": {}},  # the '0'-substituted exact pair
+        {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "bad"},
+         "spec": {}},
+    ]
+    eng = TpuEngine([policy])
+    assert eng.coverage() == (1, 1), eng.cps.rules[0].fallback_reason
+    res = eng.scan(pods)
+    code = {"pass": 0, "skip": 1, "fail": 2, "error": 4}
+    scalar = Engine()
+    for ci, pod in enumerate(pods):
+        resp = scalar.validate(build_scan_context(policy, pod, {}))
+        want = code[resp.policy_response.rules[0].status] \
+            if resp.policy_response.rules else 3
+        assert int(res.verdicts[0, ci]) == want, (ci, int(res.verdicts[0, ci]), want)
+
+
+def test_wildcard_selector_collision_and_invalid_substitution_stay_host():
+    """Dict-collision and resource-dependent-validity cases cannot
+    lower soundly: they must fall back to host, not silently diverge."""
+    from kyverno_tpu.api.policy import ClusterPolicy
+    from kyverno_tpu.tpu.engine import TpuEngine
+
+    def pol(match_labels):
+        return ClusterPolicy.from_dict({
+            "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+            "metadata": {"name": "p"},
+            "spec": {"rules": [{
+                "name": "r",
+                "match": {"any": [{"resources": {
+                    "kinds": ["Pod"],
+                    "selector": {"matchLabels": match_labels}}}]},
+                "validate": {"message": "m",
+                             "pattern": {"metadata": {"name": "?*"}}}}]}})
+
+    # wildcard key can expand onto the literal "app" entry -> host
+    assert TpuEngine([pol({"app": "x", "app*": "y*"})]).coverage() == (0, 1)
+    # two wildcard entries can collide with each other -> host
+    assert TpuEngine([pol({"a*": "x", "ap*": "y"})]).coverage() == (0, 1)
+    # '0'-substitution of a 64+ char glob key is invalid label syntax,
+    # but a real label could substitute validly -> host, not constant
+    long_key = "k" * 70 + "*"
+    assert TpuEngine([pol({long_key: "v"})]).coverage() == (0, 1)
