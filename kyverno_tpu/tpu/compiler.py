@@ -106,6 +106,19 @@ def _compile_policy_set(
                 entries.append(RuleEntry(pi, policy.name, rule.name, row, None))
             except Unsupported as e:
                 entries.append(RuleEntry(pi, policy.name, rule.name, None, str(e)))
+    # dense (un-pruned) encodes only pay for label byte lanes when some
+    # compiled selector actually globs. The flag lives on a COPY: the
+    # caller's MetaConfig may be shared across compiles, and a later
+    # compile must not mutate an earlier compiled set's config.
+    import copy as _copy
+
+    meta_cfg = _copy.copy(meta_cfg)
+    meta_cfg.label_bytes_enabled = any(
+        getattr(sel, "wild_labels", None)
+        for prog in programs
+        for block in (prog.match, prog.exclude) if block is not None
+        for f in block.filters
+        for sel in (f.selector, f.ns_selector) if sel is not None)
     return CompiledPolicySet(
         policies=list(policies),
         rules=entries,

@@ -929,11 +929,17 @@ def _compile_selector(sel: Optional[Dict[str, Any]],
     if wild:
         from ..utils.wildcard import match as _wmatch
 
-        if len(wild) > 1:
-            raise Unsupported("multiple wildcard matchLabels entries")
-        if any(_wmatch(wild[0][0], lit_k) for lit_k, _ in ml):
+        # value-only wildcard entries keep their literal key under
+        # expansion and can never collide; only wildcard KEYS move
+        wild_keys = [k for k, _ in wild if contains_wildcard(k)]
+        if len(wild_keys) > 1:
+            raise Unsupported("multiple wildcard matchLabels keys")
+        if wild_keys and (
+                any(_wmatch(wild_keys[0], lit_k) for lit_k, _ in ml)
+                or any(_wmatch(wild_keys[0], k) for k, _ in wild
+                       if k != wild_keys[0])):
             raise Unsupported("wildcard matchLabels key may collide with "
-                              "a literal entry")
+                              "another entry")
     exprs: List[Tuple[str, str, List[str]]] = []
     for e in sel.get("matchExpressions") or []:
         exprs.append((str(e.get("key")), str(e.get("operator")), [str(v) for v in (e.get("values") or [])]))

@@ -42,9 +42,12 @@ class MetaConfig:
         self.max_groups = max_groups
         self.max_roles = max_roles
         # byte lanes for wildcard matchLabels (glob NFA operands);
-        # lane pruning drops them when no selector needs globs
+        # lane pruning drops them when no selector needs globs, and
+        # un-pruned (dense) paths only fill them when the compiled
+        # policy set declares a wildcard selector
         self.label_key_bytes = label_key_bytes
         self.label_value_bytes = label_value_bytes
+        self.label_bytes_enabled = False
 
 
 def _h2(s: str, tag: str) -> tuple:
@@ -52,9 +55,18 @@ def _h2(s: str, tag: str) -> tuple:
 
 
 class MetaBatch:
-    def __init__(self, n: int, cfg: MetaConfig):
+    def __init__(self, n: int, cfg: MetaConfig, label_bytes: bool = False):
         self.cfg = cfg
         nb = cfg.name_bytes
+        # width-0 byte lanes when no compiled selector globs: the
+        # program never reads them, and the dense path must not ship
+        # N x 24 x 384 guaranteed zeros over H2D every scan
+        kw = cfg.label_key_bytes if label_bytes else 0
+        vw = cfg.label_value_bytes if label_bytes else 0
+        self.labels_kb = np.zeros((n, cfg.max_labels, kw), dtype=np.uint8)
+        self.labels_kb_len = np.zeros((n, cfg.max_labels), dtype=np.int32)
+        self.labels_vb = np.zeros((n, cfg.max_labels, vw), dtype=np.uint8)
+        self.labels_vb_len = np.zeros((n, cfg.max_labels), dtype=np.int32)
         u32 = lambda *shape: np.zeros((n,) + shape, dtype=np.uint32)  # noqa: E731
         self.group_h = u32(2)
         self.version_h = u32(2)
@@ -68,12 +80,6 @@ class MetaBatch:
         self.labels_kh = u32(cfg.max_labels, 2)
         self.labels_vh = u32(cfg.max_labels, 2)
         self.labels_n = np.zeros((n,), dtype=np.int32)
-        self.labels_kb = np.zeros((n, cfg.max_labels, cfg.label_key_bytes),
-                                  dtype=np.uint8)
-        self.labels_kb_len = np.zeros((n, cfg.max_labels), dtype=np.int32)
-        self.labels_vb = np.zeros((n, cfg.max_labels, cfg.label_value_bytes),
-                                  dtype=np.uint8)
-        self.labels_vb_len = np.zeros((n, cfg.max_labels), dtype=np.int32)
         self.ann_kh = u32(cfg.max_labels, 2)
         self.ann_vh = u32(cfg.max_labels, 2)
         self.ann_n = np.zeros((n,), dtype=np.int32)
@@ -136,7 +142,9 @@ def encode_metadata(
     neither verdicts nor the fallback decisions any reader observes."""
     cfg = cfg or MetaConfig()
     ns_labels = namespace_labels or {}
-    batch = MetaBatch(len(resources), cfg)
+    want_label_bytes = (("labels_kb" in need or "labels_vb" in need)
+                        if need is not None else cfg.label_bytes_enabled)
+    batch = MetaBatch(len(resources), cfg, label_bytes=want_label_bytes)
     b = batch
 
     def want(*lanes: str) -> bool:
@@ -171,12 +179,25 @@ def encode_metadata(
             labels = kube.get_labels(res)
             ok &= _put_pairs(b.labels_kh, b.labels_vh, b.labels_n, i,
                              labels, "lk", "lv")
-        if want("labels_kb", "labels_vb") and w_labels:
+        if w_labels and want_label_bytes:
+            from ..engine.selector import SelectorError, _validate_label_key, \
+                _validate_label_value
+
             for j, (lk, lv) in enumerate((labels or {}).items()):
                 if j >= cfg.max_labels:
                     break
                 kd = str(lk).encode("utf-8")
                 vd = str(lv).encode("utf-8")
+                # syntactically invalid label keys/values make the
+                # scalar engine's wildcard expansion ERROR the
+                # selector ("failed to parse selector") — such
+                # resources must resolve on host, not glob-match
+                try:
+                    _validate_label_key(str(lk))
+                    _validate_label_value(str(lv))
+                except SelectorError:
+                    ok = False
+                    continue
                 if (len(kd) > cfg.label_key_bytes
                         or len(vd) > cfg.label_value_bytes):
                     ok = False

@@ -650,3 +650,82 @@ def test_wildcard_selector_collision_and_invalid_substitution_stay_host():
     # but a real label could substitute validly -> host, not constant
     long_key = "k" * 70 + "*"
     assert TpuEngine([pol({long_key: "v"})]).coverage() == (0, 1)
+
+
+def test_wildcard_selector_invalid_label_syntax_goes_host():
+    """A resource carrying a syntactically invalid label key makes the
+    scalar engine ERROR the wildcard selector ('failed to parse
+    selector' -> not matched) — on device the resource must take the
+    HOST path, not glob-match (parity via fallback)."""
+    from kyverno_tpu.api.policy import ClusterPolicy
+    from kyverno_tpu.engine.engine import Engine
+    from kyverno_tpu.tpu.engine import TpuEngine, build_scan_context
+
+    policy = ClusterPolicy.from_dict({
+        "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+        "metadata": {"name": "wild"},
+        "spec": {"rules": [{
+            "name": "r",
+            "match": {"any": [{"resources": {
+                "kinds": ["Pod"],
+                "selector": {"matchLabels": {"app*": "x"}}}}]},
+            "validate": {"message": "m",
+                         "pattern": {"metadata": {"name": "!bad"}}},
+        }]}})
+    pods = [
+        {"apiVersion": "v1", "kind": "Pod",
+         "metadata": {"name": "bad", "labels": {"app-": "x"}}, "spec": {}},
+        {"apiVersion": "v1", "kind": "Pod",
+         "metadata": {"name": "bad", "labels": {"apptier": "x"}}, "spec": {}},
+    ]
+    eng = TpuEngine([policy])
+    assert eng.coverage() == (1, 1)
+    res = eng.scan(pods)
+    code = {"pass": 0, "skip": 1, "fail": 2, "error": 4}
+    scalar = Engine()
+    for ci, pod in enumerate(pods):
+        resp = scalar.validate(build_scan_context(policy, pod, {}))
+        want = code[resp.policy_response.rules[0].status] \
+            if resp.policy_response.rules else 3
+        assert int(res.verdicts[0, ci]) == want, (ci, int(res.verdicts[0, ci]), want)
+
+
+def test_value_only_wildcard_multi_entries_lower():
+    """Multiple value-only glob entries keep literal keys — no dict
+    collision is possible, so they lower and match scalar verdicts."""
+    from kyverno_tpu.api.policy import ClusterPolicy
+    from kyverno_tpu.engine.engine import Engine
+    from kyverno_tpu.tpu.engine import TpuEngine, build_scan_context
+
+    policy = ClusterPolicy.from_dict({
+        "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+        "metadata": {"name": "wild2"},
+        "spec": {"rules": [{
+            "name": "r",
+            "match": {"any": [{"resources": {
+                "kinds": ["Pod"],
+                "selector": {"matchLabels": {"app": "prod-*",
+                                             "tier": "web-?"}}}}]},
+            "validate": {"message": "m",
+                         "pattern": {"metadata": {"name": "!bad"}}},
+        }]}})
+    eng = TpuEngine([policy])
+    assert eng.coverage() == (1, 1), eng.cps.rules[0].fallback_reason
+    pods = [
+        {"apiVersion": "v1", "kind": "Pod",
+         "metadata": {"name": "bad",
+                      "labels": {"app": "prod-1", "tier": "web-a"}}, "spec": {}},
+        {"apiVersion": "v1", "kind": "Pod",
+         "metadata": {"name": "bad",
+                      "labels": {"app": "prod-1", "tier": "webXa"}}, "spec": {}},
+        {"apiVersion": "v1", "kind": "Pod",
+         "metadata": {"name": "bad", "labels": {"app": "prod-1"}}, "spec": {}},
+    ]
+    res = eng.scan(pods)
+    code = {"pass": 0, "skip": 1, "fail": 2, "error": 4}
+    scalar = Engine()
+    for ci, pod in enumerate(pods):
+        resp = scalar.validate(build_scan_context(policy, pod, {}))
+        want = code[resp.policy_response.rules[0].status] \
+            if resp.policy_response.rules else 3
+        assert int(res.verdicts[0, ci]) == want, (ci, int(res.verdicts[0, ci]), want)
