@@ -144,6 +144,9 @@ def encode_metadata(
     ns_labels = namespace_labels or {}
     want_label_bytes = (("labels_kb" in need or "labels_vb" in need)
                         if need is not None else cfg.label_bytes_enabled)
+    if want_label_bytes:
+        from ..engine.selector import (SelectorError, _validate_label_key,
+                                       _validate_label_value)
     batch = MetaBatch(len(resources), cfg, label_bytes=want_label_bytes)
     b = batch
 
@@ -180,21 +183,19 @@ def encode_metadata(
             ok &= _put_pairs(b.labels_kh, b.labels_vh, b.labels_n, i,
                              labels, "lk", "lv")
         if w_labels and want_label_bytes:
-            from ..engine.selector import SelectorError, _validate_label_key, \
-                _validate_label_value
-
             for j, (lk, lv) in enumerate((labels or {}).items()):
                 if j >= cfg.max_labels:
                     break
-                kd = str(lk).encode("utf-8")
-                vd = str(lv).encode("utf-8")
+                ks, vs = str(lk), str(lv)
+                kd = ks.encode("utf-8")
+                vd = vs.encode("utf-8")
                 # syntactically invalid label keys/values make the
                 # scalar engine's wildcard expansion ERROR the
                 # selector ("failed to parse selector") — such
                 # resources must resolve on host, not glob-match
                 try:
-                    _validate_label_key(str(lk))
-                    _validate_label_value(str(lv))
+                    _validate_label_key(ks)
+                    _validate_label_value(vs)
                 except SelectorError:
                     ok = False
                     continue

@@ -97,11 +97,48 @@ def _variant(leaf, key, op):
     })
 
 
+def _feature_variant(name, rule):
+    return ClusterPolicy.from_dict({
+        "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+        "metadata": {"name": name},
+        "spec": {"rules": [rule]}})
+
+
+# newer device features: deprecated In/NotIn, missing-path errors,
+# wildcard matchLabels, static-context constant folding
+_FEATURE_VARIANTS = [
+    _feature_variant("in-op", {
+        "name": "r", "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+        "validate": {"message": "m", "deny": {"conditions": {"any": [{
+            "key": "{{ request.object.metadata.namespace }}",
+            "operator": op, "value": ["default", "prod"]}]}}}})
+    for op in ("In", "NotIn", "AnyIn", "AllNotIn")
+] + [
+    _feature_variant("missing-path", {
+        "name": "r", "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+        "validate": {"message": "m", "deny": {"conditions": {"any": [{
+            "key": "{{ request.object.spec.nodeName }}",
+            "operator": "Equals", "value": "forbidden-node"}]}}}}),
+    _feature_variant("wild-selector", {
+        "name": "r", "match": {"any": [{"resources": {
+            "kinds": ["Pod"],
+            "selector": {"matchLabels": {"app*": "n?*"}}}}]},
+        "validate": {"message": "m",
+                     "pattern": {"spec": {"hostNetwork": False}}}}),
+    _feature_variant("folded-context", {
+        "name": "r",
+        "context": [{"name": "limit", "variable": {"value": 50}}],
+        "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+        "validate": {"message": "m", "deny": {"conditions": {"any": [{
+            "key": "{{ request.object.spec.priority }}",
+            "operator": "GreaterThan", "value": "{{ limit }}"}]}}}}),
+]
+
 _VARIANTS = [
     _variant(leaf, key, op)
     for leaf in _PATTERN_LEAVES
     for key, op in (("name", "image"), ("namespace", "privileged"))
-]
+] + _FEATURE_VARIANTS
 
 _ENGINE_CACHE = {}
 
