@@ -1,11 +1,12 @@
 #!/usr/bin/env python
 """Benchmarks reproducing the BASELINE.json configs on the attached
-accelerator. The default (driver) run is config #2 — background-scan
-throughput of the bundled PSS policy set over a cluster snapshot —
-printing ONE JSON line:
+accelerator. The default (driver) run measures ALL configs, emitting a
+cumulative JSON artifact line after every stage — the LAST stdout line
+is always the complete document so far (kill-resilient):
 
     {"metric": "rule_resource_evals_per_sec", "value": ..., "unit":
-     "evals/s", "vs_baseline": ...}
+     "evals/s", "vs_baseline": ..., "configs": {...},
+     "mixed_corpus_coverage": {...}}
 
 plus honest cost-split fields (encode/device/host seconds, end-to-end
 resources/s, device coverage). vs_baseline is measured / 1e6 — the
@@ -612,7 +613,8 @@ def mixed_corpus_coverage(corpus_root="/root/reference/test/cli/test"):
 
 
 # ---------------------------------------------------------------------------
-# driver entry: ONE JSON line, resilient to a flaky backend
+# driver entry: cumulative JSON lines (last line = complete artifact),
+# resilient to a flaky backend and mid-run kills
 
 
 FNS = {
@@ -662,6 +664,19 @@ def run_all():
     except Exception as e:  # noqa: BLE001
         out["error"] = f"scan: {e!r}"[:500]
     configs = {}
+    out["configs"] = configs
+    # emit the running artifact after every stage: the LAST printed
+    # line is always a complete JSON document, so a mid-run kill (or a
+    # wedged backend on one config) still leaves everything measured
+    # so far for the driver to parse. The scan headline goes out
+    # FIRST — it is the most expensive measurement and must survive a
+    # hang in any later stage.
+    emit(out)
+    try:
+        out["mixed_corpus_coverage"] = mixed_corpus_coverage()
+    except Exception as e:  # noqa: BLE001
+        out["mixed_corpus_coverage"] = {"error": repr(e)[:300]}
+    emit(out)
     for name in ("match", "overlay", "apply", "admission", "fallback"):
         if only and name not in only:
             continue
@@ -671,12 +686,7 @@ def run_all():
             configs[name]["wall_seconds"] = round(time.perf_counter() - t0, 1)
         except Exception as e:  # noqa: BLE001
             configs[name] = {"error": repr(e)[:500]}
-    out["configs"] = configs
-    try:
-        out["mixed_corpus_coverage"] = mixed_corpus_coverage()
-    except Exception as e:  # noqa: BLE001
-        out["mixed_corpus_coverage"] = {"error": repr(e)[:300]}
-    emit(out)
+        emit(out)
 
 
 def main():
