@@ -85,7 +85,9 @@ def make_snapshot(n, seed=0):
 
 
 def emit(result):
-    print(json.dumps(result))
+    # flush: the kill-resilience contract (last line = complete
+    # artifact) must hold when stdout is a block-buffered pipe
+    print(json.dumps(result), flush=True)
 
 
 # ---------------------------------------------------------------------------

@@ -39,10 +39,12 @@ def _is_kyverno_infrastructure(res: Dict[str, Any]) -> bool:
     """Only kyverno's own materialized admission plumbing is excluded
     from scans — keyed by kind AND managed-by label, so user resources
     that happen to carry a managed-by label still background-scan."""
+    from .webhookconfig import MANAGED_BY_LABEL
+
     if res.get("kind") not in _INFRA_KINDS:
         return False
     labels = (res.get("metadata") or {}).get("labels") or {}
-    return ("kyverno" in (labels.get("webhooks.kyverno.io/managed-by", ""),
+    return ("kyverno" in (labels.get(MANAGED_BY_LABEL, ""),
                           labels.get("app.kubernetes.io/managed-by", "")))
 
 

@@ -42,7 +42,7 @@ _CLUSTER_KINDS = {"Namespace", "Node", "PersistentVolume", "ClusterRole",
                   "ClusterRoleBinding", "CustomResourceDefinition"}
 
 FINE_GRAINED_ANNOTATION = "kyverno.io/custom-webhook-configuration"
-MANAGED_BY_LABEL = "webhooks.kyverno.io/managed-by"
+MANAGED_BY_LABEL = "webhook.kyverno.io/managed-by"
 
 
 def _parse_kind(kind: str) -> Tuple[str, str, str]:
