@@ -16,11 +16,19 @@ Supported subset (grown over rounds):
 - deny/preconditions: keys that are single ``{{ ... }}`` JMESPath
   templates over ``request.object`` path chains, multiselects,
   ``[]`` projections, ``keys(@)`` and ``|| literal`` defaults; also
-  ``request.operation``; operators Equals/NotEquals, the In family,
-  numeric/duration comparisons with literal values;
+  ``request.operation``; non-variable literal keys (constant-folded
+  via the scalar oracle); operators Equals/NotEquals, the In family
+  including deprecated In/NotIn (scalar-chain keys, literal string
+  list values), numeric/duration comparisons with literal values;
+  bare chains without defaults ERROR on missing paths (forked
+  go-jmespath semantics);
+- context: ``variable`` entries with literal values and ``configMap``
+  entries resolved against cluster-backed data sources constant-fold
+  at compile (context deps recorded for invalidation);
 - match/exclude: kinds (exact or ``*`` segments), names/namespaces with
-  globs, exact annotations, label/namespace selectors without
-  wildcards, operations, exact user roles/clusterRoles/subjects.
+  globs, exact annotations, label/namespace selectors (incl. wildcard
+  matchLabels via label byte lanes, with dict-collision soundness
+  guards), operations, exact user roles/clusterRoles/subjects.
 """
 
 from __future__ import annotations
