@@ -11,7 +11,7 @@ from __future__ import annotations
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, Iterable, List, Optional, Tuple
 
 RESULT_NAMES = ("pass", "fail", "warn", "error", "skip")
 
@@ -85,14 +85,29 @@ class ReportAggregator:
         self._per_resource: Dict[str, List[ReportResult]] = {}
         self._lock = threading.Lock()
 
-    def put(self, uid: str, results: List[ReportResult]) -> None:
+    def put(self, uid: str, results: List[ReportResult],
+            scope: Optional[Iterable[str]] = None) -> None:
+        """Record results for a resource. `scope` names the policies
+        this evaluation covered: rows for other policies survive, so
+        partial evaluations (failurePolicy-class webhook paths,
+        fine-grained per-policy paths) merge instead of clobbering each
+        other — the reference gets this for free because each
+        EphemeralReport carries per-policy labels and aggregation merges
+        by policy (aggregate/controller.go:307). None = full replace
+        (the scanner's full-rescan semantics)."""
         now = time.time()
         for r in results:
             r.resource_uid = uid
             if not r.timestamp:
                 r.timestamp = now
         with self._lock:
-            self._per_resource[uid] = list(results)
+            if scope is None:
+                self._per_resource[uid] = list(results)
+            else:
+                covered = set(scope)
+                kept = [r for r in self._per_resource.get(uid, [])
+                        if r.policy not in covered]
+                self._per_resource[uid] = kept + list(results)
 
     def drop(self, uid: str) -> None:
         with self._lock:

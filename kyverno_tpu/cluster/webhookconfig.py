@@ -145,7 +145,8 @@ class WebhookConfigGenerator:
                 continue
             fp = "Ignore" if (p.spec.failure_policy or "Fail") == "Ignore" else "Fail"
             if p.annotations.get(FINE_GRAINED_ANNOTATION) == "true":
-                wh = Webhook(fp, self.timeout, policy_name=p.name)
+                key = f"{p.namespace}/{p.name}" if p.namespace else p.name
+                wh = Webhook(fp, self.timeout, policy_name=key)
                 for k in kinds:
                     wh.merge_kind(k)
                 fine_grained.append(wh)
@@ -162,8 +163,14 @@ class WebhookConfigGenerator:
             path = f"{path_base}/{suffix}"
             name = f"{kind_name}-{suffix}.kyverno.svc"
             if wh.policy_name:
-                path += f"/{wh.policy_name}"
-                name = f"{kind_name}-{suffix}-{wh.policy_name}.kyverno.svc"
+                # fine-grained per-policy endpoint, served by the
+                # admission server's policy-scoped routing
+                # (config.FineGrainedWebhookPath, server.go:299-300);
+                # namespaced policies keep their ns segment so two
+                # same-named policies can't collide
+                path += f"/finegrained/{wh.policy_name}"
+                ident = wh.policy_name.replace("/", "-")
+                name = f"{kind_name}-{suffix}-{ident}.kyverno.svc"
             webhooks.append({
                 "name": name,
                 "clientConfig": {

@@ -149,7 +149,39 @@ class Handlers:
 
     # -- public handlers
 
-    def validate(self, review: Dict[str, Any], failure_policy: str = "fail") -> Dict[str, Any]:
+    def _lookup_policy(self, policy_key):
+        """Fine-grained URL param -> policy (handlers.go:206-219): a
+        missing policy is an evaluation error, not a silent allow."""
+        ns, name = policy_key
+        _, policies = self.cache.snapshot()
+        for p in policies:
+            if p.name == name and (not ns or getattr(p, "namespace", "") == ns):
+                return p
+        raise KeyError(f"key {ns}/{name}: policy not found")
+
+    def _class_filter(self, failure_policy: str, policy_key):
+        """handlers.go:244 filterPolicies: the /fail and /ignore webhook
+        paths each evaluate only their failurePolicy class; the bare
+        path ("all") evaluates everything. Fine-grained paths scope to
+        the one named policy (also class-filtered). Returns the set of
+        evaluable policy names, or None for no filtering."""
+        if failure_policy not in ("fail", "ignore") and policy_key is None:
+            return None
+        _, policies = self.cache.snapshot()
+        names = set()
+        for p in policies:
+            cls = "ignore" if (p.spec.failure_policy or "Fail") == "Ignore" \
+                else "fail"
+            if failure_policy in ("fail", "ignore") and cls != failure_policy:
+                continue
+            names.add(p.name)
+        if policy_key is not None:
+            scoped = self._lookup_policy(policy_key).name  # raises KeyError
+            names &= {scoped}
+        return names
+
+    def validate(self, review: Dict[str, Any], failure_policy: str = "all",
+                 policy_key=None) -> Dict[str, Any]:
         t0 = time.perf_counter()
         req = review.get("request") or {}
         payload = _payload_from_request(req, self.snapshot, self._need_roles())
@@ -158,10 +190,22 @@ class Handlers:
         if self._filtered(payload):
             return _response(req, True, "")
         try:
+            evaluable = self._class_filter(failure_policy, policy_key)
+        except KeyError as e:
+            allowed = failure_policy == "ignore"
+            return _response(req, allowed, f"evaluation error: {e}")
+        try:
             verdicts = self.batcher.submit(payload)
         except Exception as e:
             allowed = failure_policy == "ignore"
             return _response(req, allowed, f"evaluation error: {e}")
+        if evaluable is not None:
+            # the batch evaluates the full compiled program (one device
+            # dispatch for every concurrent request); rows outside this
+            # path's policy class / fine-grained scope are dropped so
+            # the decision and reports only reflect the routed policies
+            verdicts = [(pr, code) for pr, code in verdicts
+                        if pr[0] in evaluable]
         _, eng = self._engine()
         enforce = {
             p.name for p in eng.cps.policies
@@ -190,7 +234,8 @@ class Handlers:
             if payload.operation == "DELETE":
                 self.aggregator.drop(resource_uid(evaluated))
             else:
-                self.aggregator.put(resource_uid(evaluated), audit_results)
+                self.aggregator.put(resource_uid(evaluated), audit_results,
+                                    scope=evaluable)
         self.metrics.admission_duration.observe(time.perf_counter() - t0,
                                                 {"path": "validate"})
         if block_msgs:
@@ -221,6 +266,37 @@ class Handlers:
             return _response(req, False, "; ".join(errs))
         return _response(req, True, "")
 
+    def validate_policy_cr(self, review: Dict[str, Any]) -> Dict[str, Any]:
+        """Policy CR validation webhook (/policyvalidate,
+        pkg/webhooks/policy/handlers.go:27 Validate -> pkg/validation/
+        policy Validate): denies malformed policies at admission time,
+        surfaces non-fatal findings as warnings."""
+        from ..policy.validation import validate_policy
+
+        req = review.get("request") or {}
+        obj = req.get("object") or {}
+        # DELETE carries a null object — deleting a policy is never
+        # gated on its validity
+        if req.get("operation") == "DELETE" or not obj:
+            return _response(req, True, "")
+        try:
+            policy = ClusterPolicy.from_dict(obj)
+            errors, warnings = validate_policy(policy)
+        except Exception as e:  # malformed CR bodies are denials too
+            return _response(req, False, f"invalid policy: {e}")
+        out = _response(req, not errors, "; ".join(errors))
+        if warnings:
+            out["response"]["warnings"] = warnings
+        return out
+
+    def mutate_policy_cr(self, review: Dict[str, Any]) -> Dict[str, Any]:
+        """Policy CR mutation webhook (/policymutate): the reference's
+        handler is a no-op success since v1.11 (pkg/webhooks/policy/
+        handlers.go:41 Mutate returns ResponseSuccess — defaulting
+        moved to CRD defaults)."""
+        req = review.get("request") or {}
+        return _response(req, True, "")
+
     def _filtered(self, payload: AdmissionPayload) -> bool:
         """WithFilter middleware: resourceFilters + user exclusions
         short-circuit processing (handlers/filter.go)."""
@@ -235,7 +311,8 @@ class Handlers:
         return self.configuration.is_excluded(
             payload.info.username, payload.info.groups, payload.info.roles)
 
-    def mutate(self, review: Dict[str, Any], failure_policy: str = "fail") -> Dict[str, Any]:
+    def mutate(self, review: Dict[str, Any], failure_policy: str = "all",
+               policy_key=None) -> Dict[str, Any]:
         req = review.get("request") or {}
         payload = _payload_from_request(req, self.snapshot, self._need_roles())
         self.metrics.admission_requests.inc(
@@ -246,9 +323,16 @@ class Handlers:
         patched = resource
         ns_labels = self.snapshot.namespace_labels() if self.snapshot else {}
         try:
+            evaluable = self._class_filter(failure_policy, policy_key)
+        except KeyError as e:
+            allowed = failure_policy == "ignore"
+            return _response(req, allowed, f"evaluation error: {e}")
+        try:
             for policy in self.cache.get_policies(
                 PolicyType.MUTATE, kind=resource.get("kind"), namespace=payload.namespace
             ):
+                if evaluable is not None and policy.name not in evaluable:
+                    continue
                 pctx = build_scan_context(
                     policy, patched, ns_labels.get(payload.namespace, {}),
                     payload.operation, payload.info,
@@ -263,6 +347,8 @@ class Handlers:
                 PolicyType.VERIFY_IMAGES_MUTATE, kind=resource.get("kind"),
                 namespace=payload.namespace,
             ):
+                if evaluable is not None and policy.name not in evaluable:
+                    continue
                 pctx = build_scan_context(
                     policy, patched, ns_labels.get(payload.namespace, {}),
                     payload.operation, payload.info,
@@ -277,6 +363,9 @@ class Handlers:
                 # the validate path's audit plumbing
                 if self.aggregator is not None and response.policy_response.rules:
                     meta = patched.get("metadata") or {}
+                    # scope to this one policy so successive
+                    # verify-image policies (and the validate path's
+                    # rows) merge instead of replacing each other
                     self.aggregator.put(resource_uid(patched), [
                         ReportResult(
                             policy=policy.name, rule=rr.name,
@@ -284,7 +373,8 @@ class Handlers:
                             resource_kind=patched.get("kind", ""),
                             resource_name=meta.get("name", ""),
                             resource_namespace=meta.get("namespace", ""),
-                        ) for rr in response.policy_response.rules])
+                        ) for rr in response.policy_response.rules],
+                        scope={policy.name})
                 # only Enforce policies block; Audit failures surface
                 # via the report path above (utils/block.go semantics)
                 enforce = (policy.spec.validation_failure_action
@@ -399,12 +489,37 @@ class AdmissionServer:
                     self.end_headers()
                     return
                 path = self.path.rstrip("/")
-                failure_policy = "ignore" if path.endswith("/ignore") else "fail"
-                base = path.split("/")[1] if len(path) > 1 else ""
+                segs = [s for s in path.split("/") if s]
+                base = segs[0] if segs else ""
+                # /validate[/{fail|ignore}[/finegrained/[ns/]name]]
+                # (server.go:296-300 registerWebhookHandlers routes);
+                # the bare path is the "all" class — no failurePolicy
+                # filtering, errors fail closed
+                failure_policy = "all"
+                policy_key = None
+                if len(segs) >= 2 and segs[1] in ("fail", "ignore"):
+                    failure_policy = segs[1]
+                    if len(segs) >= 3 and segs[2] == "finegrained":
+                        if len(segs) < 4:
+                            # truncated fine-grained URL: refuse rather
+                            # than silently fall back to the catch-all
+                            self.send_response(404)
+                            self.end_headers()
+                            return
+                        # [ns, name] or [name] (handlers.go:200-210)
+                        rest = segs[3:]
+                        policy_key = (rest[0], rest[1]) if len(rest) >= 2 \
+                            else ("", rest[0])
                 if base == "validate":
-                    out = outer.handlers.validate(review, failure_policy)
+                    out = outer.handlers.validate(review, failure_policy,
+                                                  policy_key=policy_key)
                 elif base == "mutate":
-                    out = outer.handlers.mutate(review, failure_policy)
+                    out = outer.handlers.mutate(review, failure_policy,
+                                                policy_key=policy_key)
+                elif base == "policyvalidate":
+                    out = outer.handlers.validate_policy_cr(review)
+                elif base == "policymutate":
+                    out = outer.handlers.mutate_policy_cr(review)
                 elif base == "exception":
                     out = outer.handlers.validate_exception(review)
                 elif base == "globalcontext":

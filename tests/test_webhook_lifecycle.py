@@ -88,7 +88,7 @@ def test_fine_grained_webhook_per_policy():
     gen.reconcile()
     [wh] = gen.configs["validating"]["webhooks"]
     assert wh["name"] == "resource-validating-fail-special.kyverno.svc"
-    assert wh["clientConfig"]["url"].endswith("/validate/fail/special")
+    assert wh["clientConfig"]["url"].endswith("/validate/fail/finegrained/special")
 
 
 def test_mutating_config_covers_mutate_and_verify_images():
