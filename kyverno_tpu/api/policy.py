@@ -209,6 +209,10 @@ class Spec:
     webhook_timeout_seconds: Optional[int] = None
     failure_policy: Optional[str] = None
     schema_validation: Optional[bool] = None
+    # spec_types.go GenerateExisting (+ deprecated
+    # generateExistingOnPolicyUpdate): generate for pre-existing
+    # triggers when the policy is installed
+    generate_existing: bool = False
     raw: Dict[str, Any] = field(default_factory=dict)
 
     @classmethod
@@ -222,6 +226,9 @@ class Spec:
             webhook_timeout_seconds=d.get("webhookTimeoutSeconds"),
             failure_policy=d.get("failurePolicy"),
             schema_validation=d.get("schemaValidation"),
+            generate_existing=bool(
+                d.get("generateExisting",
+                      d.get("generateExistingOnPolicyUpdate", False))),
             raw=d,
         )
 

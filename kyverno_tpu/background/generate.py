@@ -35,22 +35,32 @@ class GenerateError(Exception):
 
 
 class GenerateController:
-    def __init__(self, snapshot: ClusterSnapshot, policies: Dict[str, ClusterPolicy]):
+    def __init__(self, snapshot: ClusterSnapshot,
+                 policies: Dict[str, ClusterPolicy],
+                 allowed_groups: Optional[set] = None):
         self.snapshot = snapshot
         self.policies = policies  # name -> policy (live view)
+        # API groups the background service account may write to
+        # (generate.go auth.CanIGenerate / the chart's aggregated
+        # clusterroles); None = unrestricted
+        self.allowed_groups = allowed_groups
 
     # -- UR processing (generate.go:97)
 
-    def process_ur(self, ur: UpdateRequest) -> None:
+    def process_ur(self, ur: UpdateRequest) -> List[Dict[str, Any]]:
+        """Returns references to the resources actually generated (empty
+        when every rule skipped) so callers can emit per-target events
+        the way the reference's generate controller does."""
+        generated: List[Dict[str, Any]] = []
         policy = self.policies.get(ur.policy)
         if policy is None:
             # policy deleted: nothing to generate; sync cleanup handles
             # downstreams via process_trigger_deletion
-            return
+            return generated
         trigger = ur.trigger
         if ur.operation == "DELETE":
             self.process_trigger_deletion(policy, trigger)
-            return
+            return generated
         for rule in policy.get_rules():
             if not rule.has_generate():
                 continue
@@ -59,18 +69,28 @@ class GenerateController:
             pctx = build_scan_context(policy, trigger, None, ur.operation)
             if not evaluate_conditions(pctx.json_context, rule.preconditions):
                 continue
-            self._apply_rule(policy, rule, trigger, pctx.json_context)
+            ref = self._apply_rule(policy, rule, trigger, pctx.json_context)
+            if ref is not None:
+                generated.append(ref)
+        return generated
 
     # -- rule application (generate.go:401)
 
     def _apply_rule(self, policy: ClusterPolicy, rule: Rule,
-                    trigger: Dict[str, Any], ctx: Context) -> None:
+                    trigger: Dict[str, Any],
+                    ctx: Context) -> Optional[Dict[str, Any]]:
         gen = rule.generation or {}
         try:
             spec = substitute_all(ctx, copy.deepcopy(gen))
         except SubstitutionError as e:
             raise GenerateError(f"substitution failed: {e}")
         api_version = spec.get("apiVersion", "v1")
+        if self.allowed_groups is not None:
+            group = api_version.split("/")[0] if "/" in api_version else ""
+            if group not in self.allowed_groups:
+                raise GenerateError(
+                    f"background service account cannot create "
+                    f"{api_version} resources (permission denied)")
         kind = spec.get("kind")
         name = spec.get("name")
         namespace = spec.get("namespace", "")
@@ -104,8 +124,10 @@ class GenerateController:
 
         existing = self._find(kind, namespace, name)
         if existing is not None and not spec.get("synchronize", False):
-            return  # without synchronize, existing targets are left alone
+            return None  # without synchronize, existing targets are left alone
         self.snapshot.upsert(target)
+        return {"apiVersion": api_version, "kind": kind, "name": name,
+                "namespace": namespace}
 
     # -- downstream sync/cleanup (cleanup.go)
 

@@ -76,6 +76,20 @@ def _synthesize_status(res: Dict[str, Any]) -> Dict[str, Any]:
         meta["creationTimestamp"] = dt.datetime.now(
             dt.timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")
         out["metadata"] = meta
+    if kind == "CustomResourceDefinition":
+        # exported CRDs carry a zeroed status; the apiserver fills
+        # acceptedNames/storedVersions on create regardless
+        names = (res.get("spec") or {}).get("names") or {}
+        out["status"] = {
+            "acceptedNames": {k: v for k, v in names.items()
+                              if k in ("kind", "listKind", "plural",
+                                       "singular", "shortNames",
+                                       "categories")},
+            "storedVersions": [v.get("name")
+                               for v in (res.get("spec") or {}).get(
+                                   "versions") or [] if v.get("storage")],
+        }
+        return out
     if "status" in res:
         return out
     if kind in ("Deployment", "StatefulSet", "ReplicaSet"):
@@ -179,6 +193,57 @@ class ScenarioRunner:
         for ns in ("default", "kube-system"):
             self.snapshot.upsert({"apiVersion": "v1", "kind": "Namespace",
                                   "metadata": {"name": ns}})
+        # the kyverno install's static RBAC surface (rbac scenarios
+        # assert the aggregated admin roles exist)
+        from ..cluster.rbac_manifests import aggregated_admin_roles
+
+        for role in aggregated_admin_roles():
+            self.snapshot.upsert(role)
+        # offline registry with the corpus' well-known test images:
+        # digests resolve, but no signature verifies under the policies'
+        # pinned keys — signature checks fail CRYPTOGRAPHICALLY (the
+        # 'signed' tag cannot pass offline: we don't hold kyverno's
+        # signing key)
+        from ..images import StaticRegistry
+        from ..images.crypto import generate_keypair
+
+        self.registry = StaticRegistry()
+        base = "ghcr.io/kyverno/test-verify-image"
+        self.registry.add_image(f"{base}:unsigned", "sha256:" + "11" * 32)
+        self.registry.add_image(
+            f"{base}:signed-by-someone-else", "sha256:" + "33" * 32)
+        someone_else, _ = generate_keypair()
+        self.registry.sign(f"{base}:signed-by-someone-else", key=someone_else)
+        # ':signed' is NOT mirrored: its signature lives under
+        # kyverno's real signing key, so lookups error (non-blocking)
+        # rather than fabricate verdicts.
+        # The zulu keyless corpus image IS mirrored: its public
+        # signature + SLSA provenance + vuln-scan attestations are
+        # re-issued under the registry's offline Fulcio-stand-in CA
+        # with the same identities, so keyless verification runs the
+        # full cert-chain + SAN/issuer + DSSE crypto path
+        zulu = "ghcr.io/chipzoller/zulu:v0.0.14"
+        zulu_digest = ("sha256:476b21f1a75dc90fac3579ee757f4607"
+                       "bb5546f476195cf645c54badf558c0db")
+        gh_issuer = "https://token.actions.githubusercontent.com"
+        slsa_builder = ("https://github.com/slsa-framework/"
+                        "slsa-github-generator/.github/workflows/"
+                        "generator_container_slsa3.yml@refs/heads/main")
+        self.registry.add_image(zulu, zulu_digest)
+        self.registry.sign(
+            zulu, subject=("https://github.com/chipzoller/zulu/.github/"
+                           "workflows/slsa-generic-keyless.yaml"
+                           "@refs/tags/v0.0.14"), issuer=gh_issuer)
+        self.registry.attest(
+            zulu, "https://slsa.dev/provenance/v0.2",
+            {"builder": {"id": slsa_builder}},
+            subject=slsa_builder, issuer=gh_issuer)
+        self.registry.attest(
+            zulu, "cosign.sigstore.dev/attestation/vuln/v1",
+            {"scanner": {"uri": "pkg:github/aquasecurity/trivy@0.34.0"}},
+            subject=("https://github.com/chipzoller/zulu/.github/"
+                     "workflows/vulnerability-scan.yaml@refs/heads/main"),
+            issuer=gh_issuer)
         self.policies: Dict[str, ClusterPolicy] = {}
         self.policy_docs: Dict[Tuple[str, str], Dict[str, Any]] = {}
         self.exceptions: List[Dict[str, Any]] = []
@@ -191,7 +256,15 @@ class ScenarioRunner:
                 api_call=_SnapshotApiCall(self.snapshot)))
         self.ttl = TtlController(self.snapshot)
         self.urq = UpdateRequestQueue()
-        self.generate = GenerateController(self.snapshot, self.policies)
+        # the background SA's write grants mirror the chart's aggregated
+        # clusterroles; custom API groups need an explicit grant, so
+        # generation into e.g. crossplane groups fails as in a cluster
+        self.generate = GenerateController(
+            self.snapshot, self.policies,
+            allowed_groups={"", "apps", "batch", "networking.k8s.io",
+                            "rbac.authorization.k8s.io", "kyverno.io",
+                            "wgpolicyk8s.io", "policy", "autoscaling",
+                            "coordination.k8s.io"})
         from ..background.mutate_existing import MutateExistingController
 
         self.mutate_existing = MutateExistingController(self.snapshot,
@@ -199,9 +272,92 @@ class ScenarioRunner:
         from ..vap import VapGenerateController
 
         self.vap_generator = VapGenerateController(self.snapshot)
+        # the webhook-configuration controller runs against the policy
+        # set exactly as in a cluster: installs/deletes reconcile the
+        # generated Validating/MutatingWebhookConfigurations, which the
+        # webhooks/* conformance scenarios assert on. The runner keys
+        # policies by kind+name (a Policy and a ClusterPolicy with the
+        # same name are distinct objects), so it feeds the generator a
+        # snapshot view of its own store rather than a PolicyCache
+        from ..cluster.webhookconfig import WebhookConfigGenerator
+
+        runner = self
+
+        class _PolicyView:
+            revision = 0
+
+            @staticmethod
+            def snapshot():
+                return _PolicyView.revision, list(runner.policies.values())
+
+        self._policy_view = _PolicyView
+        # the conformance CI runs the force-failure-policy-ignore
+        # category under a config profile with that toggle enabled
+        # (.github/workflows/conformance.yaml config matrix)
+        self.webhook_gen = WebhookConfigGenerator(
+            _PolicyView,
+            force_failure_policy_ignore=(
+                "force-failure-policy-ignore" in scenario_dir))
+        self.webhook_gen.reconcile()  # static surface exists at startup
         self._parsed_policies: Dict[str, ClusterPolicy] = {}
         self._virtual_now = None  # monotone controller clock (op_assert)
+        self.events: List[Dict[str, Any]] = []  # emitted K8s Events
+        self._admitted_uids: set = set()  # resources that went through admission
         self.log: List[str] = []
+
+    # -- events (pkg/event: policy-involving admission/generate events;
+    # the background scanner's events materialize at assert time)
+
+    def _emit_event(self, policy_kind: str, policy_name: str, reason: str,
+                    etype: str, component: str, action: str = "",
+                    message: str = "", namespace: str = "default") -> None:
+        ev = {
+            "apiVersion": "v1", "kind": "Event",
+            "metadata": {"namespace": namespace or "default"},
+            "involvedObject": {"apiVersion": "kyverno.io/v1",
+                               "kind": policy_kind, "name": policy_name},
+            "type": etype, "reason": reason,
+            "reportingComponent": component,
+            "source": {"component": component},
+        }
+        if action:
+            ev["action"] = action
+        if message:
+            ev["message"] = message
+        self.events.append(ev)
+
+    def _scan_events(self) -> List[Dict[str, Any]]:
+        """Background-scan violations as Events involving the violating
+        resource (reportingComponent kyverno-scan)."""
+        eng = self._engine()
+        ns_labels = self.snapshot.namespace_labels()
+        out: List[Dict[str, Any]] = []
+        for _, res, _ in self.snapshot.items():
+            meta = res.get("metadata") or {}
+            for ukey, policy in self.policies.items():
+                if not policy.spec.background:
+                    continue
+                if not any(r.has_validate() for r in policy.get_rules()):
+                    continue
+                key = meta.get("name", "") if res.get("kind") == "Namespace" \
+                    else meta.get("namespace", "")
+                pctx = build_scan_context(policy, res, ns_labels.get(key, {}))
+                resp = eng.validate(pctx)
+                if any(rr.status in ("fail", "error")
+                       for rr in resp.policy_response.rules):
+                    out.append({
+                        "apiVersion": "v1", "kind": "Event",
+                        "metadata": {"namespace": meta.get("namespace")
+                                     or "default"},
+                        "involvedObject": {
+                            "apiVersion": res.get("apiVersion", "v1"),
+                            "kind": res.get("kind", ""),
+                            "name": meta.get("name", "")},
+                        "type": "Warning", "reason": "PolicyViolation",
+                        "reportingComponent": "kyverno-scan",
+                        "source": {"component": "kyverno-scan"},
+                    })
+        return out
 
     # -- engine (rebuilt when exceptions change)
 
@@ -216,6 +372,23 @@ class ScenarioRunner:
 
     # -- admission
 
+    def _webhook_match_conditions_ok(self, policy, resource, op) -> bool:
+        """spec.webhookConfiguration.matchConditions: CEL over the
+        AdmissionRequest gates whether the webhook is invoked at all
+        (the apiserver evaluates these before calling kyverno)."""
+        mcs = (policy.spec.raw.get("webhookConfiguration") or {}) \
+            .get("matchConditions")
+        if not mcs:
+            return True
+        from ..vap.validator import CelValidator
+
+        v = CelValidator(validations=[], match_conditions=mcs)
+        request = {"operation": op,
+                   "userInfo": {"username": _ADMIN["username"],
+                                "groups": list(_ADMIN["groups"])}}
+        matched, _err = v.matches(object=resource, request=request)
+        return matched
+
     def _admit(self, doc: Dict[str, Any]) -> Dict[str, Any]:
         """mutate -> validate; raises StepError when an Enforce policy
         denies. Returns the (possibly mutated) resource."""
@@ -227,19 +400,73 @@ class ScenarioRunner:
         exists = self._find(doc.get("kind", ""), ns, meta.get("name", ""))
         op = "UPDATE" if exists is not None else "CREATE"
         current = doc
-        for policy in self.policies.values():
+        res_ns = ns if ns else "default"
+        for ukey, policy in self.policies.items():
+            if not policy.spec.admission:
+                continue  # background-only policy (spec.admission=false)
+            if not self._webhook_match_conditions_ok(policy, current, op):
+                continue
             if any(r.has_mutate() for r in policy.get_rules()):
                 pctx = _ctx(policy, current, ns_labels.get(key, {}), op)
                 m = eng.mutate(pctx)
-                if m.patched_resource is not None:
+                if m.patched_resource is not None and \
+                        m.patched_resource != current:
                     current = m.patched_resource
-        for policy in self.policies.values():
+                    self._emit_event(
+                        ukey.split("/")[0], policy.name, "PolicyApplied",
+                        "Normal", "kyverno-admission",
+                        action="Resource Mutated", namespace=res_ns)
+        for ukey, policy in self.policies.items():
+            # verify-image rules run on the mutate webhook after
+            # mutation (resource/handlers.go:139-177); Enforce failures
+            # block, digest patches land on the admitted resource
+            if not policy.spec.admission:
+                continue
+            if not any(r.has_verify_images() for r in policy.get_rules()):
+                continue
+            if not self._webhook_match_conditions_ok(policy, current, op):
+                continue
+            pctx = _ctx(policy, current, ns_labels.get(key, {}), op)
+            resp = eng.verify_and_patch_images(
+                pctx, registry_client=self.registry)
+            if resp.patched_resource is not None:
+                current = resp.patched_resource
+            enforce = (policy.spec.validation_failure_action
+                       or "Audit").lower().startswith("enforce")
+            # block on cryptographic verification FAILURE; a registry
+            # ERROR here means the image isn't mirrored in the offline
+            # fixture registry (it would resolve against the live
+            # registry the reference talks to), so it doesn't block
+            failed = [rr.name for rr in resp.policy_response.rules
+                      if rr.status == "fail"]
+            if enforce and failed:
+                raise StepError(
+                    f"admission denied by {policy.name}: image "
+                    f"verification failed: {', '.join(failed)}")
+        for ukey, policy in self.policies.items():
+            if not policy.spec.admission:
+                continue
             if not any(r.has_validate() for r in policy.get_rules()):
+                continue
+            if not self._webhook_match_conditions_ok(policy, current, op):
                 continue
             enforce = (policy.spec.validation_failure_action
                        or "Audit").lower().startswith("enforce")
             pctx = _ctx(policy, current, ns_labels.get(key, {}), op)
             resp = eng.validate(pctx)
+            statuses = [rr.status for rr in resp.policy_response.rules]
+            pk = ukey.split("/")[0]
+            # events go out whether or not the request is blocked (the
+            # reference emits them from an async queue before the
+            # admission response is returned)
+            if any(s in ("fail", "error") for s in statuses):
+                self._emit_event(pk, policy.name, "PolicyViolation",
+                                 "Warning", "kyverno-admission",
+                                 namespace=res_ns)
+            elif "pass" in statuses:
+                self._emit_event(pk, policy.name, "PolicyApplied",
+                                 "Normal", "kyverno-admission",
+                                 namespace=res_ns)
             for rr in resp.policy_response.rules:
                 if rr.status in ("fail", "error") and enforce:
                     raise StepError(
@@ -254,6 +481,8 @@ class ScenarioRunner:
         key = meta.get("name", "") if doc.get("kind") == "Namespace" \
             else meta.get("namespace", "")
         for policy in self.policies.values():
+            if not policy.spec.admission:
+                continue
             if not any(r.has_validate() for r in policy.get_rules()):
                 continue
             enforce = (policy.spec.validation_failure_action
@@ -272,11 +501,12 @@ class ScenarioRunner:
 
     def _run_generate(self, trigger: Dict[str, Any], op: str,
                       only_policy: Optional[str] = None,
-                      mutate_existing: bool = True) -> None:
+                      mutate_existing: bool = True,
+                      generate: bool = True) -> None:
         for name, policy in self.policies.items():
             if only_policy is not None and name != only_policy:
                 continue
-            if any(r.has_generate() for r in policy.get_rules()):
+            if generate and any(r.has_generate() for r in policy.get_rules()):
                 self.urq.add(UpdateRequest(policy=name, rule_type="generate",
                                            trigger=trigger, operation=op))
             if op != "DELETE" and mutate_existing:
@@ -290,10 +520,51 @@ class ScenarioRunner:
                     self.urq.add(UpdateRequest(
                         policy=name, rule_type="mutate", trigger=trigger,
                         operation=op))
-        self.urq.process(
-            lambda ur: (self.generate.process_ur(ur)
-                        if ur.rule_type == "generate"
-                        else self.mutate_existing.process_ur(ur)))
+        processed = list(self.urq.pending())
+        gen_refs: Dict[int, List[Dict[str, Any]]] = {}
+
+        def _handle(ur):
+            if ur.rule_type == "generate":
+                gen_refs[id(ur)] = self.generate.process_ur(ur)
+            else:
+                self.mutate_existing.process_ur(ur)
+
+        self.urq.process(_handle)
+        from ..background.updaterequest import UR_COMPLETED, UR_FAILED
+
+        for ur in processed:
+            if ur.rule_type != "generate":
+                continue
+            pk, _, pname = ur.policy.partition("/")
+            refs = gen_refs.get(id(ur), [])
+            if ur.status == UR_COMPLETED and refs:
+                # one policy-involving event + one per generated target
+                # (pkg/background/generate events)
+                self._emit_event(pk, pname, "PolicyApplied", "Normal",
+                                 "kyverno-generate",
+                                 action="Resource Generated",
+                                 message="resource generated")
+                for ref in refs:
+                    self.events.append({
+                        "apiVersion": "v1", "kind": "Event",
+                        "metadata": {"namespace": ref.get("namespace")
+                                     or "default"},
+                        "involvedObject": {
+                            "apiVersion": ref.get("apiVersion", "v1"),
+                            "kind": ref.get("kind", ""),
+                            "name": ref.get("name", ""),
+                            **({"namespace": ref["namespace"]}
+                               if ref.get("namespace") else {})},
+                        "type": "Normal", "reason": "PolicyApplied",
+                        "action": "None",
+                        "reportingComponent": "kyverno-generate",
+                        "source": {"component": "kyverno-generate"},
+                    })
+            elif ur.status == UR_FAILED or ur.message:
+                # terminal failure, or an attempt that will be retried —
+                # the reference emits a PolicyError event per failure
+                self._emit_event(pk, pname, "PolicyError", "Warning",
+                                 "kyverno-generate", message=ur.message)
 
     # -- ops
 
@@ -308,8 +579,23 @@ class ScenarioRunner:
                 elif kind in CLEANUP_KINDS:
                     self._install_cleanup(doc)
                 else:
+                    meta0 = doc.get("metadata") or {}
+                    prev = self._find(kind, meta0.get("namespace", ""),
+                                      meta0.get("name", ""))
                     admitted = self._admit(doc)
-                    self.snapshot.upsert(_synthesize_status(admitted))
+                    stamped = _synthesize_status(admitted)
+                    # apiserver bumps metadata.generation per spec
+                    # update; controllers echo it as observedGeneration
+                    gen = 1 if prev is None else (
+                        ((prev.get("metadata") or {}).get("generation") or 1)
+                        + 1)
+                    stamped.setdefault("metadata", {})["generation"] = gen
+                    st = stamped.get("status")
+                    if isinstance(st, dict) and "replicas" in st:
+                        st.setdefault("observedGeneration", gen)
+                    self.snapshot.upsert(stamped)
+                    from ..cluster.snapshot import resource_uid
+                    self._admitted_uids.add(resource_uid(stamped))
                     self._run_generate(admitted, "CREATE")
             except StepError:
                 if expect_error:
@@ -354,15 +640,59 @@ class ScenarioRunner:
                 stored["status"]["validatingadmissionpolicy"] = {
                     "generated": generated}
 
+    def _kind_resolver(self, selector: str):
+        """Discovery stand-in for policy validation (validate.go:1404
+        validKinds): builtin kinds resolve from the served-kind table;
+        CRDs and custom resources resolve from the live snapshot;
+        anything else is unknown."""
+        from ..cluster.webhookconfig import _CLUSTER_KINDS
+        from ..utils.kube import parse_kind_selector
+        from ..vap.policy import _PLURALS
+
+        _, v, k, sub = parse_kind_selector(selector)
+        # served builtins beyond the plural table (scope per discovery)
+        if k in _CLUSTER_KINDS and k not in _PLURALS:
+            return "Cluster"
+        if k in {"Lease", "Event", "PodTemplate", "EndpointSlice"}:
+            return "Namespaced"
+        if k in _PLURALS:
+            served = ("v1", "v2") if k == "HorizontalPodAutoscaler" else ("v1",)
+            if v not in ("*",) + served:
+                return None  # e.g. 'v2/Pod' — no such served version
+            if sub not in ("", "*"):
+                from ..cluster.webhookconfig import _POD_SUBRESOURCES
+                known = _POD_SUBRESOURCES if k == "Pod" else ("status", "scale")
+                if sub not in known:
+                    return None  # e.g. 'Pod/foo' — no such subresource
+            return "Cluster" if k in _CLUSTER_KINDS else "Namespaced"
+        for _, res, _ in self.snapshot.items():
+            if res.get("kind") == "CustomResourceDefinition":
+                names = ((res.get("spec") or {}).get("names") or {})
+                if names.get("kind") == k:
+                    scope = (res.get("spec") or {}).get("scope") or "Namespaced"
+                    return "Cluster" if scope == "Cluster" else "Namespaced"
+            if res.get("kind") == k:
+                return "Namespaced"
+        return None
+
     def _install_policy(self, doc: Dict[str, Any]) -> None:
         parsed = ClusterPolicy.from_dict(doc)
-        errors, _ = validate_policy(parsed)
+        errors, _ = validate_policy(parsed, kind_resolver=self._kind_resolver)
         if errors:
             raise StepError(f"policy rejected: {errors[0]}")
         policy = expand_policy(parsed)
-        self.policies[policy.name] = policy
+        ukey = f"{doc.get('kind', 'ClusterPolicy')}/{policy.name}"
+        self.policies[ukey] = policy
+        self._policy_view.revision += 1
+        self.webhook_gen.reconcile()
         stored = dict(doc)
         stored["status"] = dict(READY_STATUS)
+        # the controller surfaces computed autogen rules in status
+        # (api/kyverno/v1 PolicyStatus.Autogen; autogen/* scenarios
+        # assert the exact generated rule list)
+        gen_rules = [r.raw for r in policy.get_rules()
+                     if r.name.startswith("autogen-")]
+        stored["status"]["autogen"] = {"rules": gen_rules} if gen_rules else {}
         meta = doc.get("metadata") or {}
         # Kyverno->VAP generation reconciles on ClusterPolicy events
         # only (the reference controller watches ClusterPolicies); the
@@ -378,13 +708,16 @@ class ScenarioRunner:
                 "generated": generated}
         self.policy_docs[(doc.get("kind", ""), meta.get("name", ""))] = stored
         # replay existing triggers for THIS policy only: generate rules
-        # reconcile in background; mutate-existing replays at install
-        # only when spec.mutateExistingOnPolicyUpdate is set
+        # touch pre-existing triggers only with spec.generateExisting
+        # (spec_types.go GenerateExisting); mutate-existing replays at
+        # install only when spec.mutateExistingOnPolicyUpdate is set
         mutate_on_update = bool((doc.get("spec") or {})
                                 .get("mutateExistingOnPolicyUpdate"))
-        for _, res, _ in self.snapshot.items():
-            self._run_generate(res, "UPDATE", only_policy=policy.name,
-                               mutate_existing=mutate_on_update)
+        if policy.spec.generate_existing or mutate_on_update:
+            for _, res, _ in self.snapshot.items():
+                self._run_generate(res, "UPDATE", only_policy=ukey,
+                                   mutate_existing=mutate_on_update,
+                                   generate=policy.spec.generate_existing)
 
     def op_delete(self, ref: Dict[str, Any]) -> None:
         kind = ref.get("kind", "")
@@ -392,8 +725,10 @@ class ScenarioRunner:
         name = meta.get("name", "")
         namespace = meta.get("namespace", "")
         if kind in POLICY_KINDS:
-            self.policies.pop(name, None)
+            self.policies.pop(f"{kind}/{name}", None)
             self.policy_docs.pop((kind, name), None)
+            self._policy_view.revision += 1
+            self.webhook_gen.reconcile()
             if kind == "ClusterPolicy":
                 self._parsed_policies.pop(name, None)
                 self.vap_generator.on_policy_deleted(name)
@@ -457,6 +792,20 @@ class ScenarioRunner:
             if target is None:
                 return False
             return self._subset(tree, target)
+        if kind in ("ValidatingWebhookConfiguration",
+                    "MutatingWebhookConfiguration"):
+            if any(cfg.get("kind") == kind and self._subset(tree, cfg)
+                   for cfg in self.webhook_gen.all_configs()):
+                return True
+            return any(self._subset(tree, v)
+                       for v in getattr(self.vap_generator, "vaps", {}).values()
+                       if isinstance(v, dict) and v.get("kind") == kind)
+        if kind == "Event":
+            # cheap recorded events first; the full background-scan
+            # materialization only runs when they miss
+            if any(self._subset(tree, ev) for ev in self.events):
+                return True
+            return any(self._subset(tree, ev) for ev in self._scan_events())
         if kind in ("PolicyReport", "ClusterPolicyReport"):
             return any(self._subset(tree, rep)
                        for rep in self._materialize_reports(kind))
@@ -477,28 +826,89 @@ class ScenarioRunner:
     def _materialize_reports(self, kind: str) -> List[Dict[str, Any]]:
         """Background-scan the snapshot and shape per-resource
         PolicyReports the way the reports controller writes them
-        (scope + results rows + summary, managed-by label)."""
+        (ownerReference + scope + result rows with category/severity/
+        properties + summary, pkg/utils/report builders)."""
+        from ..cluster.snapshot import resource_uid
+
+        from ..cluster.webhookconfig import _CLUSTER_KINDS
+
         eng = self._engine()
         ns_labels = self.snapshot.namespace_labels()
+        cluster_kinds = _CLUSTER_KINDS | {"ClusterPolicy"}
         reports: List[Dict[str, Any]] = []
-        for _, res, _ in self.snapshot.items():
+        for uid, res, _ in self.snapshot.items():
             meta = res.get("metadata") or {}
             ns = meta.get("namespace", "")
-            if (kind == "PolicyReport") != bool(ns):
+            # report placement follows the RESOURCE's scope, not whether
+            # the fixture happened to carry a namespace (chainsaw stamps
+            # its test namespace on namespaced resources)
+            is_cluster = res.get("kind") in cluster_kinds
+            if (kind == "PolicyReport") == is_cluster:
                 continue
+            if not is_cluster and not ns:
+                ns = "default"
             rows: List[Dict[str, Any]] = []
             for policy in self.policies.values():
-                if not policy.spec.background:
+                # background policies are scanned; admission-only
+                # policies still surface their admission results in
+                # reports (report/admission controller path)
+                if not policy.spec.background and not (
+                        policy.spec.admission and uid in self._admitted_uids):
                     continue
-                if not any(r.has_validate() for r in policy.get_rules()):
+                mcs = (policy.spec.raw.get("webhookConfiguration") or {}
+                       ).get("matchConditions")
+                if mcs:
+                    # the scan path re-evaluates matchConditions with
+                    # its own service-account request context, not the
+                    # original requester's — object-scoped conditions
+                    # still gate, user-scoped ones see the scanner SA
+                    from ..vap.validator import CelValidator
+
+                    v = CelValidator(validations=[], match_conditions=mcs)
+                    matched, _err = v.matches(
+                        object=res,
+                        request={"operation": "UPDATE", "userInfo": {
+                            "username": ("system:serviceaccount:kyverno:"
+                                         "kyverno-reports-controller"),
+                            "groups": ["system:serviceaccounts",
+                                       "system:authenticated"]}})
+                    if not matched:
+                        continue
+                has_validate = any(r.has_validate()
+                                   for r in policy.get_rules())
+                has_vi = any(r.has_verify_images()
+                             for r in policy.get_rules())
+                if not has_validate and not has_vi:
                     continue
                 key = meta.get("name", "") if res.get("kind") == "Namespace" else ns
                 pctx = build_scan_context(policy, res, ns_labels.get(key, {}))
-                resp = eng.validate(pctx)
-                for rr in resp.policy_response.rules:
-                    rows.append({"policy": policy.name, "rule": rr.name,
-                                 "result": rr.status,
-                                 "message": rr.message})
+                responses = []
+                if has_validate:
+                    responses.append(eng.validate(pctx))
+                if has_vi:
+                    pctx_vi = build_scan_context(policy, res,
+                                                 ns_labels.get(key, {}))
+                    responses.append(eng.verify_and_patch_images(
+                        pctx_vi, registry_client=self.registry))
+                for resp in responses:
+                    for rr in resp.policy_response.rules:
+                        row = {"policy": policy.name, "rule": rr.name,
+                               "result": rr.status,
+                               "message": rr.message
+                               or (f"validation rule '{rr.name}' passed."
+                                   if rr.status == "pass" else ""),
+                               "scored": True, "source": "kyverno"}
+                        anns = policy.annotations
+                        if anns.get("policies.kyverno.io/category"):
+                            row["category"] = anns["policies.kyverno.io/category"]
+                        if anns.get("policies.kyverno.io/severity"):
+                            row["severity"] = anns["policies.kyverno.io/severity"]
+                        props = dict(rr.properties or {})
+                        if rr.exceptions:
+                            props["exception"] = ", ".join(rr.exceptions)
+                        if props:
+                            row["properties"] = props
+                        rows.append(row)
             if not rows:
                 continue
             summary = {s: sum(1 for r in rows if r["result"] == s)
@@ -506,7 +916,11 @@ class ScenarioRunner:
             reports.append({
                 "apiVersion": "wgpolicyk8s.io/v1alpha2", "kind": kind,
                 "metadata": {"namespace": ns,
-                             "labels": {"app.kubernetes.io/managed-by": "kyverno"}},
+                             "labels": {"app.kubernetes.io/managed-by": "kyverno"},
+                             "ownerReferences": [{
+                                 "apiVersion": res.get("apiVersion", ""),
+                                 "kind": res.get("kind", ""),
+                                 "name": meta.get("name", "")}]},
                 "scope": {"apiVersion": res.get("apiVersion", ""),
                           "kind": res.get("kind", ""),
                           "name": meta.get("name", ""),

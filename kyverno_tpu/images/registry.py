@@ -10,18 +10,21 @@ module defines that backend seam plus an offline static backend:
   ``fetch_attestations(opts)`` returning ``Response(digest,
   statements)`` — the same split as images.ImageVerifier in
   pkg/images/client.go;
-- ``StaticRegistry``: a deterministic in-memory registry (image ->
-  digest, signers, attestations) used by tests, the CLI's offline mode
-  and air-gapped deployments. Real cosign/notary crypto plugs in by
-  implementing the same protocol; the engine flow above is unchanged.
+- ``StaticRegistry``: an in-memory registry whose stored artifacts are
+  REAL signing envelopes (ECDSA simple-signing payloads and DSSE
+  attestations, see crypto.py) verified cryptographically — used by
+  tests, the CLI's offline mode and air-gapped deployments. A
+  networked cosign/notary backend plugs in behind the same protocol.
 """
 
 from __future__ import annotations
 
+import base64
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
 from ..utils.wildcard import match as wildcard_match
+from . import crypto
 
 
 @dataclass
@@ -55,41 +58,84 @@ class VerificationFailed(Exception):
 
 
 class StaticRegistry:
-    """Offline registry fixture. Content:
+    """Offline registry holding REAL signing envelopes. Content:
 
-    images: {image_ref_without_tag_or_with: {
+    images: {image_ref: {
         "digest": "sha256:...",
-        "signers": [{"key": pem or "subject"/"issuer" pair,
-                     "annotations": {...}, "type": "Cosign"|"Notary"}],
-        "attestations": [{"type": predicateType,
-                          "predicate": {...}, "signers": [...]}],
+        "signatures": [{"payload": b64 simple-signing JSON,
+                        "signature": b64 ECDSA-P256/SHA256 sig,
+                        "cert": signer cert PEM (keyless) or "",
+                        "type": "Cosign"|"Notary"}],
+        "attestations": [{"envelope": DSSE envelope dict,
+                          "cert": signer cert PEM or ""}],
     }}
-    Lookup matches the exact reference first, then the tag-stripped
-    repository path.
+    Verification is cryptographic: signatures must verify under the
+    attestor's key (or a certificate chaining to trusted roots) and the
+    signed payload must bind this image's digest — nothing is decided
+    by metadata comparison. Lookup matches the exact reference first,
+    then the tag-stripped repository path.
     """
 
     def __init__(self, images: Optional[Dict[str, Dict[str, Any]]] = None):
         self.images = dict(images or {})
+        self._ca = None  # lazy offline Fulcio-style CA for keyless signing
 
     # -- registration helpers (test/CLI fixture building)
 
     def add_image(self, ref: str, digest: str) -> None:
         self.images.setdefault(ref, {})["digest"] = digest
 
-    def sign(self, ref: str, key: str = "", subject: str = "", issuer: str = "",
-             annotations: Optional[Dict[str, str]] = None, sig_type: str = "Cosign") -> None:
+    def _keyless_ca(self):
+        if self._ca is None:
+            self._ca = crypto.make_ca()
+        return self._ca
+
+    @property
+    def ca_roots(self) -> str:
+        """Trusted roots PEM for the registry's keyless CA."""
+        return self._keyless_ca()[1]
+
+    def _repo(self, ref: str) -> str:
+        base = ref.split("@", 1)[0]
+        return base.rsplit(":", 1)[0] if ":" in base.rsplit("/", 1)[-1] \
+            else base
+
+    def sign(self, ref: str, key=None, subject: str = "", issuer: str = "",
+             annotations: Optional[Dict[str, str]] = None,
+             sig_type: str = "Cosign") -> None:
+        """Produce a real signature over the simple-signing payload.
+        ``key`` is an EC private key (keyed attestor); with
+        ``subject``/``issuer`` instead, an ephemeral certificate is
+        issued from the registry CA (keyless attestor)."""
         entry = self.images.setdefault(ref, {})
-        entry.setdefault("signers", []).append({
-            "key": key, "subject": subject, "issuer": issuer,
-            "annotations": annotations or {}, "type": sig_type,
+        payload = crypto.simple_signing_payload(
+            self._repo(ref), entry.get("digest", ""), annotations)
+        cert_pem = ""
+        if key is None:
+            ca_priv, ca_cert = self._keyless_ca()
+            key, cert_pem = crypto.issue_signer_cert(
+                ca_priv, ca_cert, subject or "nobody@example.com", issuer)
+        sig = crypto.sign_blob(key, payload)
+        entry.setdefault("signatures", []).append({
+            "payload": base64.b64encode(payload).decode(),
+            "signature": base64.b64encode(sig).decode(),
+            "cert": cert_pem, "type": sig_type,
         })
 
     def attest(self, ref: str, predicate_type: str, predicate: Dict[str, Any],
-               key: str = "", subject: str = "", issuer: str = "") -> None:
+               key=None, subject: str = "", issuer: str = "") -> None:
+        """Produce a real DSSE/in-toto attestation envelope."""
         entry = self.images.setdefault(ref, {})
+        statement = crypto.make_statement(
+            entry.get("digest", ""), predicate_type, predicate,
+            name=self._repo(ref))
+        cert_pem = ""
+        if key is None:
+            ca_priv, ca_cert = self._keyless_ca()
+            key, cert_pem = crypto.issue_signer_cert(
+                ca_priv, ca_cert, subject or "nobody@example.com", issuer)
         entry.setdefault("attestations", []).append({
-            "type": predicate_type, "predicate": predicate,
-            "signers": [{"key": key, "subject": subject, "issuer": issuer}],
+            "envelope": crypto.dsse_sign(key, statement), "cert": cert_pem,
         })
 
     # -- lookup
@@ -105,21 +151,50 @@ class StaticRegistry:
             return self.images[repo]
         raise RegistryError(f"image not found in registry: {image}")
 
-    @staticmethod
-    def _signer_matches(signer: Dict[str, Any], opts: VerifyOptions) -> bool:
+    def _attestor_key(self, opts: VerifyOptions,
+                      cert_pem: str) -> Optional[str]:
+        """Resolve the public key PEM this attestor accepts for a given
+        signature, applying certificate checks for keyless/cert
+        attestors. Returns None when the attestor cannot accept the
+        signature (wrong identity / untrusted chain)."""
         if opts.key:
-            if signer.get("key", "").strip() != opts.key.strip():
-                return False
-        if opts.subject:
-            if not wildcard_match(opts.subject, signer.get("subject", "")):
-                return False
-        if opts.issuer:
-            if signer.get("issuer", "") != opts.issuer:
-                return False
-        for k, v in (opts.annotations or {}).items():
-            if signer.get("annotations", {}).get(k) != v:
-                return False
-        return True
+            return opts.key
+        if opts.cert:
+            # certificate attestor: the signature must carry exactly
+            # this certificate (and it must chain when a chain is given)
+            if not cert_pem or cert_pem.strip() != opts.cert.strip():
+                return None
+            if opts.cert_chain:
+                try:
+                    crypto.verify_cert_identity(cert_pem, opts.cert_chain)
+                except crypto.CryptoError:
+                    return None
+            return crypto.cert_public_pem(cert_pem)
+        if opts.subject or opts.issuer:
+            # keyless: chain to roots, then identity-match SAN/issuer
+            if not cert_pem:
+                return None
+            roots = opts.roots or self.ca_roots
+            try:
+                subject, issuer = crypto.verify_cert_identity(cert_pem, roots)
+            except crypto.CryptoError:
+                return None
+            if opts.subject and not wildcard_match(opts.subject, subject):
+                return None
+            if opts.issuer and issuer != opts.issuer:
+                return None
+            return crypto.cert_public_pem(cert_pem)
+        # unconstrained attestor (attestations block without attestors):
+        # signature crypto still runs — a certificate-bearing envelope
+        # verifies against the trusted roots with no identity pinning;
+        # a keyed envelope has nothing to verify against and is skipped
+        if cert_pem:
+            try:
+                crypto.verify_cert_identity(cert_pem, opts.roots or self.ca_roots)
+            except crypto.CryptoError:
+                return None
+            return crypto.cert_public_pem(cert_pem)
+        return None
 
     # -- ImageVerifier protocol
 
@@ -129,28 +204,68 @@ class StaticRegistry:
         return self._entry(image).get("digest", "")
 
     def verify_signature(self, opts: VerifyOptions) -> Response:
+        """Cryptographically verify a simple-signing payload
+        (cosign.go VerifySignature): the ECDSA signature must verify
+        under the attestor's key, and the signed payload must bind this
+        image's manifest digest and carry any required annotations."""
         entry = self._entry(opts.image)
         digest = entry.get("digest", "")
-        for signer in entry.get("signers", []):
-            if signer.get("type", "Cosign") != opts.type:
+        last = "no signatures found"
+        for sig in entry.get("signatures", []):
+            if sig.get("type", "Cosign") != opts.type:
                 continue
-            if self._signer_matches(signer, opts):
-                return Response(digest=digest)
+            pub = self._attestor_key(opts, sig.get("cert", ""))
+            if pub is None:
+                last = "no signature matched the attestor identity"
+                continue
+            payload = base64.b64decode(sig.get("payload", ""))
+            raw = base64.b64decode(sig.get("signature", ""))
+            try:
+                if not crypto.verify_blob(pub, raw, payload):
+                    last = "signature verification failed"
+                    continue
+                doc = crypto.parse_simple_signing(payload)
+            except crypto.CryptoError as e:
+                last = str(e)
+                continue
+            critical = doc.get("critical") or {}
+            bound = (critical.get("image") or {}).get(
+                "docker-manifest-digest", "")
+            if bound != digest:
+                last = (f"payload digest mismatch: signed {bound}, "
+                        f"manifest has {digest}")
+                continue
+            optional = doc.get("optional") or {}
+            if any(optional.get(k) != v
+                   for k, v in (opts.annotations or {}).items()):
+                last = "required annotations missing from signed payload"
+                continue
+            return Response(digest=digest)
         raise VerificationFailed(
-            f"no matching signature for image {opts.image}")
+            f"image {opts.image}: {last}")
 
     def fetch_attestations(self, opts: VerifyOptions) -> Response:
+        """Verify DSSE envelopes and return the in-toto statements
+        whose subject binds this image (cosign.go FetchAttestations)."""
         entry = self._entry(opts.image)
         digest = entry.get("digest", "")
         statements = []
         for att in entry.get("attestations", []):
-            signers = att.get("signers", [{}])
-            if (opts.key or opts.subject or opts.issuer) and not any(
-                    self._signer_matches(s, opts) for s in signers):
+            pub = self._attestor_key(opts, att.get("cert", ""))
+            if pub is None:
                 continue
-            statements.append({"type": att.get("type", ""),
-                               "predicate": att.get("predicate", {})})
-        if not statements and not entry.get("attestations"):
+            try:
+                stmt = crypto.dsse_verify(pub, att.get("envelope") or {})
+            except crypto.CryptoError:
+                continue
+            algo_hex = digest.partition(":")
+            subjects = stmt.get("subject") or []
+            if not any((s.get("digest") or {}).get(algo_hex[0] or "sha256")
+                       == algo_hex[2] for s in subjects):
+                continue  # statement signed for a different image
+            statements.append({"type": stmt.get("predicateType", ""),
+                               "predicate": stmt.get("predicate", {})})
+        if not statements:
             raise VerificationFailed(
-                f"no attestations found for image {opts.image}")
+                f"no verifiable attestations for image {opts.image}")
         return Response(digest=digest, statements=statements)

@@ -73,7 +73,9 @@ class ImageVerificationMetadata:
             except (ValueError, TypeError):
                 existing = {}
         merged = {**existing, **self.data}
-        value = json.dumps(merged, sort_keys=True)
+        # compact separators: the reference marshals with encoding/json
+        # (no spaces), and conformance fixtures assert the exact string
+        value = json.dumps(merged, sort_keys=True, separators=(",", ":"))
         if "metadata" not in resource:
             return {"op": "add", "path": "/metadata",
                     "value": {"annotations": {VERIFY_ANNOTATION: value}}}

@@ -342,9 +342,37 @@ def _check_generate(rule: Dict[str, Any], errs: List[str],
                 break
 
 
+def _check_kinds_resolvable(policy: ClusterPolicy, rule: Dict[str, Any],
+                            kind_resolver, errors: List[str]) -> None:
+    """validKinds (validate.go:1384,1404): every non-wildcard kind must
+    resolve against discovery, and a namespaced Policy cannot match
+    cluster-scoped resources. `kind_resolver(selector)` returns
+    'Namespaced' | 'Cluster' | None (unknown)."""
+    namespaced = policy.raw.get("kind") == "Policy"
+    kinds: List[str] = []
+    for block_name in ("match", "exclude"):
+        block = rule.get(block_name) or {}
+        kinds.extend((block.get("resources") or {}).get("kinds") or [])
+        for rf in (block.get("any") or []) + (block.get("all") or []):
+            kinds.extend((rf.get("resources") or {}).get("kinds") or [])
+    from ..utils.kube import parse_kind_selector
+
+    for k in kinds:
+        if parse_kind_selector(k)[2] == "*":
+            continue  # wildcard KINDS bypass discovery (validateKinds);
+            # 'Foo/*' still resolves Foo
+        scope = kind_resolver(k)
+        if scope is None:
+            errors.append(f"unable to convert GVK to GVR for kinds {k}")
+        elif namespaced and scope == "Cluster":
+            errors.append(f"namespaced policy cannot match cluster-scoped "
+                          f"resource kind {k}")
+
+
 def validate_policy(policy: ClusterPolicy,
                     extra_allowed: Tuple[str, ...] = (),
-                    auth_checker=None) -> Tuple[List[str], List[str]]:
+                    auth_checker=None,
+                    kind_resolver=None) -> Tuple[List[str], List[str]]:
     """Returns (errors, warnings)."""
     errors: List[str] = []
     warnings: List[str] = []
@@ -388,6 +416,8 @@ def validate_policy(policy: ClusterPolicy,
                 f"rule {name!r} must define exactly one of validate/mutate/"
                 f"generate/verifyImages, found {types or 'none'}")
         errors.extend(_check_match_block(rule))
+        if kind_resolver is not None:
+            _check_kinds_resolvable(policy, rule, kind_resolver, errors)
         # validate.go:1459: subresource kinds only invalid for VALIDATE
         # rules under background scanning
         if background and rule.get("validate") is not None:

@@ -16,6 +16,7 @@ container-level exclusions to matching images.
 
 from __future__ import annotations
 
+import re
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..engine.response import RULE_TYPE_VALIDATION, RuleResponse
@@ -300,39 +301,64 @@ def _check_capabilities_restricted(spec, sections) -> List[Violation]:
     return out
 
 
-_BASELINE_CHECKS: List[Tuple[str, Callable]] = [
-    ("Host Namespaces", _check_host_namespaces),
-    ("Privileged Containers", _check_privileged),
-    ("Capabilities", _check_capabilities_baseline),
-    ("HostPath Volumes", _check_host_path),
-    ("Host Ports", _check_host_ports),
-    ("SELinux", _check_selinux),
-    ("/proc Mount Type", _check_proc_mount),
-    ("Seccomp", _check_seccomp_baseline),
-    ("Sysctls", _check_sysctls),
-    ("HostProcess", _check_windows_host_process),
+# (control title, check fn, upstream CheckResult.ID, upstream
+# ForbiddenReason) — ids/reasons per pod-security-admission policy/
+# checks and the reference's PSS_controls_to_check_id
+# (pkg/pss/utils/mapping.go:45)
+_BASELINE_CHECKS: List[Tuple[str, Callable, str, str]] = [
+    ("Host Namespaces", _check_host_namespaces,
+     "hostNamespaces", "host namespaces"),
+    ("Privileged Containers", _check_privileged,
+     "privileged", "privileged"),
+    ("Capabilities", _check_capabilities_baseline,
+     "capabilities_baseline", "non-default capabilities"),
+    ("HostPath Volumes", _check_host_path,
+     "hostPathVolumes", "hostPath volumes"),
+    ("Host Ports", _check_host_ports, "hostPorts", "hostPort"),
+    ("SELinux", _check_selinux, "seLinuxOptions", "seLinuxOptions"),
+    ("/proc Mount Type", _check_proc_mount, "procMount", "procMount"),
+    ("Seccomp", _check_seccomp_baseline,
+     "seccompProfile_baseline", "seccompProfile"),
+    ("Sysctls", _check_sysctls, "sysctls", "forbidden sysctls"),
+    ("HostProcess", _check_windows_host_process,
+     "windowsHostProcess", "hostProcess"),
 ]
 
-_RESTRICTED_CHECKS: List[Tuple[str, Callable]] = _BASELINE_CHECKS + [
-    ("Volume Types", _check_volume_types),
-    ("Privilege Escalation", _check_privilege_escalation),
-    ("Running as Non-root", _check_run_as_non_root),
-    ("Running as Non-root user", _check_run_as_user),
-    ("Seccomp", _check_seccomp_restricted),
-    ("Capabilities", _check_capabilities_restricted),
+_RESTRICTED_CHECKS: List[Tuple[str, Callable, str, str]] = _BASELINE_CHECKS + [
+    ("Volume Types", _check_volume_types,
+     "restrictedVolumes", "restricted volume types"),
+    ("Privilege Escalation", _check_privilege_escalation,
+     "allowPrivilegeEscalation", "allowPrivilegeEscalation != false"),
+    ("Running as Non-root", _check_run_as_non_root,
+     "runAsNonRoot", "runAsNonRoot != true"),
+    ("Running as Non-root user", _check_run_as_user,
+     "runAsUser", "runAsUser=0"),
+    ("Seccomp", _check_seccomp_restricted,
+     "seccompProfile_restricted", "seccompProfile"),
+    ("Capabilities", _check_capabilities_restricted,
+     "capabilities_restricted", "unrestricted capabilities"),
 ]
 
 
 def evaluate_pss(level: str, resource: Dict[str, Any]) -> List[Violation]:
     """Run the control set for ``level`` over a pod-bearing resource."""
+    return [v for v, _, _ in evaluate_pss_detailed(level, resource)]
+
+
+def evaluate_pss_detailed(
+        level: str, resource: Dict[str, Any]
+) -> List[Tuple[Violation, str, str]]:
+    """(violation, check id, upstream forbidden reason) triples — the
+    id/reason pair feeds report properties and the reference-format
+    failure message (evaluate.go:331 FormatChecksPrint)."""
     spec = _pod_spec(resource)
     if spec is None:
         return []
     sections = _sectioned(spec)
     checks = _RESTRICTED_CHECKS if level == "restricted" else _BASELINE_CHECKS
-    out: List[Violation] = []
-    for _, check in checks:
-        out.extend(check(spec, sections))
+    out: List[Tuple[Violation, str, str]] = []
+    for _, check, check_id, reason in checks:
+        out.extend((v, check_id, reason) for v in check(spec, sections))
     return out
 
 
@@ -375,6 +401,26 @@ def _excluded(violation: Violation, resource: Dict[str, Any],
     return False
 
 
+def _indexed_field(resource: Dict[str, Any], field_path: str,
+                   detail: str) -> str:
+    """Replace the '[*]' section wildcard in a violation's
+    restrictedField with the offending container's index (upstream
+    field errors are index-addressed: spec.containers[0]....)."""
+    if "[*]" not in field_path:
+        return field_path
+    m = re.search(r"'([^']+)'", detail)
+    spec = _pod_spec(resource) or {}
+    section = field_path.split(".")[1].split("[")[0]
+    containers = spec.get(section)
+    idx = 0
+    if m and isinstance(containers, list):
+        for i, c in enumerate(containers):
+            if isinstance(c, dict) and c.get("name") == m.group(1):
+                idx = i
+                break
+    return field_path.replace("[*]", f"[{idx}]", 1)
+
+
 def validate_pod_security(rule_name: str, validation, resource: Dict[str, Any],
                           extra_exclusions=None) -> RuleResponse:
     """Entry point used by the engine for validate.podSecurity rules.
@@ -382,11 +428,33 @@ def validate_pod_security(rule_name: str, validation, resource: Dict[str, Any],
     PolicyExceptions (validate_pss.go HasPodSecurity branch)."""
     ps = validation.pod_security or {}
     level = ps.get("level", "baseline")
+    version = ps.get("version", "latest")
     excludes = (ps.get("exclude") or []) + list(extra_exclusions or [])
-    violations = [v for v in evaluate_pss(level, resource) if not _excluded(v, resource, excludes)]
-    if not violations:
+    detailed = [(v, cid, reason)
+                for v, cid, reason in evaluate_pss_detailed(level, resource)
+                if not _excluded(v, resource, excludes)]
+    if not detailed:
         return RuleResponse.rule_pass(rule_name, RULE_TYPE_VALIDATION, "")
-    detail = "; ".join(f"{c}: {d}" for c, d, *_ in violations)
+    # reference failure format (validate_pss.go:107 + evaluate.go:331
+    # FormatChecksPrint): one block per failed upstream check, field
+    # errors index-addressed; properties carry the failed check ids
+    # (report rows assert on both)
+    groups: Dict[str, List[str]] = {}
+    reasons: Dict[str, str] = {}
+    for v, cid, reason in detailed:
+        _, det, _, fpath, _ = v
+        fpath = _indexed_field(resource, fpath, det)
+        err = "Required value" if fpath.endswith(".capabilities.drop") \
+            else "Forbidden"
+        groups.setdefault(cid, []).append(f"{fpath}: {err}")
+        reasons[cid] = reason
+    msg = (f"Validation rule '{rule_name}' failed. It violates PodSecurity "
+           f'"{level}:{version}": ')
+    for cid, errs in groups.items():
+        msg += (f"\n(Forbidden reason: {reasons[cid]}, "
+                f"field error list: [{', '.join(errs)}])")
     return RuleResponse.rule_fail(
-        rule_name, RULE_TYPE_VALIDATION, f"pod security {level!r} checks failed: {detail}"
+        rule_name, RULE_TYPE_VALIDATION, msg,
+        properties={"controls": ",".join(sorted(groups)),
+                    "standard": level, "version": version},
     )

@@ -176,8 +176,15 @@ class Handlers:
                 continue
             names.add(p.name)
         if policy_key is not None:
-            scoped = self._lookup_policy(policy_key).name  # raises KeyError
-            names &= {scoped}
+            scoped = self._lookup_policy(policy_key)  # raises KeyError
+            # verdict rows are keyed by bare policy name; refuse the
+            # fine-grained route when that name is ambiguous rather
+            # than leak another policy's verdicts into the decision
+            if sum(1 for p in policies if p.name == scoped.name) > 1:
+                raise KeyError(
+                    f"policy name {scoped.name!r} is ambiguous across "
+                    f"namespaces; fine-grained routing cannot scope it")
+            names &= {scoped.name}
         return names
 
     def validate(self, review: Dict[str, Any], failure_policy: str = "all",
@@ -234,8 +241,13 @@ class Handlers:
             if payload.operation == "DELETE":
                 self.aggregator.drop(resource_uid(evaluated))
             else:
+                # merge scope = policies the batch actually produced
+                # verdict rows for — NOT the whole failurePolicy class,
+                # which would clobber verify-image rows stored by the
+                # mutate webhook for policies this path never evaluates
+                covered = {pr[0] for pr, _ in verdicts}
                 self.aggregator.put(resource_uid(evaluated), audit_results,
-                                    scope=evaluable)
+                                    scope=covered)
         self.metrics.admission_duration.observe(time.perf_counter() - t0,
                                                 {"path": "validate"})
         if block_msgs:

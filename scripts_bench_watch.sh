@@ -1,0 +1,35 @@
+#!/bin/bash
+# Round-5 bench watcher: the TPU tunnel wedges for hours at a time
+# (BENCH_r03/r04 both died on backend-unavailable). Loop all round:
+# probe in a throwaway subprocess; when the tunnel is alive run the
+# full bench and snapshot the artifact; sleep and repeat so the
+# artifact tracks the newest code. Log: bench_watch.log
+cd /root/repo
+N=0
+while true; do
+  N=$((N+1))
+  echo "=== attempt $N $(date -u +%H:%M:%S) probe ===" >> bench_watch.log
+  if timeout 300 python bench.py _probe >> bench_watch.log 2>&1; then
+    echo "=== probe ok, running full bench ===" >> bench_watch.log
+    BENCH_SKIP_PROBE=1 timeout 3600 python bench.py all > bench_run.out 2> bench_run.err
+    tail -n 1 bench_run.out > BENCH_candidate.json
+    if python - <<'EOF'
+import json,sys
+d=json.load(open('/root/repo/BENCH_candidate.json'))
+sys.exit(0 if d.get('value',0)>0 and 'error' not in d else 1)
+EOF
+    then
+      cp BENCH_candidate.json BENCH_manual_r05.json
+      echo "=== bench SUCCESS $(date -u +%H:%M:%S) ===" >> bench_watch.log
+      tail -c 2000 BENCH_manual_r05.json >> bench_watch.log
+      sleep 4800
+    else
+      echo "=== bench ran but artifact bad ===" >> bench_watch.log
+      tail -c 1500 bench_run.err >> bench_watch.log
+      sleep 600
+    fi
+  else
+    echo "=== probe failed/timeout ===" >> bench_watch.log
+    sleep 600
+  fi
+done

@@ -26,8 +26,12 @@ from kyverno_tpu.images import (
     validate_image,
 )
 
-KEY_A = "-----BEGIN PUBLIC KEY-----\nAAA\n-----END PUBLIC KEY-----"
-KEY_B = "-----BEGIN PUBLIC KEY-----\nBBB\n-----END PUBLIC KEY-----"
+# real ECDSA key pairs: policies reference the public PEM, the registry
+# fixture signs with the private half
+from kyverno_tpu.images.crypto import generate_keypair
+
+PRIV_A, KEY_A = generate_keypair()
+PRIV_B, KEY_B = generate_keypair()
 DIGEST = "sha256:" + "ab" * 32
 
 
@@ -146,7 +150,7 @@ def test_cache_ttl_and_eviction():
 def make_registry():
     reg = StaticRegistry()
     reg.add_image("ghcr.io/org/app:v1", DIGEST)
-    reg.sign("ghcr.io/org/app:v1", key=KEY_A)
+    reg.sign("ghcr.io/org/app:v1", key=PRIV_A)
     return reg
 
 
@@ -217,7 +221,7 @@ def test_keyless_subject_issuer_and_nested_attestor():
 def test_attestations_with_conditions():
     reg = make_registry()
     reg.attest("ghcr.io/org/app:v1", "https://slsa.dev/provenance/v0.2",
-               {"builder": {"id": "https://github.com/actions"}}, key=KEY_A)
+               {"builder": {"id": "https://github.com/actions"}}, key=PRIV_A)
     iv = {"imageReferences": ["ghcr.io/org/*"],
           "attestations": [{
               "type": "https://slsa.dev/provenance/v0.2",
@@ -372,7 +376,7 @@ def test_annotation_patch_on_metadata_less_resource():
     ivm.add("img:1", "pass")
     patch = ivm.annotation_patch({"kind": "Thing"})
     assert patch == {"op": "add", "path": "/metadata", "value": {
-        "annotations": {VERIFY_ANNOTATION: json.dumps({"img:1": "pass"})}}}
+        "annotations": {VERIFY_ANNOTATION: json.dumps({"img:1": "pass"}, separators=(",", ":"))}}}
     from kyverno_tpu.engine.mutate import apply_json6902
     patched = apply_json6902({"kind": "Thing"}, [patch])
     assert VERIFY_ANNOTATION in patched["metadata"]["annotations"]
@@ -409,3 +413,94 @@ def test_skip_image_references_applies_to_attestation_only_rules():
     resp = run(vi_policy(iv), pod(), reg)
     [rr] = resp.policy_response.rules
     assert rr.status == "skip"
+
+
+# ---------------------------------------------------------------------------
+# envelope cryptography (cosign.go payload verify, DSSE/in-toto)
+
+
+def test_tampered_payload_fails_verification():
+    import base64
+
+    reg = make_registry()
+    entry = reg.images["ghcr.io/org/app:v1"]
+    payload = json.loads(base64.b64decode(entry["signatures"][0]["payload"]))
+    payload["critical"]["image"]["docker-manifest-digest"] = \
+        "sha256:" + "cd" * 32
+    entry["signatures"][0]["payload"] = base64.b64encode(
+        json.dumps(payload, sort_keys=True,
+                   separators=(",", ":")).encode()).decode()
+    iv = {"imageReferences": ["ghcr.io/org/*"],
+          "attestors": [{"entries": [{"keys": {"publicKeys": KEY_A}}]}]}
+    resp = run(vi_policy(iv), pod(), reg)
+    assert not resp.is_successful()  # signature no longer verifies
+
+
+def test_signed_payload_digest_must_bind_manifest():
+    # valid signature over a payload binding a DIFFERENT digest: the
+    # envelope verifies but the digest binding check must reject it
+    from kyverno_tpu.images import crypto as ic
+    import base64
+
+    reg = StaticRegistry()
+    reg.add_image("ghcr.io/org/app:v1", DIGEST)
+    wrong = ic.simple_signing_payload("ghcr.io/org/app",
+                                      "sha256:" + "cd" * 32)
+    sig = ic.sign_blob(PRIV_A, wrong)
+    reg.images["ghcr.io/org/app:v1"]["signatures"] = [{
+        "payload": base64.b64encode(wrong).decode(),
+        "signature": base64.b64encode(sig).decode(),
+        "cert": "", "type": "Cosign"}]
+    iv = {"imageReferences": ["ghcr.io/org/*"],
+          "attestors": [{"entries": [{"keys": {"publicKeys": KEY_A}}]}]}
+    resp = run(vi_policy(iv), pod(), reg)
+    assert not resp.is_successful()
+    assert "digest mismatch" in resp.policy_response.rules[0].message
+
+
+def test_tampered_attestation_predicate_fails():
+    import base64
+
+    reg = make_registry()
+    reg.attest("ghcr.io/org/app:v1", "https://slsa.dev/provenance/v0.2",
+               {"builder": {"id": "https://github.com/actions"}}, key=PRIV_A)
+    env = reg.images["ghcr.io/org/app:v1"]["attestations"][0]["envelope"]
+    stmt = json.loads(base64.b64decode(env["payload"]))
+    stmt["predicate"]["builder"]["id"] = "https://evil.example"
+    env["payload"] = base64.b64encode(
+        json.dumps(stmt, sort_keys=True, separators=(",", ":")).encode()
+    ).decode()
+    iv = {"imageReferences": ["ghcr.io/org/*"],
+          "attestations": [{
+              "type": "https://slsa.dev/provenance/v0.2",
+              "attestors": [{"entries": [{"keys": {"publicKeys": KEY_A}}]}],
+              "conditions": [{"all": [{
+                  "key": "{{ builder.id }}", "operator": "Equals",
+                  "value": "https://evil.example"}]}]}]}
+    # the tampered predicate WOULD satisfy the condition, but the DSSE
+    # signature no longer verifies -> the envelope is discarded
+    assert not run(vi_policy(iv), pod(), reg).is_successful()
+
+
+def test_keyless_untrusted_ca_rejected():
+    from kyverno_tpu.images import crypto as ic
+
+    reg = StaticRegistry()
+    reg.add_image("ghcr.io/org/app:v1", DIGEST)
+    reg.sign("ghcr.io/org/app:v1",
+             subject="https://github.com/org/repo/wf@refs/heads/main",
+             issuer="https://token.actions.githubusercontent.com")
+    _, other_root = ic.make_ca("someone else's CA")
+    iv = {"imageReferences": ["ghcr.io/org/*"],
+          "attestors": [{"entries": [{"keyless": {
+              "subject": "https://github.com/org/*",
+              "issuer": "https://token.actions.githubusercontent.com",
+              "roots": other_root}}]}]}
+    assert not run(vi_policy(iv), pod(), reg).is_successful()
+    # with the registry's own CA as roots it verifies
+    iv_ok = {"imageReferences": ["ghcr.io/org/*"],
+             "attestors": [{"entries": [{"keyless": {
+                 "subject": "https://github.com/org/*",
+                 "issuer": "https://token.actions.githubusercontent.com",
+                 "roots": reg.ca_roots}}]}]}
+    assert run(vi_policy(iv_ok), pod(), reg).is_successful()

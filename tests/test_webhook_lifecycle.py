@@ -45,8 +45,8 @@ def test_webhook_config_from_policies_and_failure_policy_split():
     assert gen.reconcile(ca_bundle="CA") is True
     cfg = gen.configs["validating"]
     byname = {w["name"]: w for w in cfg["webhooks"]}
-    fail = byname["resource-validating-fail.kyverno.svc"]
-    ignore = byname["resource-validating-ignore.kyverno.svc"]
+    fail = byname["validate.kyverno.svc-fail"]
+    ignore = byname["validate.kyverno.svc-ignore"]
     assert fail["failurePolicy"] == "Fail"
     assert ignore["failurePolicy"] == "Ignore"
     # pods imply pods/ephemeralcontainers (utils.go:81-84); the cache
@@ -58,7 +58,7 @@ def test_webhook_config_from_policies_and_failure_policy_split():
     assert "deployments" in apps["resources"]
     [irule] = ignore["rules"]
     assert irule["apiGroups"] == ["apps"] and irule["resources"] == ["deployments"]
-    assert fail["clientConfig"]["url"].endswith("/validate/fail")
+    assert fail["clientConfig"]["service"]["path"] == "/validate/fail"
     assert fail["clientConfig"]["caBundle"] == "CA"
 
 
@@ -87,8 +87,8 @@ def test_fine_grained_webhook_per_policy():
     gen = WebhookConfigGenerator(cache)
     gen.reconcile()
     [wh] = gen.configs["validating"]["webhooks"]
-    assert wh["name"] == "resource-validating-fail-special.kyverno.svc"
-    assert wh["clientConfig"]["url"].endswith("/validate/fail/finegrained/special")
+    assert wh["name"] == "validate.kyverno.svc-fail-finegrained-special"
+    assert wh["clientConfig"]["service"]["path"] == "/validate/fail/finegrained/special"
 
 
 def test_mutating_config_covers_mutate_and_verify_images():
@@ -99,7 +99,7 @@ def test_mutating_config_covers_mutate_and_verify_images():
     cfg = gen.configs["mutating"]
     assert cfg["kind"] == "MutatingWebhookConfiguration"
     [wh] = cfg["webhooks"]
-    assert wh["clientConfig"]["url"].endswith("/mutate/fail")
+    assert wh["clientConfig"]["service"]["path"] == "/mutate/fail"
 
 
 # ---------------------------------------------------------------------------
@@ -167,9 +167,13 @@ def test_parse_kind_subresource_and_gctx_unsubscribe():
     from kyverno_tpu.cluster.snapshot import ClusterSnapshot
     from kyverno_tpu.globalcontext import GlobalContextStore
 
-    assert _parse_kind("Pod/exec") == ("", "*", "pods/exec")
-    assert _parse_kind("apps/v1/Deployment") == ("apps", "v1", "deployments")
-    assert _parse_kind("Pod") == ("", "*", "pods")
+    assert _parse_kind("Pod/exec") == ("", "v1", ["pods/exec"], "Namespaced")
+    assert _parse_kind("apps/v1/Deployment") == \
+        ("apps", "v1", ["deployments"], "Namespaced")
+    assert _parse_kind("Pod") == ("", "v1", ["pods"], "Namespaced")
+    assert _parse_kind("*", policy_scope="Namespaced") == \
+        ("*", "*", ["*"], "Namespaced")
+    assert _parse_kind("CustomResourceDefinition")[3] == "*"
     # reconciling the same gctx entry twice must not leak subscribers
     snap = ClusterSnapshot()
     store = GlobalContextStore(snapshot=snap)
