@@ -206,6 +206,19 @@ class TpuEngine:
         total = np.full((len(self.cps.rules), n), NOT_MATCHED, dtype=np.int32)
         ns_labels = namespace_labels or {}
 
+        # requests whose identity strings carry globs defeat the
+        # device's hash-equality userInfo lanes (_set_in matches
+        # wildcards in either direction) -> per-cell host completion
+        glob_identity_cis: List[int] = []
+        if admission_infos:
+            from ..utils.wildcard import contains_wildcard
+
+            for ci in range(n):
+                info = admission_infos[ci] if ci < len(admission_infos) else None
+                if info is not None and any(
+                        contains_wildcard(g) for g in (info.groups or [])):
+                    glob_identity_cis.append(ci)
+
         # which (policy, resource) pairs need the scalar engine?
         host_cells: Dict[Tuple[int, int], None] = {}
         for ri, entry in enumerate(self.cps.rules):
@@ -213,7 +226,10 @@ class TpuEngine:
                 for ci in range(n):
                     host_cells[(entry.policy_idx, ci)] = None
             else:
-                row = device_table[entry.device_row]
+                row = device_table[entry.device_row].copy()
+                if glob_identity_cis and self.cps.device_programs[
+                        entry.device_row].uses_userinfo:
+                    row[glob_identity_cis] = HOST
                 total[ri] = row
                 for ci in np.nonzero(row == HOST)[0]:
                     host_cells[(entry.policy_idx, int(ci))] = None

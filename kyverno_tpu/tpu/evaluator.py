@@ -67,6 +67,7 @@ from .ir import (
     NullLeaf,
     NumLeaf,
     OpKey,
+    UserInfoKey,
     PathCollect,
     PathState,
     RuleProgram,
@@ -722,6 +723,9 @@ def eval_cond(
     op = _op_canon(ir.op)
     if isinstance(ir.key, OpKey):
         return _expand(ctx, scope, _eval_op_cond(ctx, ir.key, op, ir.value)), zero_err
+    if isinstance(ir.key, UserInfoKey):
+        return _expand(ctx, scope,
+                       _eval_userinfo_cond(ctx, ir.key, op, ir.value)), zero_err
     if isinstance(ir.key, LiteralKey):
         if isinstance(ir.value, ElementCollect):
             return _eval_literal_vs_collect(ctx, scope, prefix, ir.key.value, op, ir.value)
@@ -766,6 +770,36 @@ def _eval_literal_vs_collect(
             rows = rows | m
         hit = scope.any(rows)
     return (hit if mode in ("any_in", "all_in") else ~hit), err
+
+
+def _eval_userinfo_cond(ctx: Ctx, key: UserInfoKey, op: str,
+                        value: Any) -> jnp.ndarray:
+    """{{ request.userInfo.<field> }} membership against a literal
+    string list — per-lane hash equality over the RBAC identity lanes,
+    mirroring conditions.py _set_in for glob-free values (vacuous
+    truths on empty identity lists included)."""
+    lane, n_lane, tag = {
+        "groups": ("groups_h", "groups_n", "u"),
+        "roles": ("roles_h", "roles_n", "r"),
+        "clusterRoles": ("croles_h", "croles_n", "r"),
+    }[key.field]
+    arr = ctx.b["meta_" + lane]          # (N, L, 2)
+    n = ctx.b["meta_" + n_lane]
+    L = arr.shape[1]
+    live = jnp.arange(L, dtype=np.int32)[None, :] < n[:, None]
+    hit = jnp.zeros(arr.shape[:2], dtype=bool)
+    for v in value:
+        hi, lo = split32(hash_str(v, tag=tag))
+        hit = hit | ((arr[..., 0] == np.uint32(hi))
+                     & (arr[..., 1] == np.uint32(lo)))
+    mode = _IN_MODES[op]
+    if mode == "any_in":
+        return (live & hit).any(-1)
+    if mode == "all_in":
+        return (~live | hit).all(-1)
+    if mode == "any_not_in":
+        return (live & ~hit).any(-1)
+    return (~live | ~hit).all(-1)  # all_not_in
 
 
 def _eval_op_cond(ctx: Ctx, key: OpKey, op: str, value: Any) -> jnp.ndarray:
@@ -886,7 +920,10 @@ def _scalar_membership_const(default: Any, literals: List[Any], mode: str) -> bo
     """Host-computed membership result when the || default kicks in
     (exact conditions.py semantics via the scalar oracle)."""
     from ..engine.conditions import _deprecated_in, _membership
+    from .ir import _NullDefault
 
+    if isinstance(default, _NullDefault):
+        default = None
     if mode in ("in_strict", "notin_strict"):
         return _deprecated_in(default, list(literals),
                               not_in=(mode == "notin_strict"))
@@ -902,8 +939,10 @@ def _eval_path_cond(
     # a bare {{ request.object... }} chain with NO || default raises
     # VariableNotFoundError when the path is absent (forked go-jmespath
     # behavior pinned by the reference corpus) -> rule ERROR. A null
-    # VALUE is a present row (T_NULL) and does not error.
-    if (pc.default is None and not pc.is_projection
+    # VALUE is a present row (T_NULL) and does not error. not_null()
+    # keys never error: the function absorbs missing paths.
+    if (pc.default is None and pc.default_collect is None
+            and not pc.default_null_only and not pc.is_projection
             and len(pc.states) == 1 and pc.states[0].mode == "value"):
         exists = scope.any(ctx.rows_at(prefix + pc.states[0].segs))
         err = err | ~exists
@@ -954,7 +993,7 @@ def _eval_path_cond(
                                       is_scalar & ~hit),
         }[mode]
         if pc.default is not None:
-            falsy = _scalar_falsy(ctx, mask, scope)
+            falsy = _default_falsy(ctx, pc, mask, scope)
             const = _scalar_membership_const(pc.default, value if isinstance(value, list) else [value], mode)
             res = jnp.where(falsy, const, res)
         return res, err
@@ -963,14 +1002,79 @@ def _eval_path_cond(
             # list-vs-literal deep equality: lists never equal scalars;
             # only list literals could match — unsupported at compile
             res = jnp.zeros(shape, dtype=bool)
-        else:
-            res = _eval_scalar_equals(ctx, pc, value, scope, prefix)
-        return (~res if op == "notequals" else res), err
+            return (~res if op == "notequals" else res), err
+        res = _eval_scalar_eqop(ctx, pc, op, value, scope, prefix)
+        res = _apply_scalar_default(
+            ctx, pc, scope, prefix, res,
+            lambda dpc: _eval_scalar_eqop(ctx, dpc, op, value, scope, prefix),
+            lambda d: _const_cond(d, op, value))
+        return res, err
     if op in _NUM_OPS:
         if pc.is_projection:
             return jnp.zeros(shape, dtype=bool), err
-        return _eval_scalar_numeric(ctx, pc, _NUM_OPS[op], value, scope, prefix), err
+        res = _eval_scalar_numeric(ctx, pc, _NUM_OPS[op], value, scope, prefix)
+        res = _apply_scalar_default(
+            ctx, pc, scope, prefix, res,
+            lambda dpc: _eval_scalar_numeric(ctx, dpc, _NUM_OPS[op], value,
+                                             scope, prefix),
+            lambda d: _const_cond(d, op, value))
+        return res, err
     return jnp.zeros(shape, dtype=bool), err
+
+
+def _const_cond(key: Any, op: str, value: Any) -> bool:
+    """Host-folded condition on a constant key (the default arm)."""
+    from ..engine.conditions import evaluate_condition_values
+    from .ir import _NullDefault
+
+    if isinstance(key, _NullDefault):
+        key = None
+    try:
+        return bool(evaluate_condition_values(key, op, value))
+    except Exception:  # noqa: BLE001
+        return False
+
+
+def _eval_scalar_eqop(ctx: Ctx, pc: PathCollect, op: str, value: Any,
+                      scope, prefix: Tuple[str, ...]) -> jnp.ndarray:
+    """equals/notequals on a scalar chain, with the oracle's null-key
+    rule: NotEquals on a nil/missing key is FALSE, not the negation
+    (notequal.go:47-49 — unsupported key types evaluate false)."""
+    res = _eval_scalar_equals(ctx, pc, value, scope, prefix)
+    if op != "notequals":
+        return res
+    st = pc.states[0]
+    mask = ctx.rows_at(prefix + st.segs)
+    null_or_missing = (~scope.any(mask)) | scope.any(mask & ctx.type_is(T_NULL))
+    return ~res & ~null_or_missing
+
+
+def _default_falsy(ctx: Ctx, pc: PathCollect, mask: jnp.ndarray,
+                   scope) -> jnp.ndarray:
+    """When does this key's default arm fire: jmespath `||` on any
+    falsy value; not_null() on null/missing only."""
+    if pc.default_null_only:
+        exists = scope.any(mask)
+        null = scope.any(mask & ctx.type_is(T_NULL))
+        return (~exists) | null
+    return _scalar_falsy(ctx, mask, scope)
+
+
+def _apply_scalar_default(ctx: Ctx, pc: PathCollect, scope,
+                          prefix: Tuple[str, ...], res: jnp.ndarray,
+                          eval_chain, eval_const) -> jnp.ndarray:
+    """Route a scalar-chain key through its default arm (literal
+    constant or not_null's second chain) where the primary chain is
+    falsy/null."""
+    if pc.default is None and pc.default_collect is None:
+        return res
+    mask = ctx.rows_at(prefix + pc.states[0].segs)
+    falsy = _default_falsy(ctx, pc, mask, scope)
+    if pc.default_collect is not None:
+        alt = eval_chain(pc.default_collect)
+    else:
+        alt = jnp.full(res.shape, eval_const(pc.default))
+    return jnp.where(falsy, alt, res)
 
 
 def _jcmp(kind: str, val, const: float, canon_eq) -> jnp.ndarray:

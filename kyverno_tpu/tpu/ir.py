@@ -59,6 +59,16 @@ class Unsupported(Exception):
     """Construct outside the device subset -> host fallback."""
 
 
+class _NullDefault:
+    """Sentinel: a `|| <always-null>` default arm (key becomes null)."""
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return "NULL_DEFAULT"
+
+
+NULL_DEFAULT = _NullDefault()
+
+
 # ---------------------------------------------------------------------------
 # scalar leaf IR (pattern.Validate lowering)
 
@@ -429,6 +439,15 @@ class OpKey:
 
 
 @dataclass
+class UserInfoKey:
+    """key == {{ request.userInfo.groups|roles|clusterRoles }} — the
+    per-request RBAC identity, already encoded as hash lanes for
+    match/exclude subjects (metadata.py groups_h/roles_h/croles_h)."""
+
+    field: str  # groups | roles | clusterRoles
+
+
+@dataclass
 class LiteralKey:
     """A non-variable condition key (foreach deny's `key: ALL`)."""
 
@@ -462,6 +481,11 @@ class PathCollect:
     # element paths keys(@) was applied to: non-map elements there make
     # the whole condition a query error -> rule ERROR
     keys_error_states: List[PathState] = field(default_factory=list)
+    # not_null(chain, default) semantics: the default fires on
+    # null/missing only (context-loader defaults), not jmespath falsy
+    default_null_only: bool = False
+    # not_null(chain, other.chain): a second path chain as the default
+    default_collect: Optional["PathCollect"] = None
 
 
 @dataclass
@@ -507,6 +531,9 @@ class ConditionCompiler:
     def __init__(self, element_mode: bool = False) -> None:
         self._parser = JmesParser()
         self.element_mode = element_mode
+        # set when a compiled key reads the request identity lanes —
+        # glob-bearing runtime identities then route to host per cell
+        self.saw_userinfo = False
 
     def compile_tree(self, conditions: Any) -> Optional[CondTreeIR]:
         """None/empty conditions -> None (always pass)."""
@@ -580,6 +607,22 @@ class ConditionCompiler:
                     raise Unsupported("possible semver comparison value")
         if isinstance(value, ElementCollect):
             raise Unsupported("element value with non-literal key")
+        if isinstance(key_ir, PathCollect) and key_ir.default_collect is not None \
+                and op not in ("equals", "equal", "notequals", "notequal",
+                               "greaterthan", "greaterthanorequals",
+                               "lessthan", "lessthanorequals"):
+            raise Unsupported("chain default with membership operator")
+        if isinstance(key_ir, UserInfoKey):
+            # list-key membership only, against glob-free string lists
+            # (hash-lane equality mirrors _set_in exactly then)
+            if op not in ("anyin", "allin", "anynotin", "allnotin"):
+                raise Unsupported("userInfo key with non-membership operator")
+            if not (isinstance(value, list)
+                    and all(isinstance(v, str) and not contains_wildcard(v)
+                            and get_operator_from_string_pattern(v)
+                            not in (Operator.IN_RANGE, Operator.NOT_IN_RANGE)
+                            for v in value)):
+                raise Unsupported("userInfo key with non-literal-list value")
         if op in ("in", "notin"):
             if not isinstance(value, list):
                 # string values carry wildcard/JSON-decode semantics
@@ -718,12 +761,51 @@ class ConditionCompiler:
         default: Optional[Any] = None
         if ast[0] == "or":
             lhs, rhs = ast[1], ast[2]
-            if rhs[0] != "literal":
+            if rhs == ("field", ""):
+                # `|| ""` — a quoted EMPTY IDENTIFIER, not a string
+                # literal: evaluates to a root field named "", i.e.
+                # always null (a corpus-pinned authoring idiom)
+                default = NULL_DEFAULT
+            elif rhs[0] != "literal":
                 raise Unsupported("non-literal || default")
-            default = rhs[1]
+            else:
+                default = rhs[1]
             ast = lhs
         if ast == ("subexpression", ("field", "request"), ("field", "operation")):
             return OpKey(default if isinstance(default, (str, type(None))) else None)
+        if ast[0] == "function" and ast[1] == "not_null" and default is None \
+                and len(ast[2]) == 2:
+            # not_null(chain, default): loader-default (null-only)
+            # semantics; the default may itself be a scalar chain
+            first, second = ast[2]
+            self._keys_error_states = []
+            states, roots, is_proj = self._walk(first)
+            if is_proj:
+                raise Unsupported("not_null over a projection")
+            if second[0] == "literal":
+                return PathCollect(states, roots, False, second[1],
+                                   keys_error_states=self._keys_error_states,
+                                   default_null_only=True)
+            err_states = self._keys_error_states
+            self._keys_error_states = []
+            dstates, droots, dproj = self._walk(second)
+            if dproj:
+                raise Unsupported("not_null default projection")
+            dflt = PathCollect(dstates, droots, False, None,
+                               keys_error_states=self._keys_error_states)
+            return PathCollect(states, roots, False, None,
+                               keys_error_states=err_states,
+                               default_null_only=True,
+                               default_collect=dflt)
+        # groups is the only identity list the request context exposes
+        # under request.userInfo (roles/clusterRoles are separate
+        # context keys in the reference and error here — host handles)
+        if ast == ("subexpression",
+                   ("subexpression", ("field", "request"),
+                    ("field", "userInfo")), ("field", "groups")) \
+                and default is None:
+            self.saw_userinfo = True
+            return UserInfoKey("groups")
         self._keys_error_states: List[PathState] = []
         states, roots, is_proj = self._walk(ast)
         return PathCollect(states, roots, is_proj, default,
@@ -1049,74 +1131,278 @@ class RuleProgram:
     message: str = ""
     # set when this rule cannot run on device
     fallback_reason: Optional[str] = None
+    # reads request.userInfo identity lanes (hash equality): requests
+    # whose identity strings carry globs divert to host per cell
+    uses_userinfo: bool = False
 
 
 _FOLD_VAR_RE = re.compile(r"\{\{\s*([^{}]+?)\s*\}\}")
 _FOLD_ROOT_RE = re.compile(r"^([A-Za-z_][A-Za-z_0-9]*)")
 
 
+def _root_refs(ast: Tuple, out: Set[str]) -> None:
+    """Collect the ROOT identifiers a jmespath AST reads from the
+    evaluation context (rhs fields of subexpressions / projections /
+    pipes operate on intermediate values, not the root). Unknown
+    constructs poison the set with '?'."""
+    kind = ast[0]
+    if kind == "field":
+        out.add(ast[1])
+    elif kind in ("literal", "identity", "index", "flatten_marker"):
+        pass
+    elif kind == "current":
+        out.add("@")
+    elif kind == "index_expression":
+        # child is a [left, index] LIST; the left node holds the root
+        _root_refs(ast[1][0], out)
+    elif kind in ("subexpression", "value_projection", "filter_projection",
+                  "pipe", "flatten", "not", "projection"):
+        _root_refs(ast[1], out)
+    elif kind in ("or", "and"):
+        _root_refs(ast[1], out)
+        _root_refs(ast[2], out)
+    elif kind == "comparator":
+        _root_refs(ast[2], out)
+        _root_refs(ast[3], out)
+    elif kind == "function":
+        for a in ast[2]:
+            _root_refs(a, out)
+    elif kind == "multiselect_list":
+        for a in ast[1]:
+            _root_refs(a, out)
+    elif kind == "multiselect_dict":
+        for _k, a in ast[1]:
+            _root_refs(a, out)
+    else:
+        out.add("?")
+
+
+# roots the engine itself provides — references to these are dynamic
+# but not context-entry references
+_BUILTIN_ROOTS = {"request", "element", "elementIndex", "images", "@",
+                  "serviceAccountName", "serviceAccountNamespace"}
+
+_CHAIN_REF_RE = re.compile(r"^([A-Za-z_][A-Za-z_0-9]*)((?:\.[A-Za-z_0-9\-]+)*)$")
+
+
+def _jmes_literal(v: Any) -> Optional[str]:
+    """Render a Python literal as jmespath literal syntax."""
+    import json as _json
+
+    if isinstance(v, str) and "'" not in v and "`" not in v:
+        return f"'{v}'"
+    try:
+        return "`" + _json.dumps(v) + "`"
+    except Exception:  # noqa: BLE001
+        return None
+
+
 def _fold_static_context(rule: Rule, data_sources=None,
                          deps: Optional[Dict[str, Optional[str]]] = None) -> Optional[Rule]:
-    """Constant-fold context entries that are compile-time constants,
-    so every {{ name... }} occurrence in the rule body substitutes
-    away and the rule lowers like a context-free one:
+    """Compile-time context specialization. Each context entry resolves
+    to one of three forms, and every ``{{ name... }}`` reference in the
+    rule body substitutes accordingly, so the rule lowers like a
+    context-free one:
 
-    - `variable` entries with an explicit literal `value`;
-    - `configMap` entries with literal name/namespace, resolved
-      against ``data_sources`` (compile-time context specialization —
-      the caller records each configmap consumed in ``deps`` and must
-      recompile when its content hash moves).
+    - **constant**: `variable` entries whose value/jmesPath close over
+      literals (and earlier constants — chained entries fold through
+      the full jmespath engine, custom functions included), and
+      `configMap` entries resolved against ``data_sources``
+      (dependencies recorded in ``deps`` for recompilation when the
+      configmap's content hash moves);
+    - **template tree**: `variable.value` trees whose leaves still
+      contain ``{{ request... }}`` templates — references navigate the
+      tree and splice the underlying template;
+    - **expression**: `variable.jmesPath` specs that read the live
+      request context — references inline the expression text (with
+      ``|| default`` for literal or template defaults), exactly what
+      the deferred loader would evaluate per request.
 
-    Anything else (request-reading jmesPath specs, referenced
-    entries, apiCall/imageRegistry) returns None — dynamic context
-    stays host-only."""
+    apiCall/imageRegistry/globalReference entries stay dynamic; the
+    rule only falls back when such an entry is actually REFERENCED
+    (unreferenced entries are dropped, matching deferred-loading
+    semantics). Returns None when any reference cannot be resolved."""
     import json as _json
 
     from ..engine.context import Context
     from ..engine.contextloaders import _load_configmap, _load_variable
     from ..engine.jmespath import compile as jp_compile
 
-    env: Dict[str, Any] = {}
+    parser = JmesParser()
+    env: Dict[str, Any] = {}        # fully-resolved constants
+    trees: Dict[str, Any] = {}      # value trees w/ embedded templates
+    exprs: Dict[str, str] = {}      # live-context expression text
+    entry_names: Set[str] = set()
     local_deps: Dict[str, Optional[str]] = {}
+
+    def is_pure(v: Any) -> bool:
+        return "{{" not in _json.dumps(v, default=str)
+
+    def resolve_expr_text(text: str) -> Optional[str]:
+        """Substitute {{const}} references inside an expression TEXT
+        (e.g. a jmesPath spec referencing an earlier constant)."""
+        out = text
+        for m in reversed(list(_FOLD_VAR_RE.finditer(text))):
+            inner = m.group(1).strip()
+            roots: Set[str] = set()
+            try:
+                _root_refs(parser.parse(inner), roots)
+            except Exception:  # noqa: BLE001
+                return None
+            if not roots <= set(env):
+                return None
+            try:
+                val = jp_compile(inner).search(env)
+            except Exception:  # noqa: BLE001
+                return None
+            if not isinstance(val, str):
+                return None
+            out = out[:m.start()] + val + out[m.end():]
+        return out
+
+    def navigate(tree: Any, suffix: str) -> Any:
+        """Walk a template tree by a .a.b identifier chain."""
+        cur = tree
+        for seg in [s for s in suffix.split(".") if s]:
+            if isinstance(cur, dict) and seg in cur:
+                cur = cur[seg]
+            else:
+                return None  # loader: missing path -> null
+        return cur
+
     for entry in rule.context:
         if not isinstance(entry, dict):
             return None
         name = entry.get("name")
-        if not name:
+        if not name or name in _BUILTIN_ROOTS:
             return None
+        entry_names.add(name)
+        # an overriding entry drops the previous resolution
+        env.pop(name, None)
+        trees.pop(name, None)
+        exprs.pop(name, None)
         spec = entry.get("variable")
         cm_spec = entry.get("configMap")
         if isinstance(spec, dict):
-            # static iff an explicit literal `value` is present: the
-            # loader then evaluates any jmesPath against THAT value. A
-            # jmesPath-only spec reads the live context (request.*) —
-            # on an empty Context it would silently collapse to its
-            # default arm and bake a WRONG constant in — so it stays
-            # dynamic.
-            if spec.get("value") is None:
-                return None
-            if "{{" in _json.dumps(spec, default=str):
-                return None  # references other context -> dynamic
+            value = spec.get("value")
+            jmes = spec.get("jmesPath")
+            default = spec.get("default")
+            if value is not None:
+                if is_pure(spec):
+                    try:
+                        env[name] = _load_variable(Context(), spec)
+                    except Exception:  # noqa: BLE001
+                        pass  # stays unresolved; fails only if referenced
+                    continue
+                val = _subst_const_templates(value, env, jp_compile, parser)
+                if jmes is not None:
+                    # the loader evaluates jmesPath AGAINST the value
+                    # tree; identifier chains navigate it structurally
+                    jtext = resolve_expr_text(jmes) if "{{" in jmes else jmes
+                    if jtext is None or not _CHAIN_REF_RE.match(jtext):
+                        continue  # unresolved
+                    val = navigate({"_": val}, "_." + jtext)
+                if val is None and default is not None:
+                    # loader: a null navigation result takes the default
+                    if not is_pure(default):
+                        continue  # template default on a tree: dynamic
+                    val = default
+                if val is not None and is_pure(val):
+                    env[name] = val
+                else:
+                    trees[name] = val
+                continue
+            if jmes is None:
+                continue  # unresolved shape
+            jtext = resolve_expr_text(jmes) if "{{" in jmes else jmes
+            if jtext is None or "{{" in jtext:
+                continue  # unresolved
+            roots: Set[str] = set()
             try:
-                env[name] = _load_variable(Context(), spec)
-            except Exception:
-                return None
+                _root_refs(parser.parse(jtext), roots)
+            except Exception:  # noqa: BLE001
+                continue
+            if roots <= set(env):
+                # closes over earlier constants -> fold fully (custom
+                # functions run through the real engine)
+                try:
+                    val = jp_compile(jtext).search(env)
+                except Exception:  # noqa: BLE001
+                    val = None
+                if val is None and default is not None and is_pure(default):
+                    val = default
+                env[name] = val
+                continue
+            if default is None:
+                exprs[name] = jtext
+            else:
+                # the loader's default fires on null/missing ONLY
+                # (contextloaders.py _load_variable), which is exactly
+                # not_null() — NOT jmespath `||` (falsy) semantics
+                if isinstance(default, str) and "{{" in default:
+                    m = _FOLD_VAR_RE.fullmatch(default.strip())
+                    if m is None:
+                        continue  # partial template default
+                    exprs[name] = f"not_null({jtext}, {m.group(1).strip()})"
+                else:
+                    lit = _jmes_literal(default)
+                    if lit is None:
+                        continue
+                    exprs[name] = f"not_null({jtext}, {lit})"
         elif isinstance(cm_spec, dict):
             if data_sources is None or data_sources.configmaps is None:
-                return None
+                continue
             if "{{" in _json.dumps(cm_spec, default=str):
-                return None  # per-request namespace/name -> dynamic
+                continue  # per-request namespace/name -> dynamic
             try:
                 env[name] = _load_configmap(Context(), cm_spec, data_sources)
-            except Exception:
-                return None
+            except Exception:  # noqa: BLE001
+                continue
             from ..cluster.snapshot import resource_hash
 
             key = (f"{cm_spec.get('namespace', '') or 'default'}/"
                    f"{cm_spec.get('name', '')}")
             local_deps[key] = resource_hash(env[name])
-        else:
-            return None
+        # apiCall / imageRegistry / globalReference: unresolved
+
+    def resolve_full(expr: str):
+        """Resolve a whole-string {{expr}}: constant, spliced template
+        string, or _UNFOLDED."""
+        roots: Set[str] = set()
+        try:
+            ast = parser.parse(expr)
+        except Exception:  # noqa: BLE001
+            return _UNFOLDED
+        _root_refs(ast, roots)
+        ctx_roots = roots & entry_names
+        if not ctx_roots:
+            return _UNFOLDED  # request-rooted etc. — leave as-is
+        if "?" in roots:
+            return _UNFOLDED
+        if roots <= set(env):
+            try:
+                return jp_compile(expr).search(env)
+            except Exception:  # noqa: BLE001
+                return _UNFOLDED
+        m = _CHAIN_REF_RE.match(expr)
+        if m is None:
+            return _UNFOLDED
+        name, suffix = m.group(1), m.group(2)
+        if name in trees:
+            sub = navigate(trees[name], suffix)
+            if sub is None or is_pure(sub) or isinstance(sub, str):
+                # a constant, or a template STRING (splices verbatim
+                # and re-compiles as a request-rooted key)
+                return sub
+            return _UNFOLDED  # composite with embedded templates
+        if name in exprs:
+            base = exprs[name]
+            if not suffix:
+                return "{{ " + base + " }}"
+            if "||" in base:
+                return _UNFOLDED  # suffix would bind tighter than ||
+            return "{{ " + base + suffix + " }}"
+        return _UNFOLDED
 
     def subst(node: Any) -> Any:
         if isinstance(node, dict):
@@ -1129,23 +1415,12 @@ def _fold_static_context(rule: Rule, data_sources=None,
         matches = list(_FOLD_VAR_RE.finditer(node))
         if not matches:
             return node
-        def resolve(expr: str):
-            root = _FOLD_ROOT_RE.match(expr)
-            if root is None or root.group(1) not in env:
-                return _UNFOLDED
-            rest = expr[root.end():]
-            if rest and not rest.startswith((".", "[")):
-                return _UNFOLDED  # functions etc. stay dynamic
-            try:
-                return jp_compile(expr).search(env)
-            except Exception:
-                return _UNFOLDED
         if len(matches) == 1 and matches[0].span() == (0, len(node)):
-            val = resolve(matches[0].group(1))
+            val = resolve_full(matches[0].group(1).strip())
             return node if val is _UNFOLDED else val
         out = node
         for m in reversed(matches):
-            val = resolve(m.group(1))
+            val = resolve_full(m.group(1).strip())
             if val is _UNFOLDED:
                 continue
             if isinstance(val, bool):
@@ -1158,9 +1433,56 @@ def _fold_static_context(rule: Rule, data_sources=None,
         return out
 
     raw = subst({k: v for k, v in rule.raw.items() if k != "context"})
+    # the rule lowers only if no remaining template references a
+    # context entry (unresolved-but-unreferenced entries drop away,
+    # matching deferred loading)
+    def references_entry(node: Any) -> bool:
+        if isinstance(node, dict):
+            return any(references_entry(k) or references_entry(v)
+                       for k, v in node.items())
+        if isinstance(node, list):
+            return any(references_entry(x) for x in node)
+        if not isinstance(node, str):
+            return False
+        for m in _FOLD_VAR_RE.finditer(node):
+            roots: Set[str] = set()
+            try:
+                _root_refs(parser.parse(m.group(1).strip()), roots)
+            except Exception:  # noqa: BLE001
+                return True  # unparseable template — stay conservative
+            if roots & entry_names or "?" in roots:
+                return True
+        return False
+
+    if references_entry(raw):
+        return None
     if deps is not None:
         deps.update(local_deps)
     return Rule.from_dict(raw)
+
+
+def _subst_const_templates(tree: Any, env: Dict[str, Any], jp_compile,
+                           parser) -> Any:
+    """Substitute {{...}} templates inside a value tree when they close
+    over constants; other templates stay verbatim."""
+    if isinstance(tree, dict):
+        return {k: _subst_const_templates(v, env, jp_compile, parser)
+                for k, v in tree.items()}
+    if isinstance(tree, list):
+        return [_subst_const_templates(x, env, jp_compile, parser)
+                for x in tree]
+    if not isinstance(tree, str) or "{{" not in tree:
+        return tree
+    m = _FOLD_VAR_RE.fullmatch(tree.strip())
+    if m is not None:
+        roots: Set[str] = set()
+        try:
+            _root_refs(parser.parse(m.group(1).strip()), roots)
+            if roots <= set(env):
+                return jp_compile(m.group(1).strip()).search(env)
+        except Exception:  # noqa: BLE001
+            pass
+    return tree
 
 
 _UNFOLDED = object()
@@ -1205,7 +1527,9 @@ def _compile_rule_body(policy: ClusterPolicy, rule: Rule) -> RuleProgram:
     if v.deny is not None:
         prog.kind = "deny"
         prog.deny = cc.compile_tree((v.deny or {}).get("conditions"))
+        prog.uses_userinfo = cc.saw_userinfo
         return prog
+    prog.uses_userinfo = cc.saw_userinfo
     if v.pattern is not None:
         pc = PatternCompiler()
         prog.kind = "pattern"

@@ -426,13 +426,41 @@ def test_static_context_folding():
     cps = compile_policy_set([static])
     assert cps.coverage() == (1, 1), cps.rules[0].fallback_reason
 
+    # request-reading jmesPath entries now lower by INLINING the
+    # expression (with its default) into the references, so per-request
+    # values come from the resource rows, never from a baked constant
     dynamic = policy(
         [{"name": "replicas", "variable": {
             "jmesPath": "request.object.spec.replicas", "default": 1}}],
         [{"key": "{{ replicas }}", "operator": "GreaterThan", "value": 10}])
     cps = compile_policy_set([dynamic])
+    assert cps.coverage() == (1, 1), cps.rules[0].fallback_reason
+    from kyverno_tpu.tpu.engine import TpuEngine as _Eng
+
+    deng = _Eng([dynamic])
+    dres = deng.scan([
+        {"apiVersion": "v1", "kind": "Pod",
+         "metadata": {"name": "a"}, "spec": {"replicas": 20}},
+        {"apiVersion": "v1", "kind": "Pod",
+         "metadata": {"name": "b"}, "spec": {"replicas": 5}},
+        {"apiVersion": "v1", "kind": "Pod",
+         "metadata": {"name": "c"}, "spec": {}},  # default arm: 1 -> pass
+    ])
+    assert [int(dres.verdicts[0, i]) for i in range(3)] == [2, 0, 0]
+    # a truly dynamic entry (apiCall) that IS referenced still falls back
+    apicall = policy(
+        [{"name": "pods", "apiCall": {"urlPath": "/api/v1/pods"}}],
+        [{"key": "{{ pods }}", "operator": "Equals", "value": 1}])
+    cps = compile_policy_set([apicall])
     assert cps.coverage() == (0, 1)
     assert "context" in cps.rules[0].fallback_reason
+    # ... but an UNREFERENCED dynamic entry drops away (deferred
+    # loading never materializes it)
+    unused = policy(
+        [{"name": "pods", "apiCall": {"urlPath": "/api/v1/pods"}}],
+        [{"key": "{{ request.object.spec.x }}", "operator": "Equals",
+          "value": 1}])
+    assert compile_policy_set([unused]).coverage() == (1, 1)
 
     # folded constants evaluate correctly end to end
     from kyverno_tpu.tpu.engine import TpuEngine
@@ -729,3 +757,95 @@ def test_value_only_wildcard_multi_entries_lower():
         want = code[resp.policy_response.rules[0].status] \
             if resp.policy_response.rules else 3
         assert int(res.verdicts[0, ci]) == want, (ci, int(res.verdicts[0, ci]), want)
+
+
+def test_userinfo_key_membership_parity():
+    """{{ request.userInfo.groups }} membership conditions lower to the
+    RBAC identity lanes; device verdicts match the scalar oracle for
+    present, absent and empty identities."""
+    from kyverno_tpu.api.policy import ClusterPolicy
+    from kyverno_tpu.engine.engine import Engine
+    from kyverno_tpu.engine.match import RequestInfo
+    from kyverno_tpu.tpu.engine import (TpuEngine, _scalar_rule_verdicts,
+                                        build_scan_context)
+
+    pol = ClusterPolicy.from_dict({
+        "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+        "metadata": {"name": "p"},
+        "spec": {"rules": [{
+            "name": "r",
+            "match": {"any": [{"resources": {"kinds": ["Role"]}}]},
+            "preconditions": {"all": [
+                {"key": "{{ request.operation }}", "operator": "AnyIn",
+                 "value": ["UPDATE", "DELETE"]},
+                {"key": "{{ request.userInfo.groups }}",
+                 "operator": "AllNotIn", "value": ["system:masters"]}]},
+            "validate": {"message": "m", "deny": {}},
+        }]}})
+    eng = TpuEngine([pol])
+    assert eng.cps.coverage() == (1, 1), eng.cps.rules[0].fallback_reason
+    scal = Engine()
+    role = {"apiVersion": "rbac.authorization.k8s.io/v1", "kind": "Role",
+            "metadata": {"name": "r1", "namespace": "d"}}
+    cases = [
+        ("UPDATE", RequestInfo(username="u", groups=["system:masters", "x"])),
+        ("UPDATE", RequestInfo(username="u", groups=["devs"])),
+        ("CREATE", RequestInfo(username="u", groups=["devs"])),
+        ("DELETE", RequestInfo(username="u", groups=[])),
+        ("UPDATE", None),
+    ]
+    res = eng.scan([role] * len(cases), {},
+                   operations=[c[0] for c in cases],
+                   admission_infos=[c[1] for c in cases])
+    for i, (op, info) in enumerate(cases):
+        pctx = build_scan_context(pol, role, {}, op, info)
+        sv = _scalar_rule_verdicts(scal, pol, pctx).get("r")
+        assert int(res.verdicts[0, i]) == sv, (i, op, info)
+
+
+def test_not_null_defaults_loader_semantics_parity():
+    """Inlined context-variable defaults use not_null() — the loader's
+    null-only semantics, NOT jmespath || falsiness: an empty-string
+    key keeps the empty string. Literal, chain and numeric defaults."""
+    from kyverno_tpu.api.policy import ClusterPolicy
+    from kyverno_tpu.engine.engine import Engine
+    from kyverno_tpu.tpu.engine import (TpuEngine, _scalar_rule_verdicts,
+                                        build_scan_context)
+
+    def mk(context, conds):
+        return ClusterPolicy.from_dict({
+            "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+            "metadata": {"name": "p"},
+            "spec": {"rules": [{
+                "name": "r", "context": context,
+                "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+                "validate": {"message": "m", "deny": {"conditions": conds}},
+            }]}})
+
+    scal = Engine()
+    pods = [
+        {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "a"}},
+        {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "example"}},
+        {"apiVersion": "v1", "kind": "Pod",
+         "metadata": {"name": "b", "generateName": "x"}},
+        {"apiVersion": "v1", "kind": "Pod",
+         "metadata": {"name": "c", "generateName": ""}},
+    ]
+    policies = [
+        mk([{"name": "n", "variable": {
+            "jmesPath": "request.object.metadata.generateName",
+            "default": "example"}}],
+           [{"key": "{{ n }}", "operator": "NotEquals", "value": "example"}]),
+        mk([{"name": "n", "variable": {
+            "jmesPath": "request.object.metadata.generateName",
+            "default": "{{ request.object.metadata.name }}"}}],
+           [{"key": "{{ n }}", "operator": "NotEquals", "value": "example"}]),
+    ]
+    for pol in policies:
+        eng = TpuEngine([pol])
+        assert eng.cps.coverage() == (1, 1), eng.cps.rules[0].fallback_reason
+        res = eng.scan(pods, {})
+        for i, r in enumerate(pods):
+            pctx = build_scan_context(pol, r, {})
+            sv = _scalar_rule_verdicts(scal, pol, pctx).get("r")
+            assert int(res.verdicts[0, i]) == sv, (pol.name, i)
