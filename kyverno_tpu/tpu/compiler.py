@@ -21,8 +21,19 @@ import jax
 from ..api.policy import ClusterPolicy, Rule
 from .evaluator import build_program
 from .flatten import EncodeConfig
-from .ir import RuleProgram, Unsupported, compile_rule
+from .ir import DynKey, DynSlot, DynValueRef, RuleProgram, Unsupported, compile_rule
 from .metadata import MetaConfig
+
+
+def _iter_cond_irs(prog: RuleProgram):
+    """Every CondIR in a program's precondition/deny/foreach trees."""
+    trees = [prog.preconditions, prog.deny] + [f.tree for f in prog.foreach]
+    for tree in trees:
+        if tree is None:
+            continue
+        for any_block, all_block in tree.blocks:
+            yield from any_block
+            yield from all_block
 
 
 @dataclass
@@ -48,6 +59,9 @@ class CompiledPolicySet:
     # is only valid while every dep's hash is unchanged (scanner
     # recompiles on movement).
     context_deps: Dict[str, Optional[str]] = field(default_factory=dict)
+    # global host-resolved operand slots (per-request context values
+    # feeding the device program as canonical lanes)
+    dyn_slots: List[DynSlot] = field(default_factory=list)
     _fn: Optional[Callable] = field(default=None, repr=False)
 
     @property
@@ -93,6 +107,7 @@ def _compile_policy_set(
     byte_paths: Set[int] = set()
     key_byte_paths: Set[int] = set()
     deps: Dict[str, Optional[str]] = {}
+    dyn_slots: List[DynSlot] = []
     for pi, policy in enumerate(policies):
         for rule in policy.get_rules():
             if not rule.has_validate():
@@ -100,6 +115,16 @@ def _compile_policy_set(
             try:
                 prog = compile_rule(policy, rule, data_sources, deps)
                 row = len(programs)
+                if prog.dyn_slots:
+                    # rebase rule-local operand slots onto the global
+                    # slot table the runtime fills per batch
+                    base = len(dyn_slots)
+                    dyn_slots.extend(prog.dyn_slots)
+                    for ir_cond in _iter_cond_irs(prog):
+                        if isinstance(ir_cond.key, DynKey):
+                            ir_cond.key.slot += base
+                        if isinstance(ir_cond.value, DynValueRef):
+                            ir_cond.value.slot += base
                 programs.append(prog)
                 byte_paths |= prog.byte_paths
                 key_byte_paths |= prog.key_byte_paths
@@ -128,4 +153,5 @@ def _compile_policy_set(
         encode_cfg=encode_cfg,
         meta_cfg=meta_cfg,
         context_deps=deps,
+        dyn_slots=dyn_slots,
     )

@@ -36,6 +36,34 @@ VERDICT_NAMES = {PASS: "pass", SKIP: "skip", FAIL: "fail",
 _STATUS_TO_CODE = {"pass": PASS, "skip": SKIP, "fail": FAIL, "error": ERROR}
 
 
+def _scan_json_context(resource: Dict[str, Any], operation: str = "",
+                       admission_info: Optional[RequestInfo] = None) -> Context:
+    """The JSON context both engines evaluate against for one scanned
+    resource: request.object/namespace/operation/userInfo + images.*
+    (policy_context.go:257)."""
+    ctx = Context()
+    ctx.add_resource(resource)
+    ns = (resource.get("metadata") or {}).get("namespace", "")
+    if ns:
+        ctx.add_namespace(ns)
+    if operation:
+        ctx.add_operation(operation)
+    info = admission_info or RequestInfo()
+    ctx.add_user_info({"username": info.username, "uid": info.uid,
+                       "groups": info.groups})
+    try:
+        from ..images import extract_images
+
+        extracted = extract_images(resource)
+        if extracted:
+            ctx.add_image_infos({
+                group: {key: info_.to_dict() for key, info_ in entries.items()}
+                for group, entries in extracted.items()})
+    except Exception:
+        pass  # malformed image strings must not break context building
+    return ctx
+
+
 def build_scan_context(
     policy: ClusterPolicy,
     resource: Dict[str, Any],
@@ -47,25 +75,8 @@ def build_scan_context(
     unless a real admission operation exists (the charts' preconditions
     rely on `request.operation || 'BACKGROUND'`). Match-gating still
     defaults to CREATE (MatchesResourceDescription's default)."""
-    ctx = Context()
-    ctx.add_resource(resource)
-    if operation:
-        ctx.add_operation(operation)
+    ctx = _scan_json_context(resource, operation, admission_info)
     info = admission_info or RequestInfo()
-    ctx.add_user_info({"username": info.username, "uid": info.uid, "groups": info.groups})
-    # images.* variables from the resource's containers
-    # (policy_context.go:257 builds image infos at construction; rules
-    # reference e.g. {{ images.containers.*.registry }})
-    try:
-        from ..images import extract_images
-
-        extracted = extract_images(resource)
-        if extracted:
-            ctx.add_image_infos({
-                group: {key: info_.to_dict() for key, info_ in entries.items()}
-                for group, entries in extracted.items()})
-    except Exception:
-        pass  # malformed image strings must not break context building
     return PolicyContext(
         policy=policy,
         new_resource=resource,
@@ -107,6 +118,23 @@ def _scalar_rule_verdicts(
     return out
 
 
+def _walk_values(node, segs, i=0):
+    """Yield the values at a PathState segment chain over a raw
+    resource dict (ARRAY_SEG iterates list elements)."""
+    from .hashing import ARRAY_SEG
+
+    if i == len(segs):
+        yield node
+        return
+    seg = segs[i]
+    if seg == ARRAY_SEG:
+        if isinstance(node, list):
+            for el in node:
+                yield from _walk_values(el, segs, i + 1)
+    elif isinstance(node, dict) and seg in node:
+        yield from _walk_values(node[seg], segs, i + 1)
+
+
 class TpuEngine:
     """Compile once, scan many — the device-backed engineapi.Engine
     slice for background scans and CLI apply."""
@@ -122,7 +150,10 @@ class TpuEngine:
     ):
         self.cps: CompiledPolicySet = cps if cps is not None \
             else compile_policy_set(policies, encode_cfg, meta_cfg, data_sources)
-        self.scalar = ScalarEngine(exceptions=list(exceptions), background=True)
+        self.data_sources = data_sources  # runtime dyn-operand loading
+        self.scalar = ScalarEngine(exceptions=list(exceptions),
+                                   background=True,
+                                   data_sources=data_sources)
         # rules named by any PolicyException evaluate on the host: the
         # exception's match/conditions are per-resource dynamic state
         # the compiled program does not model (engine/exceptions.go)
@@ -143,6 +174,8 @@ class TpuEngine:
 
     # -- encoding
 
+    DYN_LIST_L = 32  # padded list-operand lanes per slot
+
     def encode(
         self,
         resources: Sequence[Dict[str, Any]],
@@ -154,7 +187,169 @@ class TpuEngine:
                                 self.cps.key_byte_paths)
         meta = encode_metadata(resources, namespace_labels, operations,
                                admission_infos, self.cps.meta_cfg)
-        return batch_to_host(rows, meta), rows, meta
+        batch = batch_to_host(rows, meta)
+        if self.cps.dyn_slots:
+            batch.update(self._encode_dyn_lanes(resources, operations,
+                                                admission_infos))
+        return batch, rows, meta
+
+    def _encode_dyn_lanes(self, resources, operations, admission_infos):
+        """Host-resolved context operands (SURVEY §7 context-dependent
+        rules): per (slot, resource), load the slot's context entries
+        through the REAL loaders (apiCall/configMap I/O included,
+        exactly the scalar engine's path) and encode the queried value
+        as canonical lanes the device program compares against.
+        Load results cache on the substituted entry spec, so
+        request-independent entries (static urlPaths, configMaps)
+        resolve once per batch."""
+        import json as _json
+
+        from ..engine.context import Context
+        from ..engine.contextloaders import load_context_entries
+        from ..engine.pattern import go_parse_float
+        from ..utils.wildcard import contains_wildcard
+        from .flatten import go_sprint
+        from .hashing import ARRAY_SEG, hash_str, split32
+
+        S, N, L = len(self.cps.dyn_slots), len(resources), self.DYN_LIST_L
+        lanes = {
+            # type: 0=load-error 1=null 2=bool 3=num 4=str 5=list 6=other
+            "dyn_type": np.zeros((S, N), np.int8),
+            "dyn_bool": np.zeros((S, N), np.int8),
+            # 0/1 = the value coerces to that bool ("true"/"false"
+            # strings included, equal.go), 2 = no bool coercion
+            "dyn_as_bool": np.full((S, N), 2, np.int8),
+            "dyn_num": np.zeros((S, N), np.float32),
+            "dyn_has_num": np.zeros((S, N), np.int8),
+            # canonical number hash (rows carry canon hashes, not floats)
+            "dyn_num_h": np.zeros((S, N, 2), np.uint32),
+            "dyn_sprint": np.zeros((S, N, 2), np.uint32),
+            "dyn_list_h": np.zeros((S, N, L, 2), np.uint32),
+            "dyn_list_n": np.zeros((S, N), np.int32),
+            # string value that decodes as a JSON string-array
+            "dyn_json_list": np.zeros((S, N), np.int8),
+            # host-completion flag: list overflow, glob/unit-bearing
+            # values, or glob-bearing guarded resource values —
+            # anything hash lanes can't compare the way the oracle does
+            "dyn_host": np.zeros((S, N), np.int8),
+        }
+        cache: Dict[Any, Tuple[bool, Any]] = {}
+        for ci, res in enumerate(resources):
+            op = (operations[ci] if operations else "") or ""
+            info = admission_infos[ci] if admission_infos else None
+            for si, slot in enumerate(self.cps.dyn_slots):
+                ctx = _scan_json_context(res, op, info)
+                key = None
+                try:
+                    from ..engine.variables import substitute_all
+
+                    key = (si, _json.dumps(
+                        substitute_all(ctx, slot.entries), sort_keys=True,
+                        default=str))
+                except Exception:  # noqa: BLE001
+                    key = None  # request-dependent substitution failed
+                if key is not None and key in cache:
+                    ok, val = cache[key]
+                else:
+                    try:
+                        load_context_entries(ctx, slot.entries,
+                                             self.data_sources)
+                        val = ctx.query(slot.query)
+                        ok = True
+                    except Exception:  # noqa: BLE001
+                        ok, val = False, None
+                    if key is not None:
+                        cache[key] = (ok, val)
+                if not ok:
+                    lanes["dyn_type"][si, ci] = 0
+                    continue
+                self._fill_dyn_value(lanes, si, ci, val, L)
+                # guarded resource paths: glob-bearing string values
+                # defeat hash membership -> host completes the cell
+                for segs in slot.guard_paths:
+                    for v in _walk_values(res, segs):
+                        if isinstance(v, str) and contains_wildcard(v):
+                            lanes["dyn_host"][si, ci] = 1
+        return lanes
+
+    @staticmethod
+    def _fill_dyn_value(lanes, si, ci, val, L):
+        from ..engine.pattern import go_parse_float
+        from ..utils.duration import parse_duration
+        from ..utils.quantity import parse_quantity
+        from ..utils.wildcard import contains_wildcard
+        from .flatten import go_sprint
+        from .hashing import canon_number, hash_str, split32
+
+        if isinstance(val, bool):
+            lanes["dyn_type"][si, ci] = 2
+            lanes["dyn_bool"][si, ci] = 1 if val else 0
+            lanes["dyn_as_bool"][si, ci] = 1 if val else 0
+        elif isinstance(val, (int, float)):
+            lanes["dyn_type"][si, ci] = 3
+            lanes["dyn_num"][si, ci] = float(val)
+            lanes["dyn_has_num"][si, ci] = 1
+            lanes["dyn_num_h"][si, ci] = split32(canon_number(val))
+        elif isinstance(val, str):
+            lanes["dyn_type"][si, ci] = 4
+            lanes["dyn_sprint"][si, ci] = split32(hash_str(val, tag="s"))
+            if val in ("true", "false"):
+                lanes["dyn_as_bool"][si, ci] = 1 if val == "true" else 0
+            f = go_parse_float(val)
+            if f is not None:
+                lanes["dyn_num"][si, ci] = f
+                lanes["dyn_has_num"][si, ci] = 1
+                lanes["dyn_num_h"][si, ci] = split32(canon_number(f))
+            # globs act as patterns, unit strings coerce, and range
+            # expressions compare structurally in the oracle — hash
+            # equality can't see any of those
+            from ..engine.operator import (Operator,
+                                           get_operator_from_string_pattern)
+
+            if contains_wildcard(val):
+                lanes["dyn_host"][si, ci] = 1
+            if (val != "0" and parse_duration(val) is not None) or \
+                    (f is None and parse_quantity(val) is not None):
+                lanes["dyn_host"][si, ci] = 1
+            if get_operator_from_string_pattern(val) in (
+                    Operator.IN_RANGE, Operator.NOT_IN_RANGE):
+                lanes["dyn_host"][si, ci] = 1
+            # a valid-JSON string-array value decodes for membership
+            # (in.go keyExistsInArray / anyin.go _value_as_string_list)
+            from ..engine.conditions import _value_as_string_list
+
+            arr = _value_as_string_list(val)
+            if arr is not None:
+                lanes["dyn_json_list"][si, ci] = 1
+                if len(arr) > L:
+                    lanes["dyn_host"][si, ci] = 1
+                n = 0
+                for v in arr[:L]:
+                    if contains_wildcard(v):
+                        lanes["dyn_host"][si, ci] = 1
+                    lanes["dyn_list_h"][si, ci, n] = split32(
+                        hash_str(v, tag="s"))
+                    n += 1
+                lanes["dyn_list_n"][si, ci] = n
+        elif val is None:
+            lanes["dyn_type"][si, ci] = 1
+        elif isinstance(val, list):
+            lanes["dyn_type"][si, ci] = 5
+            if len(val) > L:
+                lanes["dyn_host"][si, ci] = 1
+            n = 0
+            for v in val[:L]:
+                s = go_sprint(v)
+                if s is None:
+                    lanes["dyn_host"][si, ci] = 1
+                    continue
+                if contains_wildcard(s):
+                    lanes["dyn_host"][si, ci] = 1
+                lanes["dyn_list_h"][si, ci, n] = split32(hash_str(s, tag="s"))
+                n += 1
+            lanes["dyn_list_n"][si, ci] = n
+        else:
+            lanes["dyn_type"][si, ci] = 6
 
     # -- evaluation
 

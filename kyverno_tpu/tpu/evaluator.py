@@ -66,6 +66,8 @@ from .ir import (
     Node,
     NullLeaf,
     NumLeaf,
+    DynKey,
+    DynValueRef,
     OpKey,
     UserInfoKey,
     PathCollect,
@@ -726,6 +728,12 @@ def eval_cond(
     if isinstance(ir.key, UserInfoKey):
         return _expand(ctx, scope,
                        _eval_userinfo_cond(ctx, ir.key, op, ir.value)), zero_err
+    if isinstance(ir.key, DynKey):
+        res, errs = _eval_dyn_key_cond(ctx, ir.key, op, ir.value)
+        return _expand(ctx, scope, res), _expand(ctx, scope, errs)
+    if isinstance(ir.value, DynValueRef):
+        res, errs = _eval_path_vs_dyn_list(ctx, ir.key, op, ir.value, prefix)
+        return _expand(ctx, scope, res), _expand(ctx, scope, errs)
     if isinstance(ir.key, LiteralKey):
         if isinstance(ir.value, ElementCollect):
             return _eval_literal_vs_collect(ctx, scope, prefix, ir.key.value, op, ir.value)
@@ -770,6 +778,186 @@ def _eval_literal_vs_collect(
             rows = rows | m
         hit = scope.any(rows)
     return (hit if mode in ("any_in", "all_in") else ~hit), err
+
+
+def _eval_dyn_key_cond(ctx: Ctx, key: DynKey, op: str,
+                       value: Any) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Host-resolved context operand vs literal. Lanes carry the
+    value's canonical forms (type / bool / go-parsed number / sprint
+    hash); load failures surface as rule ERROR exactly like the scalar
+    engine's context-load errors. Returns ((N,) res, (N,) err)."""
+    s = key.slot
+    t = ctx.b["dyn_type"][s]
+    err = t == 0
+    # host-flagged cells (value shapes hash lanes can't express)
+    ctx.host_acc.append((ctx.b["dyn_host"][s] == 1) & ~err)
+    if op in ("equals", "notequals"):
+        if isinstance(value, bool):
+            eq = (t == 2) & (ctx.b["dyn_bool"][s] == (1 if value else 0))
+        elif isinstance(value, (int, float)):
+            # numeric equality go-coerces number strings (equal.go)
+            eq = (ctx.b["dyn_has_num"][s] == 1) \
+                & (ctx.b["dyn_num"][s] == np.float32(value))
+        elif isinstance(value, str):
+            hi, lo = split32(hash_str(value, tag="s"))
+            sh = ctx.b["dyn_sprint"][s]
+            eq = (t == 4) & (sh[:, 0] == np.uint32(hi)) \
+                & (sh[:, 1] == np.uint32(lo))
+        else:  # None — Equals never matches nil (equal.go)
+            eq = jnp.zeros(t.shape, dtype=bool)
+        if op == "notequals":
+            # nil/err/composite keys are False, not negated-True
+            typed = (t == 2) | (t == 3) | (t == 4)
+            return typed & ~eq, err
+        return eq, err
+    if op in _NUM_OPS:
+        kind = _NUM_OPS[op]
+        num = ctx.b["dyn_num"][s]
+        has = ctx.b["dyn_has_num"][s] == 1
+        c = np.float32(value)
+        res = {"gt": num > c, "ge": num >= c,
+               "lt": num < c, "le": num <= c}[kind]
+        return has & res, err
+    return jnp.zeros(t.shape, dtype=bool), err
+
+
+def _dyn_in_set(ctx: Ctx, slot: int, mask: jnp.ndarray) -> jnp.ndarray:
+    """Row-hash membership against a per-resource list operand."""
+    ln = ctx.b["dyn_list_n"][slot]
+    lh = ctx.b["dyn_list_h"][slot]       # (N, L, 2)
+    shi, slo = ctx.b["sprint_hi"], ctx.b["sprint_lo"]
+    in_set = jnp.zeros((ctx.N, ctx.R), dtype=bool)
+    for l in range(lh.shape[1]):
+        live = (jnp.asarray(l, dtype=np.int32) < ln)[:, None]
+        eq = (shi == lh[:, l, 0][:, None]) & (slo == lh[:, l, 1][:, None])
+        in_set = in_set | (live & eq)
+    return in_set & mask
+
+
+def _eval_path_vs_dyn_list(ctx: Ctx, pc, op: str, ref: DynValueRef,
+                           prefix: Tuple[str, ...]) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Path-chain / projection keys against a host-resolved operand:
+    list membership (_set_in semantics, hash equality) or scalar
+    equality. Glob/unit-bearing values and list overflow were flagged
+    host-side. ((N,) res, (N,) err)."""
+    scope = Depth0()
+    s = ref.slot
+    t = ctx.b["dyn_type"][s]
+    err = t == 0
+    # flagged cells complete on host (per-cell HOST verdict)
+    ctx.host_acc.append((ctx.b["dyn_host"][s] == 1) & ~err)
+    if isinstance(pc, LiteralKey):
+        # constant key vs per-resource list (scalar keys: the strict
+        # In/NotIn modes behave like AnyIn/AnyNotIn)
+        from .flatten import go_sprint
+
+        ks = go_sprint(pc.value)
+        ln = ctx.b["dyn_list_n"][s]
+        lh = ctx.b["dyn_list_h"][s]
+        hit = jnp.zeros((ctx.N,), dtype=bool)
+        if ks is not None:
+            hi, lo = split32(hash_str(ks, tag="s"))
+            for l in range(lh.shape[1]):
+                live = jnp.asarray(l, dtype=np.int32) < ln
+                hit = hit | (live & (lh[:, l, 0] == np.uint32(hi))
+                             & (lh[:, l, 1] == np.uint32(lo)))
+        is_list = t == 5
+        mode = _IN_MODES[op]
+        pos = mode in ("any_in", "all_in", "in_strict")
+        return is_list & hit if pos else is_list & ~hit, err
+    mask = jnp.zeros((ctx.N, ctx.R), dtype=bool)
+    for st in pc.states:
+        m = ctx.rows_at(prefix + st.segs)
+        if st.no_arr:
+            m = m & ~ctx.type_is(T_ARR)
+        if st.no_null:
+            m = m & ~ctx.type_is(T_NULL)
+        mask = mask | m
+    if op in ("equals", "notequals"):
+        # scalar chain key vs scalar operand
+        sh = ctx.b["dyn_sprint"][s]
+        eq_str = scope.any(mask & ctx.type_is(T_STR)
+                           & (ctx.b["sprint_hi"] == sh[:, 0][:, None])
+                           & (ctx.b["sprint_lo"] == sh[:, 1][:, None]))
+        nh = ctx.b["dyn_num_h"][s]
+        has_num = (ctx.b["dyn_has_num"][s] == 1)[:, None]
+        eq_num = scope.any(mask & ctx.type_is(T_NUM) & has_num
+                           & (ctx.b["num_hi"] == nh[:, 0][:, None])
+                           & (ctx.b["num_lo"] == nh[:, 1][:, None]))
+        ab = ctx.b["dyn_as_bool"][s]
+        eq_bool = scope.any(mask & ctx.type_is(T_BOOL)
+                            & (ab < 2)[:, None]
+                            & (ctx.b["bool_val"] == ab[:, None]))
+        eq = eq_str | eq_num | eq_bool
+        if op == "notequals":
+            null_or_missing = (~scope.any(mask)) \
+                | scope.any(mask & ctx.type_is(T_NULL))
+            return ~eq & ~null_or_missing, err
+        return eq, err
+    # membership. value shapes: a real list (t==5), or a STRING that
+    # JSON-decodes to a string array (strict In/NotIn + the AnyIn
+    # family both decode; other string forms keep oracle-only
+    # semantics and route to host / evaluate false)
+    mode = _IN_MODES[op]
+    strict = mode in ("in_strict", "notin_strict")
+    is_list = t == 5
+    json_list = (t == 4) & (ctx.b["dyn_json_list"][s] == 1)
+    if strict:
+        usable = is_list | json_list
+        # raw singleton-equality (the wildcard arm of keyExistsInArray)
+        # is exact equality for non-glob values; non-decodable string
+        # values keep oracle-only edge semantics -> host
+        ctx.host_acc.append((t == 4) & ~json_list & ~err)
+        sh = ctx.b["dyn_sprint"][s]
+        raw_eq = scope.any(mask & ctx.type_is(T_STR)
+                           & (ctx.b["sprint_hi"] == sh[:, 0][:, None])
+                           & (ctx.b["sprint_lo"] == sh[:, 1][:, None]))
+    else:
+        usable = is_list
+        raw_eq = jnp.zeros((ctx.N,), dtype=bool)
+        # AnyIn-family string values have singleton/range semantics
+        # hash lanes don't model: those cells complete on host
+        ctx.host_acc.append((t == 4) & ~err)
+    in_set = _dyn_in_set(ctx, s, mask)
+    if pc.is_projection:
+        present = _list_exists(ctx, pc, scope, prefix)
+        any_in = scope.any(in_set)
+        any_not_in = scope.any(mask & ~in_set)
+        res = {
+            "any_in": any_in,
+            "all_in": ~any_not_in,
+            "any_not_in": any_not_in,
+            "all_not_in": ~any_in,
+            "in_strict": ~any_not_in,
+            "notin_strict": any_not_in,
+        }[mode]
+        return present & usable & res, err
+    # scalar chain key: scalar vs array semantics as in the static
+    # membership branch
+    st = pc.states[0]
+    is_scalar = scope.any(mask & (ctx.type_is(T_STR) | ctx.type_is(T_NUM)
+                                  | ctx.type_is(T_BOOL)))
+    is_arr = scope.any(mask & ctx.type_is(T_ARR))
+    hit = scope.any(in_set) | raw_eq
+    em = ctx.rows_at(prefix + st.segs + (ARRAY_SEG,))
+    e_in = _dyn_in_set(ctx, s, em)
+    e_any_in = scope.any(e_in)
+    e_any_not = scope.any(em & ~e_in)
+    e_nonstr = scope.any(em & ~ctx.type_is(T_STR))
+    if strict and pc is not None:
+        # strict array keys vs string values mix decode rules — host
+        ctx.host_acc.append(is_arr & (t == 4) & ~err)
+    res = {
+        "any_in": jnp.where(is_arr, e_any_in, is_scalar & hit),
+        "all_in": jnp.where(is_arr, ~e_any_not, is_scalar & hit),
+        "any_not_in": jnp.where(is_arr, e_any_not, is_scalar & ~hit),
+        "all_not_in": jnp.where(is_arr, ~e_any_in, is_scalar & ~hit),
+        "in_strict": jnp.where(is_arr, ~e_any_not & ~e_nonstr,
+                               is_scalar & hit),
+        "notin_strict": jnp.where(is_arr, e_any_not & ~e_nonstr,
+                                  is_scalar & ~hit),
+    }[mode]
+    return usable & res, err
 
 
 def _eval_userinfo_cond(ctx: Ctx, key: UserInfoKey, op: str,

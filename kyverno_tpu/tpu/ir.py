@@ -439,6 +439,38 @@ class OpKey:
 
 
 @dataclass
+class DynSlot:
+    """One host-resolved context operand: the rule's context entries
+    load per request (through the real context loaders, I/O included —
+    SURVEY §7 "context-dependent rules"), the expression is queried
+    against the loaded context, and the value's canonical lanes feed
+    the device program as per-resource operands."""
+
+    query: str        # full jmespath expression (roots include context vars)
+    entries: List[Dict[str, Any]] = field(default_factory=list)  # full rule context
+    # resource paths whose STRING values must be glob-free for hash
+    # membership against this slot's list value to be sound (scalar
+    # _wild_either matches globs in either direction) — glob hits
+    # route the cell to host
+    guard_paths: List[Tuple[str, ...]] = field(default_factory=list)
+
+
+@dataclass
+class DynKey:
+    """Condition key backed by a dynamic context operand slot."""
+
+    slot: int  # global slot index (compiler-assigned)
+
+
+@dataclass
+class DynValueRef:
+    """Condition VALUE backed by a dynamic context operand slot (list
+    membership against a host-resolved string list)."""
+
+    slot: int
+
+
+@dataclass
 class UserInfoKey:
     """key == {{ request.userInfo.groups|roles|clusterRoles }} — the
     per-request RBAC identity, already encoded as hash lanes for
@@ -528,12 +560,42 @@ _SUPPORTED_OPS = {
 
 
 class ConditionCompiler:
-    def __init__(self, element_mode: bool = False) -> None:
+    def __init__(self, element_mode: bool = False,
+                 dyn_vars: Optional[Dict[str, List[Dict[str, Any]]]] = None) -> None:
         self._parser = JmesParser()
         self.element_mode = element_mode
         # set when a compiled key reads the request identity lanes —
         # glob-bearing runtime identities then route to host per cell
         self.saw_userinfo = False
+        # dynamic context variables: name -> the rule's full context
+        # entry list (loads happen per request on the host)
+        self.dyn_vars = dyn_vars or {}
+        self.dyn_slots: List[DynSlot] = []
+
+    def _dyn_slot(self, query: str, entries: List[Dict[str, Any]]) -> int:
+        for i, s in enumerate(self.dyn_slots):
+            if s.query == query:
+                return i
+        self.dyn_slots.append(DynSlot(query, entries))
+        return len(self.dyn_slots) - 1
+
+    def _dyn_expr(self, expr: str) -> Optional[int]:
+        """Slot index when the expression's roots involve a dynamic
+        context variable (the whole expression then evaluates on host
+        through the real context machinery — functions, pipes and
+        mixed request.* references included)."""
+        if not self.dyn_vars:
+            return None
+        roots: Set[str] = set()
+        try:
+            _root_refs(self._parser.parse(expr), roots)
+        except Exception:  # noqa: BLE001
+            return None
+        hit = roots & set(self.dyn_vars)
+        if not hit or "?" in roots or "@" in roots:
+            return None
+        entries = self.dyn_vars[next(iter(hit))]
+        return self._dyn_slot(expr, entries)
 
     def compile_tree(self, conditions: Any) -> Optional[CondTreeIR]:
         """None/empty conditions -> None (always pass)."""
@@ -588,11 +650,36 @@ class ConditionCompiler:
         expr = m.group(1).strip()
         if "{{" in expr:
             raise Unsupported("nested variables in key")
+        if not self.element_mode:
+            slot = self._dyn_expr(expr)
+            if slot is not None:
+                self._guard_dyn_key(op, value)
+                return CondIR(DynKey(slot), op, value)
         ast = self._parser.parse(expr)
         if self.element_mode and _mentions_element(ast):
             key_ir = self._compile_element_key(ast)
         else:
             key_ir = self._compile_key(ast)
+        if isinstance(value, DynValueRef):
+            # dynamic operand value: list membership of collected key
+            # rows, or scalar equality against a path-chain key
+            if op in ("anyin", "allin", "anynotin", "allnotin", "in", "notin"):
+                pass
+            elif op in ("equals", "equal", "notequals", "notequal"):
+                if getattr(key_ir, "is_projection", False):
+                    raise Unsupported("dynamic value equality with projection key")
+            else:
+                raise Unsupported(f"dynamic value with operator {op}")
+            if not isinstance(key_ir, PathCollect):
+                raise Unsupported("dynamic value with non-path key")
+            if key_ir.default is not None or key_ir.default_collect is not None:
+                raise Unsupported("dynamic value with defaulted key")
+            slot = self.dyn_slots[value.slot]
+            for st in key_ir.states:
+                if st.mode != "value":
+                    raise Unsupported("dynamic value with keys() key")
+                slot.guard_paths.append(st.segs)
+            return CondIR(key_ir, op, value)
         if op in ("equals", "equal", "notequals", "notequal") and isinstance(value, (list, dict)):
             raise Unsupported("deep-equality condition value")
         if op in ("greaterthan", "greaterthanorequals", "lessthan", "lessthanorequals"):
@@ -656,7 +743,56 @@ class ConditionCompiler:
             if not isinstance(key, (str, int, float, bool)):
                 raise Unsupported("non-scalar key with element value")
             self._guard_literal_key_value(op, value)
+        if isinstance(value, DynValueRef):
+            # constant key vs host-resolved list: hash membership
+            if op not in ("anyin", "allin", "anynotin", "allnotin",
+                          "in", "notin"):
+                raise Unsupported("dynamic value with non-membership operator")
+            if not isinstance(key, (str, int, float, bool)):
+                raise Unsupported("non-scalar key with dynamic value")
+            if isinstance(key, str) and contains_wildcard(key):
+                raise Unsupported("glob key with dynamic value")
         return CondIR(LiteralKey(key), op, value)
+
+    def _guard_dyn_key(self, op: str, value: Any) -> None:
+        """Dynamic-operand keys compare through canonical lanes: scalar
+        string/number/bool equality and plain numeric comparisons only
+        (no globs, ranges, durations/quantities or cross-type coercion
+        — those stay on host)."""
+        if isinstance(value, (DynValueRef, ElementCollect)):
+            raise Unsupported("dynamic key with non-literal value")
+        if op in ("equals", "equal", "notequals", "notequal"):
+            if isinstance(value, str):
+                if contains_wildcard(value):
+                    raise Unsupported("dynamic key with glob value")
+                if parse_duration(value) is not None \
+                        or parse_quantity(value) is not None:
+                    raise Unsupported("dynamic key with unit value")
+                try:
+                    float(value)
+                except ValueError:
+                    return
+                raise Unsupported("dynamic key with numeric-string value")
+            if isinstance(value, (bool, int, float)) or value is None:
+                return
+            raise Unsupported("dynamic key with composite value")
+        if op in ("greaterthan", "greaterthanorequals", "lessthan",
+                  "lessthanorequals"):
+            if isinstance(value, (int, float)) and not isinstance(value, bool):
+                return
+            raise Unsupported("dynamic key numeric op with non-number value")
+        raise Unsupported(f"dynamic key with operator {op}")
+
+    def _try_dyn_value(self, value: Any) -> Optional["DynValueRef"]:
+        """A whole-string {{ expr }} value whose roots involve a
+        dynamic context variable -> operand-slot reference."""
+        if not (self.dyn_vars and isinstance(value, str)):
+            return None
+        m = _VAR_RE.match(value.strip())
+        if m is None:
+            return None
+        slot = self._dyn_expr(m.group(1).strip())
+        return DynValueRef(slot) if slot is not None else None
 
     def _try_element_value(self, value: Any) -> Optional["ElementCollect"]:
         """{{ element... }} string value in foreach bodies -> the
@@ -677,10 +813,13 @@ class ConditionCompiler:
 
     def _compile_value_lenient(self, value: Any) -> Any:
         """Value for a literal-key condition: ElementCollect in foreach
-        bodies, otherwise any reference-free literal (the constant fold
-        handles all types)."""
+        bodies, a dynamic context-operand reference, otherwise any
+        reference-free literal (the constant fold handles all types)."""
         import json as _json
 
+        dv = self._try_dyn_value(value)
+        if dv is not None:
+            return dv
         ec = self._try_element_value(value)
         if ec is not None:
             return ec
@@ -689,8 +828,11 @@ class ConditionCompiler:
         return value
 
     def _compile_value(self, value: Any) -> Any:
-        """Literal passthrough, or an {{ element... }} ElementCollect in
-        foreach bodies."""
+        """Literal passthrough, an {{ element... }} ElementCollect in
+        foreach bodies, or a dynamic context-operand reference."""
+        dv = self._try_dyn_value(value)
+        if dv is not None:
+            return dv
         ec = self._try_element_value(value)
         if ec is not None:
             return ec
@@ -910,6 +1052,10 @@ class ForeachDeny:
 
     arrays: List[Tuple[str, ...]]   # absolute array paths (depth-1)
     tree: CondTreeIR
+    # explicit elementScope:true — non-map elements are a rule ERROR
+    # (utils/foreach.go:41-56), order-dependent vs earlier failures, so
+    # such cells complete on host
+    strict_maps: bool = False
 
 
 def compile_foreach_list(ast: Tuple) -> List[Tuple[str, ...]]:
@@ -1134,6 +1280,9 @@ class RuleProgram:
     # reads request.userInfo identity lanes (hash equality): requests
     # whose identity strings carry globs divert to host per cell
     uses_userinfo: bool = False
+    # host-resolved context operand slots (slot indices are rule-local
+    # here; the policy-set compiler rebases them globally)
+    dyn_slots: List["DynSlot"] = field(default_factory=list)
 
 
 _FOLD_VAR_RE = re.compile(r"\{\{\s*([^{}]+?)\s*\}\}")
@@ -1433,15 +1582,19 @@ def _fold_static_context(rule: Rule, data_sources=None,
         return out
 
     raw = subst({k: v for k, v in rule.raw.items() if k != "context"})
-    # the rule lowers only if no remaining template references a
-    # context entry (unresolved-but-unreferenced entries drop away,
-    # matching deferred loading)
-    def references_entry(node: Any) -> bool:
+    # entries that did not resolve statically stay DYNAMIC: their
+    # values load per request on the host and feed the device program
+    # as operand lanes. References to them are only evaluable in
+    # condition positions (preconditions / deny conditions).
+    resolved = set(env) | set(trees) | set(exprs)
+    dyn_names = entry_names - resolved
+
+    def references(node: Any, names: Set[str]) -> bool:
         if isinstance(node, dict):
-            return any(references_entry(k) or references_entry(v)
+            return any(references(k, names) or references(v, names)
                        for k, v in node.items())
         if isinstance(node, list):
-            return any(references_entry(x) for x in node)
+            return any(references(x, names) for x in node)
         if not isinstance(node, str):
             return False
         for m in _FOLD_VAR_RE.finditer(node):
@@ -1450,15 +1603,28 @@ def _fold_static_context(rule: Rule, data_sources=None,
                 _root_refs(parser.parse(m.group(1).strip()), roots)
             except Exception:  # noqa: BLE001
                 return True  # unparseable template — stay conservative
-            if roots & entry_names or "?" in roots:
+            if roots & names or "?" in roots:
                 return True
         return False
 
-    if references_entry(raw):
+    # resolved-entry references must all have substituted away
+    if references(raw, resolved):
         return None
+    dyn_map: Dict[str, List[Dict[str, Any]]] = {}
+    if references(raw, dyn_names):
+        # dynamic references outside the condition zones (match blocks,
+        # patterns, foreach bodies) have no operand-lane lowering
+        cond_free = {k: v for k, v in raw.items()
+                     if k not in ("preconditions", "validate")}
+        v_raw = dict(raw.get("validate") or {})
+        v_raw.pop("deny", None)
+        v_raw.pop("message", None)
+        if references(cond_free, dyn_names) or references(v_raw, dyn_names):
+            return None
+        dyn_map = {n: list(rule.context) for n in dyn_names}
     if deps is not None:
         deps.update(local_deps)
-    return Rule.from_dict(raw)
+    return Rule.from_dict(raw), dyn_map
 
 
 def _subst_const_templates(tree: Any, env: Dict[str, Any], jp_compile,
@@ -1495,23 +1661,25 @@ def compile_rule(policy: ClusterPolicy, rule: Rule, data_sources=None,
     compiles — a host-fallback rule must not register invalidation
     hooks for configmaps no device program folds."""
     fold_deps: Dict[str, Optional[str]] = {}
+    dyn_map: Dict[str, List[Dict[str, Any]]] = {}
     if rule.validation is None:
         raise Unsupported("not a validate rule")
     if rule.context:
         folded = _fold_static_context(rule, data_sources, fold_deps)
-        if folded is None or folded.validation is None:
+        if folded is None or folded[0].validation is None:
             raise Unsupported("rule context entries")
-        rule = folded
-    prog = _compile_rule_body(policy, rule)
+        rule, dyn_map = folded
+    prog = _compile_rule_body(policy, rule, dyn_map)
     if deps is not None:
         deps.update(fold_deps)
     return prog
 
 
-def _compile_rule_body(policy: ClusterPolicy, rule: Rule) -> RuleProgram:
+def _compile_rule_body(policy: ClusterPolicy, rule: Rule,
+                       dyn_map: Optional[Dict[str, List[Dict[str, Any]]]] = None) -> RuleProgram:
     v = rule.validation
     match_ir, exclude_ir = compile_match(rule)
-    cc = ConditionCompiler()
+    cc = ConditionCompiler(dyn_vars=dyn_map)
     pre_ir = cc.compile_tree(rule.preconditions)
 
     prog = RuleProgram(
@@ -1528,8 +1696,10 @@ def _compile_rule_body(policy: ClusterPolicy, rule: Rule) -> RuleProgram:
         prog.kind = "deny"
         prog.deny = cc.compile_tree((v.deny or {}).get("conditions"))
         prog.uses_userinfo = cc.saw_userinfo
+        prog.dyn_slots = cc.dyn_slots
         return prog
     prog.uses_userinfo = cc.saw_userinfo
+    prog.dyn_slots = cc.dyn_slots
     if v.pattern is not None:
         pc = PatternCompiler()
         prog.kind = "pattern"
@@ -1548,11 +1718,15 @@ def _compile_rule_body(policy: ClusterPolicy, rule: Rule) -> RuleProgram:
         prog.kind = "foreach_deny"
         ecc = ConditionCompiler(element_mode=True)
         for fe in v.foreach:
-            extra = set(fe.keys()) - {"list", "deny"}
+            extra = set(fe.keys()) - {"list", "deny", "elementScope"}
             if extra:
                 raise Unsupported(f"foreach with {sorted(extra)}")
             if fe.get("deny") is None:
                 raise Unsupported("foreach without deny")
+            scope_flag = fe.get("elementScope")
+            if scope_flag is False:
+                # explicit false unbinds {{element}} — host semantics
+                raise Unsupported("foreach deny with elementScope=false")
             list_expr = fe.get("list", "")
             if "{{" in list_expr:
                 raise Unsupported("variable foreach list")
@@ -1560,6 +1734,7 @@ def _compile_rule_body(policy: ClusterPolicy, rule: Rule) -> RuleProgram:
             tree = ecc.compile_tree((fe["deny"] or {}).get("conditions"))
             if tree is None:
                 raise Unsupported("foreach deny without conditions")
-            prog.foreach.append(ForeachDeny(arrays, tree))
+            prog.foreach.append(ForeachDeny(arrays, tree,
+                                            strict_maps=scope_flag is True))
         return prog
     raise Unsupported("podSecurity/cel/manifest rule")

@@ -447,13 +447,15 @@ def test_static_context_folding():
          "metadata": {"name": "c"}, "spec": {}},  # default arm: 1 -> pass
     ])
     assert [int(dres.verdicts[0, i]) for i in range(3)] == [2, 0, 0]
-    # a truly dynamic entry (apiCall) that IS referenced still falls back
+    # a truly dynamic entry (apiCall) lowers via a host-resolved
+    # operand slot: the condition compares on device, the value loads
+    # through the real loaders per batch
     apicall = policy(
         [{"name": "pods", "apiCall": {"urlPath": "/api/v1/pods"}}],
         [{"key": "{{ pods }}", "operator": "Equals", "value": 1}])
     cps = compile_policy_set([apicall])
-    assert cps.coverage() == (0, 1)
-    assert "context" in cps.rules[0].fallback_reason
+    assert cps.coverage() == (1, 1)
+    assert len(cps.dyn_slots) == 1
     # ... but an UNREFERENCED dynamic entry drops away (deferred
     # loading never materializes it)
     unused = policy(
