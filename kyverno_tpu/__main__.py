@@ -1,0 +1,8 @@
+"""`python -m kyverno_tpu` — alias for `python -m kyverno_tpu.cli`."""
+
+import sys
+
+from .cli.__main__ import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -39,6 +39,22 @@ def add_parser(sub: argparse._SubParsersAction) -> None:
                    help="override the KYVERNO_TPU_ENGINE toggle")
     p.add_argument("--config", default=None,
                    help="kyverno ConfigMap-style YAML (resourceFilters etc.)")
+    # micro-batching serving pipeline (serving/batcher.py) — default
+    # off, so the existing per-flush MicroBatcher path is untouched
+    p.add_argument("--batching", action="store_true",
+                   help="coalesce concurrent AdmissionReviews into padded "
+                        "TPU batches (deadline-aware flush + shedding)")
+    p.add_argument("--max-batch-size", type=int, default=64,
+                   help="flush when this many requests are queued")
+    p.add_argument("--max-wait-ms", type=float, default=2.0,
+                   help="flush when the oldest request has waited this long")
+    p.add_argument("--deadline-ms", type=float, default=5000.0,
+                   help="per-request queue budget before deadline expiry")
+    p.add_argument("--queue-high-water", type=int, default=1024,
+                   help="queue depth beyond which requests are shed")
+    p.add_argument("--shed-mode", choices=["scalar", "fail"], default="scalar",
+                   help="shed overload to the scalar engine, or fail the "
+                        "request per the webhook path's failurePolicy")
     p.set_defaults(func=run)
 
 
@@ -46,7 +62,8 @@ class ControlPlane:
     """Everything `serve` wires together; used directly by tests."""
 
     def __init__(self, policies, port=0, metrics_port=0, cert=None, key=None,
-                 configuration=None, toggles=None):
+                 configuration=None, toggles=None, batching=False,
+                 batch_config=None):
         self.cache = PolicyCache()
         for p in policies:
             self.cache.set(p)
@@ -81,7 +98,8 @@ class ControlPlane:
         self.webhook_config.reconcile()
         self.handlers = build_handlers(
             self.cache, self.snapshot, self.aggregator,
-            configuration=self.configuration, toggles=self.toggles)
+            configuration=self.configuration, toggles=self.toggles,
+            batching=batching, batch_config=batch_config)
         self.admission = AdmissionServer(
             self.handlers, port=port, certfile=cert, keyfile=key)
         self.metrics_server = _metrics_server(self, metrics_port)
@@ -167,9 +185,20 @@ def run(args: argparse.Namespace) -> int:
             doc = yaml.safe_load(f) or {}
         configuration.load(doc.get("data") or doc)
     toggles = Toggles(engine=args.engine) if args.engine else Toggles()
+    batch_config = None
+    if args.batching:
+        from ..serving import BatchConfig
+
+        batch_config = BatchConfig(
+            max_batch_size=args.max_batch_size,
+            max_wait_ms=args.max_wait_ms,
+            deadline_ms=args.deadline_ms,
+            high_water=args.queue_high_water,
+            shed_mode=args.shed_mode)
     cp = ControlPlane(policies, port=args.port, metrics_port=args.metrics_port,
                       cert=args.cert, key=args.key,
-                      configuration=configuration, toggles=toggles)
+                      configuration=configuration, toggles=toggles,
+                      batching=args.batching, batch_config=batch_config)
     cp.start(args.scan_interval)
     print(f"admission on :{cp.admission.port}, metrics on "
           f":{cp.metrics_server.server_address[1]}, "

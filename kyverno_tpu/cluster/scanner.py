@@ -21,6 +21,7 @@ import time
 from typing import Any, Dict, List, Optional, Set, Tuple
 
 from ..observability.metrics import MetricsRegistry, global_registry
+from ..serving.dispatch import resource_verdicts
 from ..tpu.evaluator import ERROR, FAIL, NOT_MATCHED, PASS, SKIP
 from .policycache import PolicyCache
 from .reports import ReportAggregator, ReportResult
@@ -199,8 +200,10 @@ class BackgroundScanService:
             for ci, (uid, res, h) in enumerate(chunk):
                 meta = res.get("metadata") or {}
                 results = []
-                for row, (pname, rname) in enumerate(result.rules):
-                    code = int(result.verdicts[row, ci])
+                # same dispatch helper as the admission pipeline, so
+                # scan report rows and serve verdict rows can't drift
+                # in rule ordering
+                for (pname, rname), code in resource_verdicts(result, ci):
                     if code == NOT_MATCHED:
                         continue
                     status = _CODE_TO_RESULT.get(code, "error")

@@ -27,18 +27,33 @@ import datetime
 import json
 from typing import Any, Dict, List, Optional, Tuple
 
-from cryptography import x509
-from cryptography.exceptions import InvalidSignature
-from cryptography.hazmat.primitives import hashes, serialization
-from cryptography.hazmat.primitives.asymmetric import ec
-from cryptography.x509.oid import NameOID
-
-# Fulcio OIDC issuer extension
-FULCIO_ISSUER_OID = x509.ObjectIdentifier("1.3.6.1.4.1.57264.1.1")
-
-
 class CryptoError(Exception):
     pass
+
+
+try:
+    from cryptography import x509
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.x509.oid import NameOID
+
+    # Fulcio OIDC issuer extension
+    FULCIO_ISSUER_OID = x509.ObjectIdentifier("1.3.6.1.4.1.57264.1.1")
+except ImportError:  # pragma: no cover - environment-dependent
+    # the container may not ship `cryptography`; importing this module
+    # must still succeed (the admission plane imports the images
+    # subsystem unconditionally) — actual signature work raises
+    # CryptoError at use time instead
+    class InvalidSignature(Exception):  # type: ignore[no-redef]
+        pass
+
+    class _MissingCrypto:
+        def __getattr__(self, name):
+            raise CryptoError("the 'cryptography' library is not installed")
+
+    x509 = hashes = serialization = ec = NameOID = _MissingCrypto()  # type: ignore
+    FULCIO_ISSUER_OID = None
 
 
 # ---------------------------------------------------------------------------

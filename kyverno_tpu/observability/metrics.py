@@ -91,8 +91,10 @@ class Histogram:
                 cum = 0
                 for b, c in zip(self.buckets, self._counts[k]):
                     cum += c
-                    out.append(f"{self.name}_bucket{_fmt_labels(k, f'le=\"{b}\"')} {cum}")
-                out.append(f"{self.name}_bucket{_fmt_labels(k, 'le=\"+Inf\"')} {self._totals[k]}")
+                    le = f'le="{b}"'
+                    out.append(f"{self.name}_bucket{_fmt_labels(k, le)} {cum}")
+                inf = 'le="+Inf"'
+                out.append(f"{self.name}_bucket{_fmt_labels(k, inf)} {self._totals[k]}")
                 out.append(f"{self.name}_sum{_fmt_labels(k)} {self._sums[k]}")
                 out.append(f"{self.name}_count{_fmt_labels(k)} {self._totals[k]}")
         return out
@@ -121,6 +123,31 @@ class MetricsRegistry:
             "kyverno_tpu_device_dispatch_seconds", "device program wall time")
         self.compile_cache = self.counter(
             "kyverno_tpu_compile_cache_total", "policy-set compiles by outcome")
+        # serving pipeline instruments (serving/batcher.py): queue
+        # depth, batch occupancy, flush reasons, shed/expiry counters,
+        # and submit-to-verdict latency (p50-p99 read from buckets)
+        self.serving_queue_depth = self.gauge(
+            "kyverno_serving_queue_depth",
+            "admission requests waiting in the batching queue")
+        self.serving_batch_size = self.histogram(
+            "kyverno_serving_batch_size",
+            "live requests per batched device dispatch",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256))
+        self.serving_batch_occupancy = self.histogram(
+            "kyverno_serving_batch_occupancy",
+            "live requests / padded bucket capacity per flush",
+            buckets=(0.125, 0.25, 0.5, 0.75, 0.9, 1.0))
+        self.serving_flush_total = self.counter(
+            "kyverno_serving_flush_total", "batch flushes by trigger reason")
+        self.serving_shed_total = self.counter(
+            "kyverno_serving_shed_total",
+            "requests shed at the queue high-water mark by outcome")
+        self.serving_deadline_expired_total = self.counter(
+            "kyverno_serving_deadline_expired_total",
+            "requests whose deadline expired while queued")
+        self.serving_request_latency = self.histogram(
+            "kyverno_serving_request_latency_seconds",
+            "admission submit-to-verdict latency")
         # scan_stream phase split (SURVEY §5: encode/device/host costs)
         self.scan_encode_seconds = self.histogram(
             "kyverno_tpu_scan_encode_seconds", "host encode time per scan")

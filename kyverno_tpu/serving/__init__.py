@@ -1,0 +1,23 @@
+"""Micro-batching admission pipeline.
+
+The traffic-facing counterpart of the scan engine's batch-first design:
+concurrent AdmissionReviews coalesce into padded, shape-bucketed device
+batches (one XLA program per bucket, reused across flushes), with
+deadline-aware flushing, overload shedding, and per-request verdict
+dispatch. See serving/batcher.py for the pipeline proper.
+"""
+
+from .batcher import AdmissionPipeline, BatchConfig
+from .dispatch import resource_verdicts
+from .queue import (AdmissionQueue, DeadlineExceededError, QueuedRequest,
+                    QueueFullError)
+
+__all__ = [
+    "AdmissionPipeline",
+    "AdmissionQueue",
+    "BatchConfig",
+    "DeadlineExceededError",
+    "QueueFullError",
+    "QueuedRequest",
+    "resource_verdicts",
+]

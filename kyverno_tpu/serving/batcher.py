@@ -1,0 +1,231 @@
+"""AdmissionPipeline — coalesce concurrent requests into padded device
+batches.
+
+The pipeline sits between the HTTP admission handler and the batch
+engine. A dedicated flusher thread drains the bounded queue when either
+`max_batch_size` requests accumulate or the oldest entry has waited
+`max_wait_ms` — flushing EARLY when an entry's deadline would otherwise
+expire before the timer matures (deadline-aware flush). Each flush pads
+the live requests up to a power-of-two bucket so the device program is
+dispatched at one of O(log2) shapes: the XLA jit cache is keyed by
+shape, so bucketed padding means batches of 3, 9, or 14 requests all
+reuse the 16-wide compiled program instead of churning recompiles.
+
+Overload policy: when the queue is at its high-water mark, submit()
+sheds — either degrading the single request to the caller-supplied
+scalar fallback (graceful degradation, verdicts still exact) or raising
+QueueFullError for the handler to translate per failurePolicy. The
+queue never blocks unboundedly.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from ..observability.metrics import MetricsRegistry, global_registry
+from .queue import (AdmissionQueue, DeadlineExceededError, QueuedRequest,
+                    QueueFullError)
+
+
+@dataclass
+class BatchConfig:
+    max_batch_size: int = 64
+    max_wait_ms: float = 2.0
+    # total budget a request may spend queued; in-flight evaluation is
+    # allowed to complete past it (eval_grace_s bounds the full wait)
+    deadline_ms: float = 5000.0
+    # how far BEFORE the oldest entry's deadline a deadline-triggered
+    # flush fires: flushing at the deadline itself would drain an
+    # already-expired entry that then never reaches the evaluator
+    deadline_lead_ms: float = 2.0
+    high_water: int = 1024
+    shed_mode: str = "scalar"  # scalar | fail
+    # smallest padded shape; callers wiring the pipeline to a TpuEngine
+    # overwrite this with TpuEngine.MIN_BUCKET (webhooks/server.py,
+    # bench.py) so the pipeline's padding and the engine's own
+    # bucketing agree on the dispatched shape (no double padding).
+    # serving/ stays jax-free, so the engine constant is not imported
+    # here
+    min_bucket: int = 16
+    eval_grace_s: float = 30.0
+
+    def bucket(self, n: int) -> int:
+        b = self.min_bucket
+        while b < n:
+            b *= 2
+        return b
+
+
+class AdmissionPipeline:
+    """evaluate_fn(padded_payloads) -> per-payload results.
+
+    `padded_payloads` is the drained batch padded with None up to the
+    bucket size; evaluate_fn must return at least as many results as
+    there are real (non-None) leading payloads. scalar_fallback(payload)
+    -> result is the single-request degradation path used on shed."""
+
+    def __init__(
+        self,
+        evaluate_fn: Callable[[List[Any]], List[Any]],
+        scalar_fallback: Optional[Callable[[Any], Any]] = None,
+        config: Optional[BatchConfig] = None,
+        metrics: Optional[MetricsRegistry] = None,
+    ) -> None:
+        self._fn = evaluate_fn
+        self._scalar = scalar_fallback
+        self.config = config or BatchConfig()
+        self.metrics = metrics or global_registry
+        self.queue = AdmissionQueue(self.config.high_water)
+        self._stopped = False
+        self.stats: Dict[str, Any] = {
+            "requests": 0, "flushes": 0, "evaluated": 0, "shed": 0,
+            "expired": 0, "flush_reasons": {}, "flushes_by_bucket": {},
+            "occupancy_sum": 0.0,
+        }
+        self._stats_lock = threading.Lock()
+        self.metrics.serving_queue_depth.set(0)
+        self._flusher = threading.Thread(target=self._run, daemon=True,
+                                         name="admission-flusher")
+        self._flusher.start()
+
+    # -- caller side
+
+    def submit(self, payload: Any, deadline_ms: Optional[float] = None) -> Any:
+        if self._stopped:
+            raise RuntimeError("admission pipeline is stopped")
+        budget = (deadline_ms if deadline_ms is not None
+                  else self.config.deadline_ms) / 1000.0
+        t0 = time.monotonic()
+        try:
+            req = self.queue.put(payload, t0 + budget, now=t0)
+        except QueueFullError:
+            with self._stats_lock:
+                self.stats["shed"] += 1
+            if self.config.shed_mode == "scalar" and self._scalar is not None:
+                self.metrics.serving_shed_total.inc({"outcome": "scalar"})
+                out = self._scalar(payload)
+                self.metrics.serving_request_latency.observe(
+                    time.monotonic() - t0, {"path": "shed"})
+                return out
+            self.metrics.serving_shed_total.inc({"outcome": "rejected"})
+            raise
+        self.metrics.serving_queue_depth.set(self.queue.depth())
+        # the deadline governs QUEUE time; once dispatched, the device
+        # call is allowed eval_grace_s to complete
+        if not req.event.wait(budget + self.config.eval_grace_s):
+            raise DeadlineExceededError("admission batch evaluation timed out")
+        self.metrics.serving_request_latency.observe(
+            time.monotonic() - t0, {"path": "batched"})
+        if isinstance(req.result, BaseException):
+            raise req.result
+        return req.result
+
+    def stop(self) -> None:
+        with self.queue.cv:
+            self._stopped = True
+            self.queue.closed = True
+            self.queue.cv.notify_all()
+        self._flusher.join(timeout=self.config.eval_grace_s)
+
+    # -- flusher side
+
+    def _run(self) -> None:
+        cfg = self.config
+        max_wait = cfg.max_wait_ms / 1000.0
+        lead = cfg.deadline_lead_ms / 1000.0
+        while True:
+            with self.queue.cv:
+                while True:
+                    if self.queue.depth() >= cfg.max_batch_size:
+                        reason = "size"
+                        break
+                    oldest = self.queue.oldest()
+                    if self._stopped:
+                        # final drain: anything still queued flushes now
+                        # (an empty queue makes this a no-op exit)
+                        reason = "shutdown"
+                        break
+                    if oldest is None:
+                        self.queue.cv.wait()
+                        continue
+                    now = time.monotonic()
+                    # deadline-aware: flush when the timer matures OR —
+                    # EARLY, with deadline_lead_ms to spare — when
+                    # waiting for the timer would expire the oldest
+                    # entry before it ever reaches the evaluator
+                    timer_at = oldest.enqueued_at + max_wait
+                    deadline_at = oldest.deadline - lead
+                    flush_at = min(timer_at, deadline_at)
+                    if now >= flush_at:
+                        reason = "timer" if timer_at <= deadline_at \
+                            else "deadline"
+                        break
+                    self.queue.cv.wait(flush_at - now)
+                batch = self.queue.drain(cfg.max_batch_size)
+                drained_at = time.monotonic()
+                stopped = self._stopped
+            if batch:
+                self._process(batch, reason, drained_at)
+                self.metrics.serving_queue_depth.set(self.queue.depth())
+            if stopped and not batch:
+                return
+
+    def _process(self, batch: List[QueuedRequest], reason: str,
+                 now: Optional[float] = None) -> None:
+        # expiry is judged at the moment the flush decision drained the
+        # queue: a deadline-triggered flush fires deadline_lead_ms early
+        # precisely so the entry it fires for is still live here, and
+        # scheduling jitter between drain and this check must not
+        # re-expire it (submit()'s wait has eval_grace_s slack anyway)
+        if now is None:
+            now = time.monotonic()
+        live: List[QueuedRequest] = []
+        for req in batch:
+            if req.deadline <= now:
+                # expired mid-queue: resolve with the error instead of
+                # spending device work on a verdict nobody is waiting for
+                req.resolve(DeadlineExceededError(
+                    "request deadline expired while queued"))
+            else:
+                live.append(req)
+        n_expired = len(batch) - len(live)
+        if n_expired:
+            self.metrics.serving_deadline_expired_total.inc(value=n_expired)
+        bucket = self.config.bucket(len(live)) if live else 0
+        with self._stats_lock:
+            self.stats["requests"] += len(batch)
+            self.stats["expired"] += n_expired
+            self.stats["flushes"] += 1
+            reasons = self.stats["flush_reasons"]
+            reasons[reason] = reasons.get(reason, 0) + 1
+            if live:
+                by_bucket = self.stats["flushes_by_bucket"]
+                by_bucket[bucket] = by_bucket.get(bucket, 0) + 1
+                self.stats["evaluated"] += len(live)
+                self.stats["occupancy_sum"] += len(live) / bucket
+        self.metrics.serving_flush_total.inc({"reason": reason})
+        if not live:
+            return
+        self.metrics.serving_batch_size.observe(len(live))
+        self.metrics.serving_batch_occupancy.observe(len(live) / bucket)
+        padded = [req.payload for req in live] + [None] * (bucket - len(live))
+        try:
+            results = self._fn(padded)
+            if len(results) < len(live):
+                raise RuntimeError("batch evaluator returned wrong arity")
+        except BaseException as e:  # propagate to every waiter
+            for req in live:
+                req.resolve(e)
+            return
+        for req, result in zip(live, results):
+            req.resolve(result)
+
+    # -- introspection
+
+    def mean_batch_size(self) -> float:
+        with self._stats_lock:
+            flushes = sum(self.stats["flushes_by_bucket"].values())
+            return self.stats["evaluated"] / flushes if flushes else 0.0

@@ -1,0 +1,76 @@
+"""Bounded admission queue — arrival times, deadlines, backpressure.
+
+Every entry carries its arrival time and an absolute deadline; the
+queue refuses work past a high-water mark (QueueFullError) instead of
+blocking unboundedly, so overload surfaces as an explicit shed decision
+at the pipeline layer rather than as threads piling up on a lock.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, List, Optional
+
+
+class QueueFullError(RuntimeError):
+    """Queue depth crossed the high-water mark; request was shed."""
+
+
+class DeadlineExceededError(TimeoutError):
+    """Request spent its whole deadline budget waiting in the queue."""
+
+
+class QueuedRequest:
+    __slots__ = ("payload", "enqueued_at", "deadline", "event", "result")
+
+    def __init__(self, payload: Any, enqueued_at: float, deadline: float):
+        self.payload = payload
+        self.enqueued_at = enqueued_at
+        self.deadline = deadline  # absolute monotonic time
+        self.event = threading.Event()
+        self.result: Any = None
+
+    def resolve(self, result: Any) -> None:
+        self.result = result
+        self.event.set()
+
+
+class AdmissionQueue:
+    """FIFO of QueuedRequests guarded by one condition variable: put()
+    notifies the flusher; the flusher sleeps on the cv until work
+    arrives or its flush timer matures."""
+
+    def __init__(self, high_water: int = 1024):
+        self.high_water = high_water
+        self.cv = threading.Condition()
+        # set under cv together with the pipeline's stop flag: a put
+        # racing shutdown either fails fast here or lands before the
+        # final drain — never stranded until the wait timeout
+        self.closed = False
+        self._items: List[QueuedRequest] = []
+
+    def put(self, payload: Any, deadline: float,
+            now: Optional[float] = None) -> QueuedRequest:
+        req = QueuedRequest(payload, now if now is not None
+                            else time.monotonic(), deadline)
+        with self.cv:
+            if self.closed:
+                raise RuntimeError("admission queue is closed")
+            if len(self._items) >= self.high_water:
+                raise QueueFullError(
+                    f"admission queue at high-water mark ({self.high_water})")
+            self._items.append(req)
+            self.cv.notify_all()
+        return req
+
+    def drain(self, max_n: int) -> List[QueuedRequest]:
+        """Pop up to max_n oldest entries. Callers hold self.cv."""
+        batch, self._items = self._items[:max_n], self._items[max_n:]
+        return batch
+
+    def depth(self) -> int:
+        return len(self._items)
+
+    def oldest(self) -> Optional[QueuedRequest]:
+        return self._items[0] if self._items else None

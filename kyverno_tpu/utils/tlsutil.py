@@ -16,10 +16,19 @@ import threading
 import time
 from typing import Callable, List, Optional, Tuple
 
-from cryptography import x509
-from cryptography.hazmat.primitives import hashes, serialization
-from cryptography.hazmat.primitives.asymmetric import rsa
-from cryptography.x509.oid import NameOID
+try:
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+except ImportError:  # pragma: no cover - environment-dependent
+    # keep the module importable without `cryptography` (see
+    # images/crypto.py); cert generation raises at use time
+    class _MissingCrypto:
+        def __getattr__(self, name):
+            raise RuntimeError("the 'cryptography' library is not installed")
+
+    x509 = hashes = serialization = rsa = NameOID = _MissingCrypto()  # type: ignore
 
 CA_VALIDITY_S = 365 * 24 * 3600.0        # tls/certmanager: 1 year
 CERT_VALIDITY_S = 183 * 24 * 3600.0      # ~6 months

@@ -27,6 +27,7 @@ from ..cluster.reports import ReportAggregator, ReportResult
 from ..cluster.snapshot import ClusterSnapshot, resource_uid
 from ..engine.engine import Engine as ScalarEngine
 from ..engine.match import RequestInfo
+from ..serving import AdmissionPipeline, BatchConfig, resource_verdicts
 from ..tpu.engine import (TpuEngine, VERDICT_NAMES, _scalar_rule_verdicts,
                           build_scan_context)
 from ..tpu.evaluator import ERROR, FAIL, NOT_MATCHED
@@ -61,6 +62,8 @@ class Handlers:
         registry_client=None,
         iv_cache=None,
         exceptions=None,
+        batching: bool = False,
+        batch_config: Optional[BatchConfig] = None,
     ) -> None:
         self.cache = cache
         self.snapshot = snapshot
@@ -79,6 +82,22 @@ class Handlers:
         self._rbac_needed: Dict[int, bool] = {}  # per cache revision
         self._lock = threading.Lock()
         self.batcher = MicroBatcher(self._evaluate_batch, max_batch, max_wait_ms)
+        # --batching: the serving pipeline replaces the plain batcher on
+        # the validate path — shape-bucketed padding, deadline-aware
+        # flushing, and high-water shedding (serving/batcher.py)
+        self.pipeline: Optional[AdmissionPipeline] = None
+        if batching:
+            cfg = batch_config or BatchConfig(
+                max_batch_size=max_batch, max_wait_ms=max_wait_ms)
+            # the pipeline's padding and the engine's own bucketing must
+            # agree on the dispatched shape (no double padding, no
+            # surprise recompiles) — the engine is the single source
+            cfg.min_bucket = TpuEngine.MIN_BUCKET
+            self.pipeline = AdmissionPipeline(
+                self._evaluate_padded,
+                scalar_fallback=self._scalar_verdict_rows,
+                config=cfg,
+                metrics=self.metrics)
 
     # -- engine cache keyed by policy revision (compile-cache churn control)
 
@@ -106,46 +125,71 @@ class Handlers:
                 self._rbac_needed[rev] = need
         return need
 
-    def _evaluate_batch(self, payloads: List[AdmissionPayload]):
+    def _scalar_verdict_rows(self, payload: AdmissionPayload):
+        """One request through the scalar oracle, emitted in the same
+        compiled-rule row order as the batch path (the shed/degradation
+        path must be bit-identical to the batched one)."""
         _, eng = self._engine()
-        resources = [
-            p.old if (p.operation == "DELETE" and p.old) else p.resource
-            for p in payloads
-        ]
+        res = payload.old if (payload.operation == "DELETE" and payload.old) \
+            else payload.resource
         ns_labels = self.snapshot.namespace_labels() if self.snapshot else {}
+        per_policy: Dict[int, Dict[str, int]] = {}
+        rows = []
+        for entry in eng.cps.rules:
+            verdicts = per_policy.get(entry.policy_idx)
+            if verdicts is None:
+                policy = eng.cps.policies[entry.policy_idx]
+                pctx = build_scan_context(
+                    policy, res, ns_labels.get(payload.namespace, {}),
+                    payload.operation, payload.info)
+                verdicts = _scalar_rule_verdicts(self.scalar, policy, pctx)
+                per_policy[entry.policy_idx] = verdicts
+            rows.append(((entry.policy_name, entry.rule_name),
+                         verdicts.get(entry.rule_name, NOT_MATCHED)))
+        return rows
+
+    def _evaluate_batch(self, payloads: List[AdmissionPayload]):
+        # unpadded MicroBatcher path: same evaluator as the serving
+        # pipeline (zero pad slots), so batched and non-batched verdict
+        # computation cannot drift
+        return self._evaluate_padded(payloads)
+
+    def _evaluate_padded(self, payloads: List[Optional[AdmissionPayload]]):
+        """Batch evaluator shared by the MicroBatcher (no pad slots) and
+        the serving pipeline, whose batches arrive padded with trailing
+        None up to their shape bucket; pad slots encode as empty
+        resources so every flush dispatches at a bucketed
+        (compile-cached) shape. HOST-flagged cells inside eng.scan
+        complete via the scalar engine — a request the device path can't
+        cover degrades to the host oracle instead of failing the whole
+        batch."""
+        pad = AdmissionPayload({}, "", RequestInfo(), "")
+        real_n = sum(1 for p in payloads if p is not None)
+        filled = [p if p is not None else pad for p in payloads]
         t0 = time.perf_counter()
         if self.toggles.engine == "scalar":
             # toggle-gated host path (pkg/toggle analogue): same verdict
             # table, computed by the scalar oracle per (policy, resource)
-            out = []
-            for p, res in zip(payloads, resources):
-                pctx_rows = []
-                for entry in eng.cps.rules:
-                    policy = eng.cps.policies[entry.policy_idx]
-                    pctx = build_scan_context(
-                        policy, res, ns_labels.get(p.namespace, {}),
-                        p.operation, p.info)
-                    verdicts = _scalar_rule_verdicts(self.scalar, policy, pctx)
-                    pctx_rows.append(((entry.policy_name, entry.rule_name),
-                                      verdicts.get(entry.rule_name, NOT_MATCHED)))
-                out.append(pctx_rows)
+            out = [self._scalar_verdict_rows(p) for p in filled[:real_n]]
             self.metrics.device_dispatch.observe(time.perf_counter() - t0,
                                                  {"engine": "scalar"})
             return out
+        _, eng = self._engine()
+        resources = [
+            p.old if (p.operation == "DELETE" and p.old) else p.resource
+            for p in filled
+        ]
+        ns_labels = self.snapshot.namespace_labels() if self.snapshot else {}
         result = eng.scan(
             resources,
             ns_labels,
-            operations=[p.operation for p in payloads],
-            admission_infos=[p.info for p in payloads],
+            operations=[p.operation for p in filled],
+            admission_infos=[p.info for p in filled],
         )
         self.metrics.device_dispatch.observe(time.perf_counter() - t0,
                                              {"engine": "tpu"})
-        self.metrics.batch_size.observe(len(payloads))
-        return [
-            [(result.rules[row], int(result.verdicts[row, ci]))
-             for row in range(len(result.rules))]
-            for ci in range(len(payloads))
-        ]
+        self.metrics.batch_size.observe(real_n)
+        return [resource_verdicts(result, ci) for ci in range(real_n)]
 
     # -- public handlers
 
@@ -202,7 +246,14 @@ class Handlers:
             allowed = failure_policy == "ignore"
             return _response(req, allowed, f"evaluation error: {e}")
         try:
-            verdicts = self.batcher.submit(payload)
+            # --batching routes through the serving pipeline (padded
+            # shape buckets, deadline-aware flush, high-water shedding);
+            # a shed in "fail" mode or an expired deadline lands here as
+            # an exception and resolves per failurePolicy below
+            if self.pipeline is not None:
+                verdicts = self.pipeline.submit(payload)
+            else:
+                verdicts = self.batcher.submit(payload)
         except Exception as e:
             allowed = failure_policy == "ignore"
             return _response(req, allowed, f"evaluation error: {e}")
@@ -613,3 +664,5 @@ class AdmissionServer:
     def stop(self) -> None:
         self._httpd.shutdown()
         self.handlers.batcher.stop()
+        if self.handlers.pipeline is not None:
+            self.handlers.pipeline.stop()

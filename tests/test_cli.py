@@ -69,6 +69,31 @@ def test_apply_pass_exit_zero(files, tmp_path, capsys):
     assert rc == 0 and out["summary"]["fail"] == 0
 
 
+def test_serve_batching_help(capsys):
+    """`serve --batching --help` must parse: the batching flag set is
+    part of the CLI surface, not an internal-only knob."""
+    with pytest.raises(SystemExit) as exc:
+        main(["serve", "--batching", "--help"])
+    assert exc.value.code == 0
+    out = capsys.readouterr().out
+    for flag in ("--batching", "--max-batch-size", "--max-wait-ms",
+                 "--deadline-ms", "--queue-high-water", "--shed-mode"):
+        assert flag in out
+
+
+def test_serve_batching_help_module_entry():
+    """The literal `python -m kyverno_tpu serve --batching --help`
+    invocation (package-level __main__) exits 0 and shows the flags."""
+    import subprocess
+    import sys
+
+    r = subprocess.run(
+        [sys.executable, "-m", "kyverno_tpu", "serve", "--batching", "--help"],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    assert "--batching" in r.stdout and "--shed-mode" in r.stdout
+
+
 def test_jp_query(capsys):
     import io
     import sys

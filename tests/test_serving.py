@@ -1,0 +1,353 @@
+"""Serving pipeline: queue/batcher edge cases (fake evaluator, no
+device) + a fast CPU batching smoke test proving batched verdicts match
+the scalar oracle through the real Handlers."""
+
+import concurrent.futures
+import threading
+import time
+
+import pytest
+
+from kyverno_tpu.serving import (AdmissionPipeline, BatchConfig,
+                                 AdmissionQueue, DeadlineExceededError,
+                                 QueueFullError)
+
+# ---------------------------------------------------------------------------
+# queue
+
+
+def test_queue_fifo_and_high_water():
+    q = AdmissionQueue(high_water=3)
+    reqs = [q.put(i, deadline=time.monotonic() + 10) for i in range(3)]
+    with pytest.raises(QueueFullError):
+        q.put(99, deadline=time.monotonic() + 10)
+    with q.cv:
+        batch = q.drain(2)
+    assert [r.payload for r in batch] == [0, 1]
+    assert q.depth() == 1 and q.oldest() is reqs[2]
+
+
+def test_queue_put_after_close_fails_fast():
+    q = AdmissionQueue()
+    with q.cv:
+        q.closed = True
+    with pytest.raises(RuntimeError, match="closed"):
+        q.put(1, deadline=time.monotonic() + 10)
+
+
+# ---------------------------------------------------------------------------
+# pipeline edge cases (fake evaluate_fn — the contract is: payloads
+# arrive padded with None to the shape bucket, results cover the real
+# leading prefix)
+
+
+def _echo_evaluate(calls=None):
+    def fn(payloads):
+        if calls is not None:
+            calls.append(list(payloads))
+        return [("ok", p) for p in payloads if p is not None]
+    return fn
+
+
+def test_single_request_light_load_pads_to_min_bucket():
+    calls = []
+    p = AdmissionPipeline(_echo_evaluate(calls),
+                          config=BatchConfig(max_batch_size=8, max_wait_ms=1.0,
+                                             min_bucket=16))
+    assert p.submit("r1") == ("ok", "r1")
+    p.stop()
+    assert len(calls) == 1
+    assert len(calls[0]) == 16 and calls[0][0] == "r1"
+    assert calls[0][1:] == [None] * 15  # padded, not recompiled-for-1
+    assert p.stats["flushes_by_bucket"] == {16: 1}
+    assert p.stats["flush_reasons"].get("timer", 0) == 1
+
+
+def test_size_flush_at_max_batch():
+    calls = []
+    ev = threading.Event()
+
+    def gated(payloads):
+        ev.wait(5)
+        return _echo_evaluate(calls)(payloads)
+
+    p = AdmissionPipeline(gated, config=BatchConfig(
+        max_batch_size=4, max_wait_ms=5000.0, min_bucket=4))
+    with concurrent.futures.ThreadPoolExecutor(max_workers=4) as ex:
+        futs = [ex.submit(p.submit, f"r{i}") for i in range(4)]
+        ev.set()
+        outs = [f.result(timeout=10) for f in futs]
+    p.stop()
+    assert sorted(o[1] for o in outs) == ["r0", "r1", "r2", "r3"]
+    assert p.stats["flush_reasons"].get("size", 0) >= 1
+
+
+def test_empty_flush_on_shutdown_is_noop():
+    p = AdmissionPipeline(_echo_evaluate())
+    p.stop()
+    assert p.stats["flushes"] == 0 and p.stats["requests"] == 0
+    assert not p._flusher.is_alive()
+    with pytest.raises(RuntimeError):
+        p.submit("late")
+
+
+def test_shutdown_flushes_queued_requests():
+    # a request sitting under a long flush timer still completes when
+    # stop() triggers the final shutdown drain
+    p = AdmissionPipeline(_echo_evaluate(), config=BatchConfig(
+        max_batch_size=64, max_wait_ms=60_000.0))
+    with concurrent.futures.ThreadPoolExecutor(max_workers=1) as ex:
+        fut = ex.submit(p.submit, "r1")
+        time.sleep(0.05)  # let it enqueue (flusher now sleeping on timer)
+        p.stop()
+        assert fut.result(timeout=10) == ("ok", "r1")
+    assert p.stats["flush_reasons"].get("shutdown", 0) == 1
+
+
+def test_deadline_expiry_mid_queue():
+    started = threading.Event()
+    release = threading.Event()
+
+    def slow(payloads):
+        started.set()
+        release.wait(10)
+        return [("ok", p) for p in payloads if p is not None]
+
+    p = AdmissionPipeline(slow, config=BatchConfig(
+        max_batch_size=1, max_wait_ms=1.0, min_bucket=1))
+    with concurrent.futures.ThreadPoolExecutor(max_workers=2) as ex:
+        f1 = ex.submit(p.submit, "r1")
+        assert started.wait(5)  # r1's batch is on the (blocked) device
+        f2 = ex.submit(p.submit, "r2", 20.0)  # 20 ms budget, queued
+        time.sleep(0.1)  # r2's deadline expires while waiting in queue
+        release.set()
+        assert f1.result(timeout=10) == ("ok", "r1")
+        with pytest.raises(DeadlineExceededError):
+            f2.result(timeout=10)
+    p.stop()
+    assert p.stats["expired"] == 1
+
+
+def test_deadline_shorter_than_timer_still_evaluates():
+    """A deadline tighter than max_wait_ms must trigger an EARLY flush
+    that evaluates the request — not drain it already expired (the
+    flush leads the deadline by deadline_lead_ms)."""
+    p = AdmissionPipeline(
+        lambda payloads: [("ok", x) for x in payloads if x is not None],
+        config=BatchConfig(max_batch_size=8, max_wait_ms=500.0,
+                           min_bucket=1, deadline_lead_ms=20.0))
+    t0 = time.monotonic()
+    assert p.submit("r", deadline_ms=100.0) == ("ok", "r")
+    assert time.monotonic() - t0 < 0.5  # deadline flush, not the timer
+    p.stop()
+    assert p.stats["expired"] == 0
+    assert p.stats["flush_reasons"] == {"deadline": 1}
+
+
+def test_queue_full_sheds_to_fallback_scalar():
+    started = threading.Event()
+    release = threading.Event()
+
+    def slow(payloads):
+        started.set()
+        release.wait(10)
+        return [("batched", p) for p in payloads if p is not None]
+
+    p = AdmissionPipeline(
+        slow, scalar_fallback=lambda payload: ("scalar", payload),
+        config=BatchConfig(max_batch_size=1, max_wait_ms=1.0, min_bucket=1,
+                           high_water=1, shed_mode="scalar"))
+    with concurrent.futures.ThreadPoolExecutor(max_workers=2) as ex:
+        f1 = ex.submit(p.submit, "r1")
+        assert started.wait(5)
+        f2 = ex.submit(p.submit, "r2")  # fills the queue to high-water
+        time.sleep(0.05)
+        assert p.submit("r3") == ("scalar", "r3")  # shed, degraded inline
+        release.set()
+        assert f1.result(timeout=10) == ("batched", "r1")
+        assert f2.result(timeout=10) == ("batched", "r2")
+    p.stop()
+    assert p.stats["shed"] == 1
+
+
+def test_queue_full_shed_mode_fail_raises():
+    started = threading.Event()
+    release = threading.Event()
+
+    def slow(payloads):
+        started.set()
+        release.wait(10)
+        return [("batched", p) for p in payloads if p is not None]
+
+    p = AdmissionPipeline(slow, config=BatchConfig(
+        max_batch_size=1, max_wait_ms=1.0, min_bucket=1,
+        high_water=1, shed_mode="fail"))
+    with concurrent.futures.ThreadPoolExecutor(max_workers=2) as ex:
+        f1 = ex.submit(p.submit, "r1")
+        assert started.wait(5)
+        f2 = ex.submit(p.submit, "r2")
+        time.sleep(0.05)
+        with pytest.raises(QueueFullError):
+            p.submit("r3")
+        release.set()
+        f1.result(timeout=10)
+        f2.result(timeout=10)
+    p.stop()
+
+
+def test_evaluator_error_propagates_to_all_waiters():
+    def boom(payloads):
+        raise ValueError("device fell over")
+
+    p = AdmissionPipeline(boom, config=BatchConfig(
+        max_batch_size=2, max_wait_ms=1.0, min_bucket=2))
+    with concurrent.futures.ThreadPoolExecutor(max_workers=2) as ex:
+        futs = [ex.submit(p.submit, f"r{i}") for i in range(2)]
+        for f in futs:
+            with pytest.raises(ValueError, match="device fell over"):
+                f.result(timeout=10)
+    p.stop()
+
+
+def test_bucket_shapes_are_powers_of_two():
+    cfg = BatchConfig(min_bucket=16, max_batch_size=100)
+    assert [cfg.bucket(n) for n in (1, 16, 17, 33, 100)] == [16, 16, 32, 64, 128]
+
+
+# ---------------------------------------------------------------------------
+# CPU batching smoke: real Handlers, batched verdicts == scalar oracle,
+# including a mixed device/host-fallback batch
+
+
+DEVICE_POLICY = {
+    "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+    "metadata": {"name": "no-privileged"},
+    "spec": {
+        "validationFailureAction": "Enforce",
+        "rules": [{
+            "name": "privileged",
+            "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+            "validate": {
+                "message": "privileged is forbidden",
+                "pattern": {"spec": {"containers": [
+                    {"=(securityContext)": {"=(privileged)": "false"}}]}},
+            },
+        }],
+    },
+}
+
+# deprecated `In` operator -> host-only rule (tpu/ir.py): resources it
+# matches complete via the scalar engine INSIDE the batch
+HOST_POLICY = {
+    "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+    "metadata": {"name": "host-only-cm"},
+    "spec": {
+        "validationFailureAction": "Enforce",
+        "rules": [{
+            "name": "cm-keys",
+            "match": {"any": [{"resources": {"kinds": ["ConfigMap"]}}]},
+            "validate": {"message": "forbidden key", "deny": {"conditions": {
+                "any": [{"key": "forbidden", "operator": "In",
+                         "value": "{{ request.object.data.keys(@) }}"}]}}},
+        }],
+    },
+}
+
+
+def _pod(name, priv):
+    return {"apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": name, "namespace": "default"},
+            "spec": {"containers": [{
+                "name": "c", "image": "nginx",
+                "securityContext": {"privileged": priv}}]}}
+
+
+def _cm(name, key):
+    return {"apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": {"name": name, "namespace": "default"},
+            "data": {key: "x"}}
+
+
+def _review(resource, uid):
+    return {"apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview",
+            "request": {"uid": uid, "operation": "CREATE",
+                        "namespace": "default", "object": resource}}
+
+
+def _mk_handlers(batching, engine=None, **batch_kw):
+    from kyverno_tpu.api.policy import ClusterPolicy
+    from kyverno_tpu.cluster import PolicyCache
+    from kyverno_tpu.config import Toggles
+    from kyverno_tpu.webhooks import build_handlers
+
+    cache = PolicyCache()
+    cache.set(ClusterPolicy.from_dict(DEVICE_POLICY))
+    cache.set(ClusterPolicy.from_dict(HOST_POLICY))
+    kw = {}
+    if batching:
+        kw["batch_config"] = BatchConfig(**batch_kw) if batch_kw else None
+    return build_handlers(cache, batching=batching,
+                          toggles=Toggles(engine=engine) if engine else None,
+                          **kw)
+
+
+def test_batched_verdicts_match_scalar_mixed_host_fallback():
+    resources = ([_pod(f"p{i}", i % 2 == 0) for i in range(6)]
+                 + [_cm("cm-bad", "forbidden"), _cm("cm-ok", "a")])
+    reviews = [_review(r, f"u{i}") for i, r in enumerate(resources)]
+
+    batched = _mk_handlers(batching=True, max_batch_size=8, max_wait_ms=10.0)
+    _, eng = batched._engine()
+    dev, total = eng.cps.coverage()
+    assert dev < total, "host-only rule must NOT lower to device"
+    with concurrent.futures.ThreadPoolExecutor(max_workers=8) as ex:
+        got = list(ex.map(batched.validate, reviews))
+    batched.pipeline.stop()
+    batched.batcher.stop()
+
+    scalar = _mk_handlers(batching=False, engine="scalar")
+    want = [scalar.validate(r) for r in reviews]
+    scalar.batcher.stop()
+
+    assert [g["response"]["allowed"] for g in got] \
+        == [w["response"]["allowed"] for w in want]
+    assert [g["response"].get("status") for g in got] \
+        == [w["response"].get("status") for w in want]
+    # the host-matched configmap really was decided inside a batch
+    assert got[6]["response"]["allowed"] is False
+    assert p_stats_requests(batched) == len(reviews)
+
+
+def p_stats_requests(handlers):
+    return handlers.pipeline.stats["requests"] + handlers.pipeline.stats["shed"]
+
+
+def test_serving_metrics_exposed_on_metrics_endpoint():
+    import http.client
+    import json as _json
+
+    from kyverno_tpu.api.policy import ClusterPolicy
+    from kyverno_tpu.cli.serve import ControlPlane
+
+    cp = ControlPlane([ClusterPolicy.from_dict(DEVICE_POLICY)],
+                      port=0, metrics_port=0, batching=True)
+    cp.start(scan_interval=3600)
+    try:
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", cp.admission.port, timeout=60)
+        conn.request("POST", "/validate", _json.dumps(_review(_pod("m", True), "u")),
+                     {"Content-Type": "application/json"})
+        out = _json.loads(conn.getresponse().read())
+        conn.close()
+        assert out["response"]["allowed"] is False
+        mport = cp.metrics_server.server_address[1]
+        conn = http.client.HTTPConnection("127.0.0.1", mport, timeout=60)
+        conn.request("GET", "/metrics")
+        body = conn.getresponse().read().decode()
+        conn.close()
+    finally:
+        cp.stop()
+    assert "kyverno_serving_queue_depth" in body
+    assert 'kyverno_serving_flush_total{reason=' in body
+    assert "kyverno_serving_batch_occupancy_bucket" in body
+    assert "kyverno_serving_request_latency_seconds_count" in body
