@@ -55,6 +55,9 @@ def add_parser(sub: argparse._SubParsersAction) -> None:
     p.add_argument("--shed-mode", choices=["scalar", "fail"], default="scalar",
                    help="shed overload to the scalar engine, or fail the "
                         "request per the webhook path's failurePolicy")
+    p.add_argument("--request-timeout-s", type=float, default=10.0,
+                   help="per-request time budget; an overrun resolves per "
+                        "the webhook path's failurePolicy, never a 500")
     p.set_defaults(func=run)
 
 
@@ -63,7 +66,7 @@ class ControlPlane:
 
     def __init__(self, policies, port=0, metrics_port=0, cert=None, key=None,
                  configuration=None, toggles=None, batching=False,
-                 batch_config=None):
+                 batch_config=None, request_timeout_s=10.0):
         self.cache = PolicyCache()
         for p in policies:
             self.cache.set(p)
@@ -99,7 +102,8 @@ class ControlPlane:
         self.handlers = build_handlers(
             self.cache, self.snapshot, self.aggregator,
             configuration=self.configuration, toggles=self.toggles,
-            batching=batching, batch_config=batch_config)
+            batching=batching, batch_config=batch_config,
+            request_timeout_s=request_timeout_s)
         self.admission = AdmissionServer(
             self.handlers, port=port, certfile=cert, keyfile=key)
         self.metrics_server = _metrics_server(self, metrics_port)
@@ -198,7 +202,15 @@ def run(args: argparse.Namespace) -> int:
     cp = ControlPlane(policies, port=args.port, metrics_port=args.metrics_port,
                       cert=args.cert, key=args.key,
                       configuration=configuration, toggles=toggles,
-                      batching=args.batching, batch_config=batch_config)
+                      batching=args.batching, batch_config=batch_config,
+                      request_timeout_s=args.request_timeout_s)
+    from ..resilience.faults import global_faults
+
+    armed = global_faults.armed()
+    if armed:
+        # chaos runs must be unmistakable in the serve log
+        print(f"FAULTS ARMED (KYVERNO_TPU_FAULTS): {sorted(armed)}",
+              file=sys.stderr)
     cp.start(args.scan_interval)
     print(f"admission on :{cp.admission.port}, metrics on "
           f":{cp.metrics_server.server_address[1]}, "

@@ -193,7 +193,24 @@ class BackgroundScanService:
             chunk = todo[start:start + self.batch_size]
             resources = [r for (_, r, _) in chunk]
             t0 = time.perf_counter()
-            result = scanner.scan(resources, ns_labels)
+            try:
+                result = scanner.scan(resources, ns_labels)
+            except Exception:
+                # the scanner's own ladder (quarantine, breaker, scalar
+                # completion) should have absorbed this — if it still
+                # escapes, the chunk reports per-rule ERROR verdicts
+                # rather than aborting the whole scan loop
+                import numpy as np
+
+                from ..tpu.engine import ScanResult
+                from ..tpu.evaluator import ERROR as _ERR
+
+                rules = [(e.policy_name, e.rule_name)
+                         for e in scanner.cps.rules]
+                result = ScanResult(
+                    verdicts=np.full((len(rules), len(resources)), _ERR,
+                                     dtype=np.int32),
+                    rules=rules)
             self.metrics.device_dispatch.observe(
                 time.perf_counter() - t0, {"engine": "scan"})
             self.metrics.batch_size.observe(len(chunk))

@@ -85,32 +85,73 @@ class ExternalApiEntry:
     call (the reference goes through apicall.Execute with service URLs,
     apiCall.go:107); refresh happens lazily when the cached value is
     older than refreshInterval, and a ``refresh()`` hook exists for a
-    background poller loop."""
+    background poller loop.
+
+    Degradation ladder (invalid/entry.go semantics, resilience/):
+    each refresh retries with jittered backoff inside a deadline
+    budget; while refreshes keep failing the entry serves the
+    last-known-good data until it is older than ``stale_ttl_s``
+    (default 3x refreshInterval), after which ``get()`` surfaces the
+    error state; a healed backend recovers the entry on the next poll."""
+
+    STALE_TTL_FACTOR = 3.0
 
     def __init__(self, spec: ExternalAPICallSpec,
                  executor: Callable[[ExternalAPICallSpec], Any],
-                 clock=time.monotonic) -> None:
+                 clock=time.monotonic,
+                 retry=None,
+                 stale_ttl_s: Optional[float] = None,
+                 sleep=time.sleep) -> None:
         self.spec = spec
         self.executor = executor
         self._clock = clock
+        self._sleep = sleep
+        if retry is None:
+            from ..resilience.retry import RetryPolicy
+
+            # the refresh loop's budget must stay well inside the
+            # refresh interval or a slow-failing backend makes polls
+            # pile onto each other
+            retry = RetryPolicy(
+                max_attempts=3, base_delay_s=0.05, max_delay_s=1.0,
+                deadline_s=min(5.0, max(spec.refresh_interval_s / 2.0, 0.1)))
+        self.retry = retry
+        self.stale_ttl_s = (stale_ttl_s if stale_ttl_s is not None
+                            else self.STALE_TTL_FACTOR * spec.refresh_interval_s)
         self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
         self._data: Any = None
         self._err: Optional[str] = None
-        self._fetched_at: Optional[float] = None
+        self._fetched_at: Optional[float] = None  # last attempt
+        self._ok_at: Optional[float] = None       # last success
+        self._refreshing = False  # single-flight: one lazy refresh at a time
         self._stopped = False
 
     def refresh(self) -> None:
+        from ..resilience.faults import SITE_GCTX_REFRESH, global_faults
+        from ..resilience.retry import Deadline, retry_call
+
+        def attempt():
+            global_faults.fire(SITE_GCTX_REFRESH)
+            return self.executor(self.spec)
+
         try:
-            data = self.executor(self.spec)
+            data = retry_call(
+                attempt, policy=self.retry,
+                deadline=Deadline(self.retry.deadline_s, clock=self._clock),
+                site=SITE_GCTX_REFRESH, clock=self._clock, sleep=self._sleep)
             with self._lock:
+                now = self._clock()
                 self._data = data
                 self._err = None
-                self._fetched_at = self._clock()
+                self._fetched_at = now
+                self._ok_at = now
         except Exception as e:
             with self._lock:
                 self._err = str(e)
                 # a failed poll marks the entry stale-with-error but
-                # keeps the timestamp so we don't hot-loop the executor
+                # keeps the timestamp so we don't hot-loop the executor;
+                # last-known-good data stays for the stale-serve window
                 self._fetched_at = self._clock()
 
     def _stale(self) -> bool:
@@ -120,14 +161,56 @@ class ExternalApiEntry:
     def get(self) -> Any:
         if self._stopped:
             raise EntryError("entry stopped")
-        with self._lock:
-            stale = self._stale()
-        if stale:
-            self.refresh()
-        with self._lock:
-            if self._err is not None:
-                raise EntryError(f"api call failed: {self._err}")
-            return self._data
+        # single-flight: exactly one reader pays the retry/backoff
+        # budget per staleness window; everyone else serves the cached
+        # (possibly stale) value immediately. Without this, M concurrent
+        # admissions against a down backend each run their own retry
+        # loop — M x deadline_s of added latency and 3M redundant calls
+        # onto a backend that is already failing.
+        do_refresh = False
+        with self._cond:
+            if self._stale() and not self._refreshing:
+                self._refreshing = True
+                do_refresh = True
+        if do_refresh:
+            try:
+                self.refresh()
+            finally:
+                with self._cond:
+                    self._refreshing = False
+                    self._cond.notify_all()
+        with self._cond:
+            # cold entry (never fetched): there is nothing to serve
+            # stale, so wait for the in-flight first fetch to land
+            # instead of handing back an empty result. wait_for bounds
+            # the TOTAL wait (a bare wait() in a loop restarts its
+            # timeout on every spurious wakeup): if the refresher is
+            # wedged inside a hung executor past the retry budget, this
+            # surfaces the error state instead of hanging every
+            # admission thread that touches the entry
+            # a deadline-free retry policy still gets a FINITE wait
+            # here (the refresh interval, floored at 30s): an unbounded
+            # cond.wait would let one hung executor wedge every
+            # admission thread that touches the cold entry
+            wait_s = (self.retry.deadline_s + 1.0
+                      if self.retry.deadline_s is not None
+                      else max(self.spec.refresh_interval_s, 30.0))
+            if not self._cond.wait_for(
+                    lambda: self._fetched_at is not None
+                    or not self._refreshing,
+                    timeout=wait_s):
+                raise EntryError(
+                    "api call failed: first fetch still in flight past "
+                    "the retry deadline budget")
+            if self._err is None:
+                return self._data
+            # serve last-known-good while it is younger than the TTL:
+            # a flapping backend degrades reads to slightly-stale data
+            # instead of erroring every admission that touches it
+            if (self._ok_at is not None
+                    and self._clock() - self._ok_at < self.stale_ttl_s):
+                return self._data
+            raise EntryError(f"api call failed: {self._err}")
 
     def stop(self) -> None:
         self._stopped = True

@@ -148,6 +148,23 @@ class MetricsRegistry:
         self.serving_request_latency = self.histogram(
             "kyverno_serving_request_latency_seconds",
             "admission submit-to-verdict latency")
+        # resilience layer (resilience/): breaker state machine, scalar
+        # fallback routing, retry outcomes, injected faults
+        self.breaker_state = self.gauge(
+            "kyverno_tpu_breaker_state",
+            "circuit breaker state (0 closed, 1 open, 2 half-open)")
+        self.breaker_transitions = self.counter(
+            "kyverno_tpu_breaker_transitions_total",
+            "circuit breaker state transitions")
+        self.breaker_fallback = self.counter(
+            "kyverno_tpu_breaker_fallback_total",
+            "batches completed by the scalar oracle instead of the device")
+        self.retry_attempts = self.counter(
+            "kyverno_resilience_retry_total",
+            "retried call outcomes by site (recovered counts extra attempts)")
+        self.faults_injected = self.counter(
+            "kyverno_resilience_faults_injected_total",
+            "injected faults fired by site and mode")
         # scan_stream phase split (SURVEY §5: encode/device/host costs)
         self.scan_encode_seconds = self.histogram(
             "kyverno_tpu_scan_encode_seconds", "host encode time per scan")

@@ -189,12 +189,28 @@ class ShardedScanner:
     def scan(self, resources, namespace_labels=None, operations=None):
         """Complete ScanResult over ALL rules: device verdicts merged
         with scalar-engine completions (host rules + capped resources) —
-        HOST never escapes."""
-        from ..tpu.engine import TpuEngine
+        HOST never escapes.
 
-        device_table, _ = self.scan_device(resources, namespace_labels, operations)
+        Resilience ladder (resilience/): an encode failure quarantines
+        hostile resources via TpuEngine.scan; a device failure (raised,
+        injected, or wrong-shaped) trips the shared TPU breaker and the
+        whole batch completes on the scalar oracle — bit-identical
+        verdicts, the scan never aborts."""
+        from ..tpu.engine import TpuEngine
+        from ..tpu.evaluator import HOST
+
         eng = TpuEngine(cps=self.cps, exceptions=self.exceptions)
-        return eng.assemble(device_table, resources, namespace_labels, operations)
+        try:
+            batch, n = self.encode(resources, namespace_labels, operations)
+        except Exception:
+            return eng.scan(resources, namespace_labels, operations)
+        D = len(self.cps.device_programs)
+        table = eng.guarded_dispatch(
+            lambda: np.asarray(self._step(self.put(batch))[0])[:, :n],
+            (D, n))
+        if table is None:
+            table = np.full((D, len(resources)), HOST, dtype=np.int32)
+        return eng.assemble(table, resources, namespace_labels, operations)
 
     def put(self, batch: Dict[str, Any]) -> Dict[str, jnp.ndarray]:
         """Place a host batch on the mesh — per-resource lanes sharded

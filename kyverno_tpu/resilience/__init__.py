@@ -1,0 +1,37 @@
+"""Resilience layer — deterministic degradation for every failure mode.
+
+Three building blocks, wired through the device, context, and serving
+layers:
+
+- ``breaker``: circuit breaker around the TPU device plane; tripped
+  batches route to the scalar oracle (bit-identical verdicts).
+- ``retry``: jittered exponential backoff under deadline budgets for
+  the pluggable context backends and the GlobalContext refresh loop.
+- ``faults``: named-site fault injection (``KYVERNO_TPU_FAULTS``) so
+  chaos behavior is reproducible in CI.
+"""
+
+from .breaker import CLOSED, HALF_OPEN, OPEN, CircuitBreaker, tpu_breaker
+from .faults import (FaultConfigError, FaultInjected, FaultRegistry,
+                     FaultSpec, global_faults)
+from .retry import (DEFAULT_RETRY, Deadline, PermanentError,
+                    RetryBudgetExceeded, RetryPolicy, retry_call)
+
+__all__ = [
+    "CLOSED",
+    "CircuitBreaker",
+    "DEFAULT_RETRY",
+    "Deadline",
+    "FaultConfigError",
+    "FaultInjected",
+    "FaultRegistry",
+    "FaultSpec",
+    "HALF_OPEN",
+    "OPEN",
+    "PermanentError",
+    "RetryBudgetExceeded",
+    "RetryPolicy",
+    "global_faults",
+    "retry_call",
+    "tpu_breaker",
+]

@@ -1,0 +1,242 @@
+"""Fault-injection registry — chaos behavior as a reproducible fixture.
+
+Every failure mode this repo defends against has a *named site* where
+the failure physically happens:
+
+    tpu.dispatch        the jitted device program call (tpu/engine.py)
+    context.api_call    the apiCall context backend (contextloaders.py)
+    context.image_data  the imageRegistry context backend
+    gctx.refresh        the GlobalContext external-API poll (entry.py)
+    serving.flush       the admission pipeline's batch evaluation
+
+Tests (and the ``KYVERNO_TPU_FAULTS`` env knob) arm a site with a
+probability- or count-based trigger and a mode — ``raise``, ``delay``,
+or ``corrupt`` (shape-mangle the site's result) — so degradation paths
+are exercised deterministically in CI instead of waiting for real
+hardware to misbehave. Probability triggers draw from a per-fault
+seeded RNG, making a chaos run replayable.
+
+``corrupt`` is only meaningful at sites that pass their RESULT through
+``FaultRegistry.corrupt()`` (today: ``tpu.dispatch``, whose verdict
+table is shape-validated downstream). Arming corrupt at a raise/delay
+only site is rejected at arm time — a chaos run that silently injects
+nothing is worse than no chaos run.
+
+Env syntax (';'-separated site specs)::
+
+    KYVERNO_TPU_FAULTS="tpu.dispatch:raise:p=0.3;gctx.refresh:raise:count=3"
+    site ':' mode [':' key=value (',' key=value)*]
+    keys: p=<float 0..1> | count=<int first-N calls> | delay_s=<float> | seed=<int>
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from random import Random
+from typing import Any, Dict, Optional
+
+SITE_TPU_DISPATCH = "tpu.dispatch"
+SITE_CONTEXT_API_CALL = "context.api_call"
+SITE_CONTEXT_IMAGE_DATA = "context.image_data"
+SITE_GCTX_REFRESH = "gctx.refresh"
+SITE_SERVING_FLUSH = "serving.flush"
+
+KNOWN_SITES = frozenset({
+    SITE_TPU_DISPATCH, SITE_CONTEXT_API_CALL, SITE_CONTEXT_IMAGE_DATA,
+    SITE_GCTX_REFRESH, SITE_SERVING_FLUSH,
+})
+
+MODES = ("raise", "delay", "corrupt")
+
+# sites whose result flows through FaultRegistry.corrupt(); every other
+# site only has the fire() (raise/delay) hook
+CORRUPTIBLE_SITES = frozenset({SITE_TPU_DISPATCH})
+
+
+class FaultInjected(RuntimeError):
+    """The error an armed ``raise`` fault throws at its site."""
+
+
+class FaultConfigError(ValueError):
+    """Malformed KYVERNO_TPU_FAULTS spec / arm() arguments."""
+
+
+@dataclass
+class FaultSpec:
+    site: str
+    mode: str = "raise"
+    p: Optional[float] = None       # probability trigger per call
+    count: Optional[int] = None     # trigger on the first N calls
+    delay_s: float = 0.01           # sleep for mode=delay
+    seed: int = 0                   # RNG seed for probability triggers
+    calls: int = 0                  # observed calls (all)
+    fired: int = 0                  # calls that triggered
+    _rng: Random = field(default_factory=Random, repr=False)
+
+    def __post_init__(self) -> None:
+        if self.mode not in MODES:
+            raise FaultConfigError(f"unknown fault mode {self.mode!r}")
+        if self.p is None and self.count is None:
+            self.p = 1.0  # armed with no trigger = always fires
+        if self.p is not None and not (0.0 <= self.p <= 1.0):
+            raise FaultConfigError(f"fault probability out of range: {self.p}")
+        self._rng = Random(self.seed)
+
+    def _triggers(self) -> bool:
+        self.calls += 1
+        if self.count is not None:
+            if self.fired >= self.count:
+                return False
+        elif self.p is not None and self._rng.random() >= self.p:
+            return False
+        self.fired += 1
+        return True
+
+
+def _corrupt(value: Any) -> Any:
+    """Shape-mangle a site result: the wrong-shaped answer a sick
+    device or a half-written upstream response produces."""
+    try:
+        import numpy as np
+
+        if isinstance(value, np.ndarray):
+            return value[..., :-1] if value.size else value
+    except ImportError:  # numpy always present in this repo; belt+braces
+        pass
+    if isinstance(value, list):
+        return value[:-1]
+    if isinstance(value, dict):
+        out = dict(value)
+        if out:
+            out.pop(next(iter(out)))
+        return out
+    if isinstance(value, str):
+        return value[:-1]
+    return None
+
+
+class FaultRegistry:
+    """Armed faults by site. ``fire()`` is the raise/delay hook placed
+    BEFORE the protected operation; ``corrupt()`` filters the
+    operation's RESULT. Unarmed sites cost one dict lookup."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._armed: Dict[str, FaultSpec] = {}
+
+    # -- arming
+
+    def arm(self, site: str, mode: str = "raise", p: Optional[float] = None,
+            count: Optional[int] = None, delay_s: float = 0.01,
+            seed: int = 0) -> FaultSpec:
+        if site not in KNOWN_SITES:
+            raise FaultConfigError(
+                f"unknown fault site {site!r} (known: {sorted(KNOWN_SITES)})")
+        if mode == "corrupt" and site not in CORRUPTIBLE_SITES:
+            raise FaultConfigError(
+                f"site {site!r} does not filter results through corrupt() "
+                f"(corruptible: {sorted(CORRUPTIBLE_SITES)}) — arming it "
+                f"would inject nothing")
+        spec = FaultSpec(site=site, mode=mode, p=p, count=count,
+                         delay_s=delay_s, seed=seed)
+        with self._lock:
+            self._armed[site] = spec
+        return spec
+
+    def disarm(self, site: Optional[str] = None) -> None:
+        with self._lock:
+            if site is None:
+                self._armed.clear()
+            else:
+                self._armed.pop(site, None)
+
+    def armed(self) -> Dict[str, FaultSpec]:
+        with self._lock:
+            return dict(self._armed)
+
+    def arm_from_string(self, text: str) -> int:
+        """Parse the KYVERNO_TPU_FAULTS syntax; returns #faults armed."""
+        n = 0
+        for chunk in (text or "").split(";"):
+            chunk = chunk.strip()
+            if not chunk:
+                continue
+            parts = chunk.split(":")
+            if len(parts) < 2:
+                raise FaultConfigError(
+                    f"fault spec {chunk!r} needs at least site:mode")
+            site, mode = parts[0].strip(), parts[1].strip()
+            kw: Dict[str, Any] = {}
+            for pair in ",".join(parts[2:]).split(","):
+                pair = pair.strip()
+                if not pair:
+                    continue
+                if "=" not in pair:
+                    raise FaultConfigError(f"bad fault option {pair!r}")
+                k, v = (s.strip() for s in pair.split("=", 1))
+                if k == "p":
+                    kw["p"] = float(v)
+                elif k == "count":
+                    kw["count"] = int(v)
+                elif k == "delay_s":
+                    kw["delay_s"] = float(v)
+                elif k == "seed":
+                    kw["seed"] = int(v)
+                else:
+                    raise FaultConfigError(f"unknown fault option {k!r}")
+            self.arm(site, mode=mode, **kw)
+            n += 1
+        return n
+
+    # -- firing
+
+    def fire(self, site: str) -> None:
+        """Raise/delay hook. A ``corrupt`` fault never fires here — its
+        trigger is consumed by ``corrupt()`` on the result instead."""
+        spec = self._armed.get(site)  # GIL-safe fast path when unarmed
+        if spec is None or spec.mode == "corrupt":
+            return
+        with self._lock:
+            triggered = spec._triggers()
+        if not triggered:
+            return
+        self._count(spec)
+        if spec.mode == "delay":
+            time.sleep(spec.delay_s)
+            return
+        raise FaultInjected(f"injected fault at {site}")
+
+    def corrupt(self, site: str, value: Any) -> Any:
+        """Result filter for ``corrupt``-mode faults."""
+        spec = self._armed.get(site)
+        if spec is None or spec.mode != "corrupt":
+            return value
+        with self._lock:
+            triggered = spec._triggers()
+        if not triggered:
+            return value
+        self._count(spec)
+        return _corrupt(value)
+
+    @staticmethod
+    def _count(spec: FaultSpec) -> None:
+        from ..observability.metrics import global_registry
+
+        global_registry.faults_injected.inc(
+            {"site": spec.site, "mode": spec.mode})
+
+
+global_faults = FaultRegistry()
+# env arming happens once at import: the knob is a process-launch
+# switch (chaos CI runs), not a hot-reloaded config. A malformed spec
+# fails the process LOUDLY here — silently running a chaos suite with
+# no chaos armed would be the worst possible degradation — but names
+# the env var so the operator knows exactly what to fix.
+try:
+    global_faults.arm_from_string(os.environ.get("KYVERNO_TPU_FAULTS", ""))
+except FaultConfigError as e:
+    raise FaultConfigError(f"malformed KYVERNO_TPU_FAULTS env value: {e}") \
+        from None

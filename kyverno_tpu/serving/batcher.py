@@ -93,11 +93,20 @@ class AdmissionPipeline:
 
     # -- caller side
 
-    def submit(self, payload: Any, deadline_ms: Optional[float] = None) -> Any:
+    def submit(self, payload: Any, deadline_ms: Optional[float] = None,
+               eval_grace_s: Optional[float] = None) -> Any:
+        """``eval_grace_s`` caps how long a DISPATCHED request may wait
+        past its queue budget for the evaluator; callers with a hard
+        wall (the webhook's request timeout — the API server hangs up
+        at timeoutSeconds regardless) pass their remaining budget so
+        a wedged evaluator resolves per failurePolicy inside it instead
+        of holding the connection for the full default grace."""
         if self._stopped:
             raise RuntimeError("admission pipeline is stopped")
         budget = (deadline_ms if deadline_ms is not None
                   else self.config.deadline_ms) / 1000.0
+        grace = (eval_grace_s if eval_grace_s is not None
+                 else self.config.eval_grace_s)
         t0 = time.monotonic()
         try:
             req = self.queue.put(payload, t0 + budget, now=t0)
@@ -113,10 +122,17 @@ class AdmissionPipeline:
             self.metrics.serving_shed_total.inc({"outcome": "rejected"})
             raise
         self.metrics.serving_queue_depth.set(self.queue.depth())
-        # the deadline governs QUEUE time; once dispatched, the device
-        # call is allowed eval_grace_s to complete
-        if not req.event.wait(budget + self.config.eval_grace_s):
-            raise DeadlineExceededError("admission batch evaluation timed out")
+        # the deadline governs QUEUE time; only a request that actually
+        # made it onto the device earns eval_grace_s to complete — a
+        # request still queued past its budget (wedged flusher) resolves
+        # per failurePolicy NOW, honoring the webhook's request timeout
+        if not req.event.wait(budget):
+            if not req.dispatched:
+                raise DeadlineExceededError(
+                    "request deadline expired while queued")
+            if not req.event.wait(grace):
+                raise DeadlineExceededError(
+                    "admission batch evaluation timed out")
         self.metrics.serving_request_latency.observe(
             time.monotonic() - t0, {"path": "batched"})
         if isinstance(req.result, BaseException):
@@ -129,6 +145,19 @@ class AdmissionPipeline:
             self.queue.closed = True
             self.queue.cv.notify_all()
         self._flusher.join(timeout=self.config.eval_grace_s)
+        # the flusher's final drain normally empties the queue; if it
+        # is wedged on a stuck evaluator (join timed out), whoever is
+        # still QUEUED resolves now via the scalar fallback — shutdown
+        # degrades service, it never strands a waiter unresolved
+        for req in self.queue.drain_all():
+            try:
+                if self._scalar is None:
+                    raise RuntimeError(
+                        "admission pipeline stopped before evaluation")
+                req.resolve(self._scalar(req.payload))
+                self.metrics.serving_shed_total.inc({"outcome": "shutdown"})
+            except BaseException as e:  # waiter gets the error, not a hang
+                req.resolve(e)
 
     # -- flusher side
 
@@ -179,7 +208,8 @@ class AdmissionPipeline:
         # queue: a deadline-triggered flush fires deadline_lead_ms early
         # precisely so the entry it fires for is still live here, and
         # scheduling jitter between drain and this check must not
-        # re-expire it (submit()'s wait has eval_grace_s slack anyway)
+        # re-expire it (drained entries are marked dispatched under the
+        # cv, so submit()'s wait has eval_grace_s slack for them)
         if now is None:
             now = time.monotonic()
         live: List[QueuedRequest] = []
@@ -213,6 +243,13 @@ class AdmissionPipeline:
         self.metrics.serving_batch_occupancy.observe(len(live) / bucket)
         padded = [req.payload for req in live] + [None] * (bucket - len(live))
         try:
+            # chaos hook: an armed serving.flush fault lands here, so
+            # an injected flush failure takes the SAME path a real
+            # evaluator error takes — every waiter gets the exception
+            # and the webhook layer resolves it per failurePolicy
+            from ..resilience.faults import SITE_SERVING_FLUSH, global_faults
+
+            global_faults.fire(SITE_SERVING_FLUSH)
             results = self._fn(padded)
             if len(results) < len(live):
                 raise RuntimeError("batch evaluator returned wrong arity")

@@ -22,7 +22,8 @@ class DeadlineExceededError(TimeoutError):
 
 
 class QueuedRequest:
-    __slots__ = ("payload", "enqueued_at", "deadline", "event", "result")
+    __slots__ = ("payload", "enqueued_at", "deadline", "event", "result",
+                 "dispatched")
 
     def __init__(self, payload: Any, enqueued_at: float, deadline: float):
         self.payload = payload
@@ -30,6 +31,13 @@ class QueuedRequest:
         self.deadline = deadline  # absolute monotonic time
         self.event = threading.Event()
         self.result: Any = None
+        # set under the queue cv the instant drain() hands this entry
+        # to the flusher: submit() only extends its wait past the
+        # deadline budget for requests the flusher owns (eval grace),
+        # never for ones still stuck in a wedged queue — and because
+        # the flag flips atomically with the pop, a waiter's timeout
+        # can never observe "queued" for an entry already in a flush
+        self.dispatched = False
 
     def resolve(self, result: Any) -> None:
         self.result = result
@@ -67,6 +75,14 @@ class AdmissionQueue:
     def drain(self, max_n: int) -> List[QueuedRequest]:
         """Pop up to max_n oldest entries. Callers hold self.cv."""
         batch, self._items = self._items[:max_n], self._items[max_n:]
+        for req in batch:
+            req.dispatched = True
+        return batch
+
+    def drain_all(self) -> List[QueuedRequest]:
+        """Pop everything (shutdown path: every waiter must resolve)."""
+        with self.cv:
+            batch, self._items = self._items, []
         return batch
 
     def depth(self) -> int:

@@ -25,6 +25,7 @@ from ..engine.engine import Engine as ScalarEngine
 from ..engine.match import RequestInfo
 from ..engine.policycontext import PolicyContext
 from ..engine.response import EngineResponse
+from ..resilience.faults import SITE_TPU_DISPATCH, global_faults
 from .compiler import CompiledPolicySet, compile_policy_set
 from .evaluator import ERROR, FAIL, HOST, NOT_MATCHED, PASS, SKIP, batch_to_host
 from .flatten import EncodeConfig, encode_resources
@@ -32,6 +33,12 @@ from .metadata import MetaConfig, encode_metadata
 
 VERDICT_NAMES = {PASS: "pass", SKIP: "skip", FAIL: "fail",
                  NOT_MATCHED: "not_matched", ERROR: "error"}
+
+
+class DeviceResultError(RuntimeError):
+    """The device program returned a wrong-shaped/typed verdict table —
+    treated exactly like a dispatch failure (breaker + scalar fallback),
+    never silently consumed as truth."""
 
 _STATUS_TO_CODE = {"pass": PASS, "skip": SKIP, "fail": FAIL, "error": ERROR}
 
@@ -147,10 +154,18 @@ class TpuEngine:
         cps: Optional[CompiledPolicySet] = None,
         exceptions: Sequence[Any] = (),
         data_sources=None,
+        breaker=None,
     ):
         self.cps: CompiledPolicySet = cps if cps is not None \
             else compile_policy_set(policies, encode_cfg, meta_cfg, data_sources)
         self.data_sources = data_sources  # runtime dyn-operand loading
+        # device errors are device-wide, so engines share the process
+        # breaker by default (engines churn with policy revisions)
+        if breaker is None:
+            from ..resilience.breaker import tpu_breaker
+
+            breaker = tpu_breaker()
+        self.breaker = breaker
         self.scalar = ScalarEngine(exceptions=list(exceptions),
                                    background=True,
                                    data_sources=data_sources)
@@ -202,15 +217,6 @@ class TpuEngine:
         Load results cache on the substituted entry spec, so
         request-independent entries (static urlPaths, configMaps)
         resolve once per batch."""
-        import json as _json
-
-        from ..engine.context import Context
-        from ..engine.contextloaders import load_context_entries
-        from ..engine.pattern import go_parse_float
-        from ..utils.wildcard import contains_wildcard
-        from .flatten import go_sprint
-        from .hashing import ARRAY_SEG, hash_str, split32
-
         S, N, L = len(self.cps.dyn_slots), len(resources), self.DYN_LIST_L
         lanes = {
             # type: 0=load-error 1=null 2=bool 3=num 4=str 5=list 6=other
@@ -234,6 +240,27 @@ class TpuEngine:
             "dyn_host": np.zeros((S, N), np.int8),
         }
         cache: Dict[Any, Tuple[bool, Any]] = {}
+        # scope backend-failure poisoning to this batch: a dead backend
+        # costs ONE retry budget here, not one per (slot, resource)
+        begin_batch = getattr(self.data_sources, "begin_batch", None)
+        if begin_batch is not None:
+            begin_batch()
+        try:
+            return self._encode_dyn_cells(resources, operations,
+                                          admission_infos, lanes, cache)
+        finally:
+            end_batch = getattr(self.data_sources, "end_batch", None)
+            if end_batch is not None:
+                end_batch()
+
+    def _encode_dyn_cells(self, resources, operations, admission_infos,
+                          lanes, cache):
+        import json as _json
+
+        from ..engine.contextloaders import load_context_entries
+        from ..utils.wildcard import contains_wildcard
+
+        L = self.DYN_LIST_L
         for ci, res in enumerate(resources):
             op = (operations[ci] if operations else "") or ""
             info = admission_infos[ci] if admission_infos else None
@@ -377,14 +404,137 @@ class TpuEngine:
         ops = (list(operations) + [""] * (padded_n - n)) if operations else None
         infos = (list(admission_infos) + [None] * (padded_n - n)) \
             if admission_infos else None
-        batch, rows, meta = self.encode(padded, namespace_labels, ops, infos)
-        import jax
-
-        # one batched H2D put for the whole lane dict — per-lane
-        # transfer pays a link round-trip per array (see batch_to_host)
-        device_table = np.asarray(self.cps.device_fn()(jax.device_put(batch)))[:, :n]  # (D, N)
+        try:
+            batch, rows, meta = self.encode(padded, namespace_labels, ops, infos)
+        except Exception:
+            # a hostile resource broke batch encoding: quarantine it so
+            # the rest of the batch still evaluates (device or scalar),
+            # and the bad resource degrades to scalar / per-rule ERROR
+            return self._scan_quarantining(
+                resources, namespace_labels, operations, admission_infos)
+        device_table = self._dispatch(batch, padded_n)[:, :n]  # (D, N)
         return self.assemble(
             device_table, resources, namespace_labels, operations, admission_infos
+        )
+
+    def guarded_dispatch(self, dispatch_fn, want_shape) -> Optional[np.ndarray]:
+        """The ONE breaker-gated dispatch ladder (shared with
+        ShardedScanner so the two paths cannot drift): fault hook,
+        dispatch, corrupt filter, shape/dtype validation, breaker
+        bookkeeping. Returns the validated verdict table, or None when
+        the breaker is open or the dispatch failed — the caller falls
+        back to scalar completion (all-HOST)."""
+        from ..observability.metrics import global_registry
+
+        if not self.breaker.allow():
+            global_registry.breaker_fallback.inc({"reason": "open"})
+            return None
+        try:
+            global_faults.fire(SITE_TPU_DISPATCH)
+            table = dispatch_fn()
+            table = global_faults.corrupt(SITE_TPU_DISPATCH, table)
+            if not (isinstance(table, np.ndarray)
+                    and table.shape == want_shape
+                    and np.issubdtype(table.dtype, np.integer)):
+                raise DeviceResultError(
+                    f"device returned shape "
+                    f"{getattr(table, 'shape', None)}, want {want_shape}")
+            self.breaker.record_success()
+            return table
+        except Exception:
+            self.breaker.record_failure()
+            global_registry.breaker_fallback.inc({"reason": "error"})
+            return None
+
+    def _dispatch(self, batch, padded_n: int) -> np.ndarray:
+        """One device dispatch through the guarded ladder. Any failure
+        returns an all-HOST table, which routes the WHOLE batch through
+        the scalar oracle in assemble(): verdicts stay bit-identical,
+        only latency degrades."""
+
+        def run():
+            import jax
+
+            # one batched H2D put for the whole lane dict — per-lane
+            # transfer pays a link round-trip per array (see batch_to_host)
+            return np.asarray(self.cps.device_fn()(jax.device_put(batch)))
+
+        D = len(self.cps.device_programs)
+        table = self.guarded_dispatch(run, (D, padded_n))
+        if table is None:
+            return np.full((D, padded_n), HOST, dtype=np.int32)
+        return table
+
+    def _scan_quarantining(
+        self,
+        resources: Sequence[Dict[str, Any]],
+        namespace_labels: Optional[Dict[str, Dict[str, str]]] = None,
+        operations: Optional[Sequence[str]] = None,
+        admission_infos: Optional[Sequence[Optional[RequestInfo]]] = None,
+    ) -> ScanResult:
+        """Batch encode failed: split the batch into resources that
+        encode alone (re-scanned as a clean sub-batch) and hostile ones,
+        which complete per (policy, resource) on the scalar engine — a
+        policy the scalar engine ALSO cannot evaluate yields per-rule
+        ERROR verdicts instead of aborting the scan."""
+        n = len(resources)
+        good: List[int] = []
+        bad: List[int] = []
+        for ci, res in enumerate(resources):
+            op = [(operations[ci] if operations else "") or ""]
+            info = [admission_infos[ci]] if admission_infos else None
+            try:
+                # STRUCTURAL probe only (rows + meta lanes): dyn-lane
+                # encoding does real context-backend I/O and catches its
+                # own load errors, so probing it here would pay O(batch)
+                # duplicate backend calls for nothing. A dyn-lane value
+                # that still throws re-enters quarantine from the good
+                # sub-batch's scan, which then degrades it to scalar.
+                encode_resources([res], self.cps.encode_cfg,
+                                 self.cps.byte_paths, self.cps.key_byte_paths)
+                encode_metadata([res], namespace_labels, op, info,
+                                self.cps.meta_cfg)
+                good.append(ci)
+            except Exception:
+                bad.append(ci)
+        if not bad:
+            # batch-level failure with no single culprit: degrade the
+            # whole batch to the scalar path rather than loop forever
+            good, bad = [], list(range(n))
+        total = np.full((len(self.cps.rules), n), NOT_MATCHED, dtype=np.int32)
+        if good:
+            sub = self.scan(
+                [resources[i] for i in good], namespace_labels,
+                [operations[i] for i in good] if operations else None,
+                [admission_infos[i] for i in good] if admission_infos else None)
+            total[:, good] = sub.verdicts
+        ns_labels = namespace_labels or {}
+        for ci in bad:
+            res = resources[ci]
+            op = (operations[ci] if operations else "") or ""
+            info = admission_infos[ci] if admission_infos else None
+            try:
+                kind = res.get("kind", "")
+                meta = res.get("metadata") or {}
+                nsl = ns_labels.get(
+                    meta.get("name", "") if kind == "Namespace"
+                    else meta.get("namespace", ""), {})
+            except Exception:  # not even dict-shaped
+                nsl = {}
+            for pi, policy in enumerate(self.cps.policies):
+                try:
+                    pctx = build_scan_context(policy, res, nsl, op, info)
+                    verdicts = _scalar_rule_verdicts(self.scalar, policy, pctx)
+                except Exception:
+                    verdicts = None  # ERROR every rule of this policy
+                for ri, entry in enumerate(self.cps.rules):
+                    if entry.policy_idx != pi:
+                        continue
+                    total[ri, ci] = ERROR if verdicts is None \
+                        else verdicts.get(entry.rule_name, NOT_MATCHED)
+        return ScanResult(
+            verdicts=total,
+            rules=[(e.policy_name, e.rule_name) for e in self.cps.rules],
         )
 
     def assemble(

@@ -27,7 +27,8 @@ from ..cluster.reports import ReportAggregator, ReportResult
 from ..cluster.snapshot import ClusterSnapshot, resource_uid
 from ..engine.engine import Engine as ScalarEngine
 from ..engine.match import RequestInfo
-from ..serving import AdmissionPipeline, BatchConfig, resource_verdicts
+from ..serving import (AdmissionPipeline, BatchConfig, DeadlineExceededError,
+                       resource_verdicts)
 from ..tpu.engine import (TpuEngine, VERDICT_NAMES, _scalar_rule_verdicts,
                           build_scan_context)
 from ..tpu.evaluator import ERROR, FAIL, NOT_MATCHED
@@ -64,6 +65,7 @@ class Handlers:
         exceptions=None,
         batching: bool = False,
         batch_config: Optional[BatchConfig] = None,
+        request_timeout_s: float = 10.0,
     ) -> None:
         self.cache = cache
         self.snapshot = snapshot
@@ -77,6 +79,10 @@ class Handlers:
             iv_cache = ImageVerifyCache()
         self.iv_cache = iv_cache
         self.exceptions = exceptions or []
+        # per-request time budget (the reference webhook's 10 s
+        # timeoutSeconds): propagated into the serving pipeline's queue
+        # deadline so an overrun resolves per failurePolicy, not a 500
+        self.request_timeout_s = request_timeout_s
         self.scalar = ScalarEngine(exceptions=self.exceptions)
         self._engines: Dict[int, TpuEngine] = {}
         self._rbac_needed: Dict[int, bool] = {}  # per cache revision
@@ -231,9 +237,24 @@ class Handlers:
             names &= {scoped.name}
         return names
 
+    def _fail_open(self, failure_policy: str) -> bool:
+        """Resolve an evaluation error per failurePolicy: the /ignore
+        path class (or the force toggle, pkg/toggle) allows, everything
+        else denies with reason — a degraded engine never surfaces as
+        an unhandled 500."""
+        return (failure_policy == "ignore"
+                or bool(getattr(self.toggles, "force_failure_policy_ignore",
+                                False)))
+
     def validate(self, review: Dict[str, Any], failure_policy: str = "all",
                  policy_key=None) -> Dict[str, Any]:
+        from ..resilience.retry import Deadline
+
         t0 = time.perf_counter()
+        # the request's time budget starts when WE start processing it:
+        # every downstream wait (queue, batch, device) draws from the
+        # same Deadline, so total webhook latency stays bounded
+        deadline = Deadline(self.request_timeout_s)
         req = review.get("request") or {}
         payload = _payload_from_request(req, self.snapshot, self._need_roles())
         self.metrics.admission_requests.inc(
@@ -243,20 +264,37 @@ class Handlers:
         try:
             evaluable = self._class_filter(failure_policy, policy_key)
         except KeyError as e:
-            allowed = failure_policy == "ignore"
-            return _response(req, allowed, f"evaluation error: {e}")
+            return _response(req, self._fail_open(failure_policy),
+                             f"evaluation error: {e}")
         try:
             # --batching routes through the serving pipeline (padded
             # shape buckets, deadline-aware flush, high-water shedding);
             # a shed in "fail" mode or an expired deadline lands here as
             # an exception and resolves per failurePolicy below
+            remaining = deadline.remaining()
+            if remaining <= 0:
+                raise DeadlineExceededError(
+                    "request budget exhausted before evaluation")
             if self.pipeline is not None:
-                verdicts = self.pipeline.submit(payload)
+                # queue budget: the TIGHTER of the request's remaining
+                # webhook budget and the pipeline's configured queue
+                # deadline — always passing the webhook remainder alone
+                # would make --deadline-ms dead configuration. The
+                # eval grace for a dispatched request is whatever the
+                # webhook wall leaves after the queue budget: the API
+                # server hangs up at timeoutSeconds, so waiting longer
+                # only strands the connection
+                queue_ms = min(remaining * 1000.0,
+                               self.pipeline.config.deadline_ms)
+                verdicts = self.pipeline.submit(
+                    payload, deadline_ms=queue_ms,
+                    eval_grace_s=min(self.pipeline.config.eval_grace_s,
+                                     max(0.0, remaining - queue_ms / 1000.0)))
             else:
-                verdicts = self.batcher.submit(payload)
+                verdicts = self.batcher.submit(payload, timeout=remaining)
         except Exception as e:
-            allowed = failure_policy == "ignore"
-            return _response(req, allowed, f"evaluation error: {e}")
+            return _response(req, self._fail_open(failure_policy),
+                             f"evaluation error: {e}")
         if evaluable is not None:
             # the batch evaluates the full compiled program (one device
             # dispatch for every concurrent request); rows outside this
@@ -388,8 +426,8 @@ class Handlers:
         try:
             evaluable = self._class_filter(failure_policy, policy_key)
         except KeyError as e:
-            allowed = failure_policy == "ignore"
-            return _response(req, allowed, f"evaluation error: {e}")
+            return _response(req, self._fail_open(failure_policy),
+                             f"evaluation error: {e}")
         try:
             for policy in self.cache.get_policies(
                 PolicyType.MUTATE, kind=resource.get("kind"), namespace=payload.namespace
@@ -448,8 +486,8 @@ class Handlers:
                         req, False,
                         f"image verification failed: {policy.name}: {failed}")
         except Exception as e:
-            allowed = failure_policy == "ignore"
-            return _response(req, allowed, f"mutation error: {e}")
+            return _response(req, self._fail_open(failure_policy),
+                             f"mutation error: {e}")
         out = _response(req, True, "")
         ops = jsonpatch_diff(resource, patched)
         if ops:

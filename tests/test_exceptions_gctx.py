@@ -188,29 +188,45 @@ def test_gctx_k8s_resource_entry_tracks_snapshot():
     assert [d["metadata"]["name"] for d in store["deployments"]] == ["d2"]
 
 
-def test_gctx_external_api_entry_polls_and_staleness():
+def test_gctx_external_api_entry_polls_staleness_and_stale_serve():
+    """Reference degradation ladder (invalid/entry.go + resilience/):
+    refresh within the interval is cached; a failing backend serves
+    last-known-good data until stale_ttl; past the TTL the error state
+    surfaces; a healed backend recovers the entry."""
     calls = {"n": 0}
     now = [0.0]
+    failing = [False]
 
     def executor(spec):
         calls["n"] += 1
-        if calls["n"] == 3:
+        if failing[0]:
             raise RuntimeError("upstream down")
         return {"seen": calls["n"]}
 
-    entry = ExternalApiEntry.__new__(ExternalApiEntry)
     from kyverno_tpu.globalcontext.types import ExternalAPICallSpec
-    entry.__init__(ExternalAPICallSpec(url_path="/x", refresh_interval_s=10),
-                   executor, clock=lambda: now[0])
+    from kyverno_tpu.resilience import RetryPolicy
+
+    entry = ExternalApiEntry(
+        ExternalAPICallSpec(url_path="/x", refresh_interval_s=10),
+        executor, clock=lambda: now[0],
+        retry=RetryPolicy(max_attempts=1, deadline_s=5.0),
+        sleep=lambda s: None)
+    assert entry.stale_ttl_s == 30.0  # 3x refresh interval
     assert entry.get() == {"seen": 1}
     assert entry.get() == {"seen": 1}  # cached within interval
     now[0] = 11.0
     assert entry.get() == {"seen": 2}  # refreshed
+    failing[0] = True
     now[0] = 22.0
-    with pytest.raises(EntryError):   # failed poll -> error state
+    assert entry.get() == {"seen": 2}  # failed poll -> serve stale
+    now[0] = 40.0                      # last success 11.0, age 29 < 30
+    assert entry.get() == {"seen": 2}  # still inside the stale TTL
+    now[0] = 51.0                      # age 40 >= 30: error state surfaces
+    with pytest.raises(EntryError):
         entry.get()
-    now[0] = 33.0
-    assert entry.get() == {"seen": 4}  # recovers
+    failing[0] = False
+    now[0] = 62.0
+    assert entry.get()["seen"] >= 3    # recovers after the backend heals
 
 
 def test_gctx_feeds_global_reference_loader():

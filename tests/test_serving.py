@@ -209,6 +209,26 @@ def test_evaluator_error_propagates_to_all_waiters():
     p.stop()
 
 
+def test_submit_eval_grace_clamped_to_caller_budget():
+    # a dispatched request whose evaluator wedges must resolve inside
+    # the caller's wall (queue budget + eval_grace_s), not the default
+    # 30s grace — the API server hung up long before that
+    release = threading.Event()
+
+    def wedged(payloads):
+        release.wait(10.0)
+        return ["late"] * len(payloads)
+
+    p = AdmissionPipeline(wedged, config=BatchConfig(
+        max_batch_size=1, max_wait_ms=1.0, min_bucket=1))
+    t0 = time.monotonic()
+    with pytest.raises(DeadlineExceededError, match="evaluation timed out"):
+        p.submit("r0", deadline_ms=200.0, eval_grace_s=0.2)
+    assert time.monotonic() - t0 < 2.0
+    release.set()
+    p.stop()
+
+
 def test_bucket_shapes_are_powers_of_two():
     cfg = BatchConfig(min_bucket=16, max_batch_size=100)
     assert [cfg.bucket(n) for n in (1, 16, 17, 33, 100)] == [16, 16, 32, 64, 128]
@@ -320,6 +340,28 @@ def test_batched_verdicts_match_scalar_mixed_host_fallback():
 
 def p_stats_requests(handlers):
     return handlers.pipeline.stats["requests"] + handlers.pipeline.stats["shed"]
+
+
+def test_webhook_queue_budget_capped_by_configured_deadline_ms():
+    # the queue budget handed to pipeline.submit must be the TIGHTER of
+    # the request's remaining webhook budget and BatchConfig.deadline_ms
+    # — otherwise `serve --batching --deadline-ms N` is dead config
+    batched = _mk_handlers(batching=True, deadline_ms=100.0)
+    seen = []
+    orig = batched.pipeline.submit
+
+    def spy(payload, deadline_ms=None, **kw):
+        seen.append(deadline_ms)
+        return orig(payload, deadline_ms=deadline_ms, **kw)
+
+    batched.pipeline.submit = spy
+    out = batched.validate(_review(_pod("p-cap", False), "u-cap"))
+    assert out["response"]["allowed"] is True
+    batched.pipeline.stop()
+    batched.batcher.stop()
+    # request_timeout_s defaults to 10s (10000ms): the 100ms config cap
+    # must win
+    assert seen and seen[0] == pytest.approx(100.0)
 
 
 def test_serving_metrics_exposed_on_metrics_endpoint():
