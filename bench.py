@@ -635,6 +635,21 @@ FNS = {
 }
 
 
+def _parse_probe_phases(stdout):
+    """`_probe` emits `probe-phase <name> <seconds>` progress lines; the
+    phases PRESENT tell exactly how far the probe got before it died
+    (import hang vs device-attach hang look identical from outside)."""
+    phases = {}
+    for line in (stdout or "").splitlines():
+        parts = line.split()
+        if len(parts) == 3 and parts[0] == "probe-phase":
+            try:
+                phases[parts[1]] = float(parts[2])
+            except ValueError:
+                pass
+    return phases
+
+
 def _probe_backend(retries=None, sleep_s=None, timeout_s=None):
     """The TPU attach is occasionally unavailable (BENCH_r03 failed on
     it before measuring anything; BENCH_r05's probe WEDGED for its full
@@ -642,7 +657,12 @@ def _probe_backend(retries=None, sleep_s=None, timeout_s=None):
     backend-init failure per process, so probe in a THROWAWAY
     subprocess — and fail FAST: a short per-attempt timeout and short
     backoff, because the caller degrades to a CPU-jitted run rather
-    than emitting an error artifact."""
+    than emitting an error artifact.
+
+    Returns None on success, else a dict with the failure breakdown:
+    ``error`` (one line), ``stderr_tail`` (last 400 chars of the probe's
+    stderr), and ``phases`` (the per-phase probe progress) — so the
+    BENCH artifact records WHERE the probe died, not just that it did."""
     import subprocess
 
     retries = int(os.environ.get("BENCH_PROBE_RETRIES", "2")) \
@@ -651,7 +671,7 @@ def _probe_backend(retries=None, sleep_s=None, timeout_s=None):
         if sleep_s is None else sleep_s
     timeout_s = float(os.environ.get("BENCH_PROBE_TIMEOUT", "60")) \
         if timeout_s is None else timeout_s
-    last = ""
+    last = {"error": "backend probe failed", "stderr_tail": "", "phases": {}}
     for i in range(retries):
         try:
             r = subprocess.run(
@@ -659,12 +679,23 @@ def _probe_backend(retries=None, sleep_s=None, timeout_s=None):
                 capture_output=True, text=True, timeout=timeout_s)
             if r.returncode == 0 and "probe-ok" in r.stdout:
                 return None
-            last = (r.stdout + r.stderr)[-400:]
+            last = {"error": (r.stdout + r.stderr)[-400:]
+                    or f"probe exited {r.returncode}",
+                    "stderr_tail": (r.stderr or "")[-400:],
+                    "phases": _parse_probe_phases(r.stdout)}
+        except subprocess.TimeoutExpired as e:
+            last = {"error": f"probe timed out after {timeout_s}s",
+                    "stderr_tail": ((e.stderr or b"").decode("utf-8", "replace")
+                                    if isinstance(e.stderr, bytes)
+                                    else (e.stderr or ""))[-400:],
+                    "phases": _parse_probe_phases(
+                        (e.stdout or b"").decode("utf-8", "replace")
+                        if isinstance(e.stdout, bytes) else (e.stdout or ""))}
         except Exception as e:  # noqa: BLE001
-            last = repr(e)[:400]
+            last = {"error": repr(e)[:400], "stderr_tail": "", "phases": {}}
         if i < retries - 1:
             time.sleep(sleep_s * (i + 1))
-    return last or "backend probe failed"
+    return last
 
 
 def _force_cpu_backend():
@@ -685,8 +716,13 @@ def run_all():
         # the bench always emits a real throughput number: a dead TPU
         # attach degrades to a CPU-jitted run (smaller default sizes so
         # the host finishes inside the driver budget) instead of the
-        # former 0.0 + error payload
-        out["tpu_probe_error"] = f"TPU backend unavailable: {err}"[:500]
+        # former 0.0 + error payload — and the artifact records WHERE
+        # the probe died (phase progress + stderr tail), not just that
+        # it did
+        out["tpu_probe_error"] = \
+            f"TPU backend unavailable: {err['error']}"[:500]
+        out["tpu_probe_stderr_tail"] = err["stderr_tail"]
+        out["tpu_probe_phases"] = err["phases"]
         out["platform_fallback"] = "cpu"
         os.environ.setdefault("BENCH_RESOURCES", "20000")
         os.environ.setdefault("BENCH_ITERS", "3")
@@ -723,21 +759,47 @@ def run_all():
         emit(out)
 
 
+def _emit_phase_split():
+    """--phases: the encode/compile/dispatch/readback split accumulated
+    by the profiling hooks during whatever just ran (stderr — stdout is
+    the JSON artifact contract)."""
+    from kyverno_tpu.observability.profiling import global_profiler
+
+    print(global_profiler.render_table("per-phase breakdown (bench --phases)"),
+          file=sys.stderr)
+
+
 def main():
-    config = sys.argv[1] if len(sys.argv) > 1 else "all"
+    argv = [a for a in sys.argv[1:] if a != "--phases"]
+    want_phases = "--phases" in sys.argv[1:]
+    config = argv[0] if argv else "all"
     if config == "_probe":
+        # phase-stamped progress: the parent's failure artifact shows
+        # how far the probe got (import vs device attach) and how long
+        # each step took
+        t0 = time.perf_counter()
         import jax
 
-        assert jax.devices()
+        print(f"probe-phase import_jax {time.perf_counter() - t0:.3f}",
+              flush=True)
+        t0 = time.perf_counter()
+        devices = jax.devices()
+        print(f"probe-phase devices {time.perf_counter() - t0:.3f}",
+              flush=True)
+        assert devices
         print("probe-ok")
         return
     if config == "all":
         run_all()
+        if want_phases:
+            _emit_phase_split()
         return
     if config == "coverage":
         emit(mixed_corpus_coverage())
         return
     emit(FNS[config]())
+    if want_phases:
+        _emit_phase_split()
 
 
 if __name__ == "__main__":

@@ -77,6 +77,13 @@ def add_parser(sub: argparse._SubParsersAction) -> None:
     p.add_argument("--output", "-o", default=None,
                    help="write (mutated) resources to this file or "
                         "directory (the reference's forceMutate output)")
+    p.add_argument("--profile", action="store_true",
+                   help="print a per-phase latency breakdown table "
+                        "(encode/compile/dispatch/readback/host) after "
+                        "the scan")
+    p.add_argument("--xla-trace", default=None, metavar="DIR",
+                   help="capture one jax.profiler trace of the validate "
+                        "stage into DIR (XLA-level profiling)")
     p.set_defaults(func=run)
 
 
@@ -251,6 +258,11 @@ def run(args: argparse.Namespace) -> int:
     for d in vap_docs:
         enforce[(d.get("metadata") or {}).get("name", "vap")] = "enforce"
 
+    if getattr(args, "profile", False):
+        # profile THIS apply run, not whatever warmed the process
+        from ..observability.profiling import global_profiler
+
+        global_profiler.reset()
     resource_docs, mutate_rows = _apply_mutations(policies, resource_docs)
     registry_client = None
     if getattr(args, "registry_fixture", None):
@@ -266,11 +278,14 @@ def run(args: argparse.Namespace) -> int:
     ns_labels = {(d.get("metadata") or {}).get("name", ""):
                  ((d.get("metadata") or {}).get("labels") or {})
                  for d in resource_docs if d.get("kind") == "Namespace"}
-    rows = (mutate_rows + vi_rows
-            + (_verdict_rows(policies, resource_docs, ns_labels or None,
-                             args.engine)
-               if policies else [])
-            + _vap_rows(vap_docs, resource_docs, ns_labels))
+    from ..observability.profiling import maybe_xla_trace
+
+    with maybe_xla_trace(getattr(args, "xla_trace", None)):
+        rows = (mutate_rows + vi_rows
+                + (_verdict_rows(policies, resource_docs, ns_labels or None,
+                                 args.engine)
+                   if policies else [])
+                + _vap_rows(vap_docs, resource_docs, ns_labels))
 
     counts = {"pass": 0, "fail": 0, "warn": 0, "error": 0, "skip": 0}
     failures: List[Tuple[str, str, str, str]] = []
@@ -310,6 +325,13 @@ def run(args: argparse.Namespace) -> int:
         print(f"\nApplied {len(policies)} policy rule(s) to {len(resource_docs)} resource(s)...")
         print(f"pass: {counts['pass']}, fail: {counts['fail']}, warn: {counts['warn']}, "
               f"error: {counts['error']}, skip: {counts['skip']}")
+    if getattr(args, "profile", False):
+        # stderr: --output-json consumers own stdout
+        from ..observability.profiling import global_profiler
+
+        print(global_profiler.render_table(
+            "per-phase latency breakdown (apply --profile)"),
+            file=sys.stderr)
     if counts["error"]:
         return 3
     return 1 if counts["fail"] else 0

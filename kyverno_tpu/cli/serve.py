@@ -58,6 +58,9 @@ def add_parser(sub: argparse._SubParsersAction) -> None:
     p.add_argument("--request-timeout-s", type=float, default=10.0,
                    help="per-request time budget; an overrun resolves per "
                         "the webhook path's failurePolicy, never a 500")
+    p.add_argument("--trace-export", default=None, metavar="PATH",
+                   help="append every finished span to PATH as "
+                        "newline-delimited OTLP-JSON (offline trace capture)")
     p.set_defaults(func=run)
 
 
@@ -139,11 +142,29 @@ def _metrics_server(cp: "ControlPlane", port: int) -> ThreadingHTTPServer:
 
         def do_GET(self):
             if self.path == "/metrics":
-                self._send(200, global_registry.exposition().encode())
+                body, ctype = global_registry.http_body()
+                self._send(200, body, ctype)
             elif self.path == "/reports":
                 reports = {ns or "_cluster": r.to_dict()
                            for ns, r in cp.aggregator.aggregate().items()}
                 self._send(200, json.dumps(reports).encode(), "application/json")
+            elif self.path == "/healthz":
+                self._send(200, b"ok")
+            elif self.path == "/readyz":
+                # ready = policy cache compiled + TPU breaker not OPEN
+                # (webhooks/server.py Handlers.ready)
+                ok, detail = cp.handlers.ready()
+                self._send(200 if ok else 503,
+                           json.dumps(detail).encode(), "application/json")
+            elif self.path.startswith("/debug/"):
+                # introspection next to /metrics: the metrics port is
+                # the operator-facing localhost surface, so the debug
+                # router is always on here (the ADMISSION port keeps it
+                # behind enable_debug)
+                from ..webhooks.server import handle_debug_path
+
+                code, body, ctype = handle_debug_path(self.path, cp.handlers)
+                self._send(code, body, ctype)
             else:
                 self._send(404, b"")
 
@@ -199,6 +220,15 @@ def run(args: argparse.Namespace) -> int:
             deadline_ms=args.deadline_ms,
             high_water=args.queue_high_water,
             shed_mode=args.shed_mode)
+    exporter = None
+    if args.trace_export:
+        from ..observability.tracing import (OTLPJsonFileExporter,
+                                             global_tracer)
+
+        exporter = OTLPJsonFileExporter(args.trace_export)
+        global_tracer.add_exporter(exporter)
+        print(f"trace export -> {args.trace_export} (OTLP-JSON lines)",
+              file=sys.stderr)
     cp = ControlPlane(policies, port=args.port, metrics_port=args.metrics_port,
                       cert=args.cert, key=args.key,
                       configuration=configuration, toggles=toggles,
@@ -220,4 +250,6 @@ def run(args: argparse.Namespace) -> int:
     signal.signal(signal.SIGINT, lambda *a: stop.set())
     stop.wait()
     cp.stop()
+    if exporter is not None:
+        exporter.close()
     return 0

@@ -1,6 +1,8 @@
-"""Observability: events, metrics, tracing (pkg/event, pkg/metrics,
-pkg/tracing equivalents)."""
+"""Observability: events, metrics, tracing, profiling (pkg/event,
+pkg/metrics, pkg/tracing equivalents + the SURVEY §5 phase split)."""
 
 from .events import Event, EventGenerator
 from .metrics import MetricsRegistry, global_registry
-from .tracing import Span, Tracer
+from .profiling import PhaseProfiler, global_profiler
+from .tracing import (OTLPJsonFileExporter, Span, SpanContext, Tracer,
+                      global_tracer)

@@ -40,18 +40,27 @@ class EventGenerator:
         workers: int = 3,
         max_queued: int = 1000,
         omit_reasons: Optional[List[str]] = None,
+        metrics=None,
     ) -> None:
         self._sink = sink or (lambda e: None)
         self._queue: "queue.Queue[Optional[Event]]" = queue.Queue(maxsize=max_queued)
         self._omit = set(omit_reasons or [])
+        # every counter mutation holds _counter_lock — add() and the
+        # worker threads race on these, and a lost drop increment hides
+        # an overload signal
         self.dropped = 0
         self.emitted = 0
         self._counter_lock = threading.Lock()
-        self._inflight = 0
+        if metrics is None:
+            from .metrics import global_registry
+
+            metrics = global_registry
+        self.metrics = metrics
         self._workers = [
             threading.Thread(target=self._drain, daemon=True) for _ in range(workers)
         ]
         self._started = False
+        self._stopping = False
         self._lock = threading.Lock()
 
     def start(self) -> None:
@@ -71,7 +80,9 @@ class EventGenerator:
             try:
                 self._queue.put_nowait(e)
             except queue.Full:
-                self.dropped += 1
+                with self._counter_lock:
+                    self.dropped += 1
+                self.metrics.events_dropped.inc()
 
     def _drain(self) -> None:
         while True:
@@ -83,6 +94,7 @@ class EventGenerator:
                 self._sink(e)
                 with self._counter_lock:
                     self.emitted += 1
+                self.metrics.events_emitted.inc()
             except Exception:
                 pass
             finally:
@@ -100,9 +112,24 @@ class EventGenerator:
                     return
             time.sleep(0.005)
 
-    def stop(self) -> None:
-        for _ in self._workers:
+    def stop(self, timeout: float = 5.0) -> None:
+        """Stop workers and JOIN them within a bound: a sentinel that
+        cannot be enqueued now (queue full) is retried as workers drain,
+        and a worker wedged in a stuck sink is abandoned at the deadline
+        (daemon threads) rather than hanging shutdown forever."""
+        import time
+
+        with self._lock:
+            if not self._started or self._stopping:
+                return
+            self._stopping = True
+        deadline = time.time() + timeout
+        pending = len(self._workers)
+        while pending and time.time() < deadline:
             try:
-                self._queue.put_nowait(None)
+                self._queue.put(None, timeout=0.05)
+                pending -= 1
             except queue.Full:
-                pass
+                continue
+        for w in self._workers:
+            w.join(timeout=max(0.0, deadline - time.time()))

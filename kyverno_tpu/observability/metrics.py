@@ -13,7 +13,8 @@ from __future__ import annotations
 
 import bisect
 import threading
-from typing import Dict, List, Optional, Sequence, Tuple
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 LabelKey = Tuple[Tuple[str, str], ...]
 
@@ -22,8 +23,15 @@ def _labels_key(labels: Optional[Dict[str, str]]) -> LabelKey:
     return tuple(sorted((labels or {}).items()))
 
 
+def _escape(value: str) -> str:
+    """Prometheus label-value escaping: backslash, double-quote, and
+    newline must be escaped or the line is unscrapeable."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def _fmt_labels(key: LabelKey, extra: str = "") -> str:
-    parts = [f'{k}="{v}"' for k, v in key]
+    parts = [f'{k}="{_escape(v)}"' for k, v in key]
     if extra:
         parts.append(extra)
     return "{" + ",".join(parts) + "}" if parts else ""
@@ -66,35 +74,58 @@ _DEFAULT_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
 
 
 class Histogram:
+    """Histogram with OpenMetrics exemplar support: an observation may
+    carry exemplar labels (typically ``{"trace_id": ...}``) and the
+    bucket it lands in remembers the LAST one — so a slow latency bucket
+    links straight back to a concrete trace in ``/debug/traces``."""
+
     def __init__(self, name: str, help_: str, buckets: Sequence[float] = _DEFAULT_BUCKETS):
         self.name, self.help = name, help_
         self.buckets = list(buckets)
         self._counts: Dict[LabelKey, List[int]] = {}
         self._sums: Dict[LabelKey, float] = {}
         self._totals: Dict[LabelKey, int] = {}
+        # (labelkey, bucket idx) -> (exemplar labels, value, unix ts);
+        # idx == len(buckets) is the +Inf bucket
+        self._exemplars: Dict[Tuple[LabelKey, int], Tuple[LabelKey, float, float]] = {}
         self._lock = threading.Lock()
 
-    def observe(self, value: float, labels: Optional[Dict[str, str]] = None) -> None:
+    def observe(self, value: float, labels: Optional[Dict[str, str]] = None,
+                exemplar: Optional[Dict[str, str]] = None) -> None:
         k = _labels_key(labels)
         with self._lock:
             counts = self._counts.setdefault(k, [0] * len(self.buckets))
             i = bisect.bisect_left(self.buckets, value)
             if i < len(counts):
                 counts[i] += 1
+            if exemplar:
+                self._exemplars[(k, i)] = (
+                    _labels_key(exemplar), float(value), time.time())
             self._sums[k] = self._sums.get(k, 0.0) + value
             self._totals[k] = self._totals.get(k, 0) + 1
+
+    def _exemplar_suffix(self, k: LabelKey, i: int) -> str:
+        ex = self._exemplars.get((k, i))
+        if ex is None:
+            return ""
+        ex_labels, ex_value, ex_ts = ex
+        body = ",".join(f'{lk}="{_escape(lv)}"' for lk, lv in ex_labels)
+        return f" # {{{body}}} {ex_value} {round(ex_ts, 3)}"
 
     def collect(self) -> List[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
         with self._lock:
             for k in sorted(self._counts):
                 cum = 0
-                for b, c in zip(self.buckets, self._counts[k]):
+                for i, (b, c) in enumerate(zip(self.buckets, self._counts[k])):
                     cum += c
                     le = f'le="{b}"'
-                    out.append(f"{self.name}_bucket{_fmt_labels(k, le)} {cum}")
+                    out.append(f"{self.name}_bucket{_fmt_labels(k, le)} {cum}"
+                               + self._exemplar_suffix(k, i))
                 inf = 'le="+Inf"'
-                out.append(f"{self.name}_bucket{_fmt_labels(k, inf)} {self._totals[k]}")
+                out.append(
+                    f"{self.name}_bucket{_fmt_labels(k, inf)} {self._totals[k]}"
+                    + self._exemplar_suffix(k, len(self.buckets)))
                 out.append(f"{self.name}_sum{_fmt_labels(k)} {self._sums[k]}")
                 out.append(f"{self.name}_count{_fmt_labels(k)} {self._totals[k]}")
         return out
@@ -172,6 +203,15 @@ class MetricsRegistry:
             "kyverno_tpu_scan_device_seconds", "device wall time per scan")
         self.scan_host_seconds = self.histogram(
             "kyverno_tpu_scan_host_seconds", "host completion time per scan")
+        # event generator accounting (observability/events.py): drops
+        # are an overload signal that must be scrapeable, not an
+        # attribute on a Python object nobody reads
+        self.events_emitted = self.counter(
+            "kyverno_events_emitted_total",
+            "policy events delivered to the sink")
+        self.events_dropped = self.counter(
+            "kyverno_events_dropped_total",
+            "policy events dropped on queue overflow")
 
     def counter(self, name: str, help_: str) -> Counter:
         with self._lock:
@@ -197,6 +237,14 @@ class MetricsRegistry:
                 self._instruments[name] = inst
             return inst  # type: ignore[return-value]
 
+    # exemplars are an OpenMetrics construct: a scraper that negotiates
+    # the plain text format would reject the mid-line '#'. The HTTP
+    # surfaces serve this content type (and the terminator below) so
+    # the right parser is selected; exposition() itself stays a plain
+    # string for tests and programmatic readers.
+    OPENMETRICS_CONTENT_TYPE = \
+        "application/openmetrics-text; version=1.0.0; charset=utf-8"
+
     def exposition(self) -> str:
         lines: List[str] = []
         with self._lock:
@@ -204,6 +252,12 @@ class MetricsRegistry:
         for inst in insts:
             lines.extend(inst.collect())  # type: ignore[attr-defined]
         return "\n".join(lines) + "\n"
+
+    def http_body(self) -> "Tuple[bytes, str]":
+        """(body, content-type) for a /metrics endpoint: OpenMetrics
+        framing — exposition plus the mandatory '# EOF' terminator."""
+        return (self.exposition() + "# EOF\n").encode(), \
+            self.OPENMETRICS_CONTENT_TYPE
 
 
 global_registry = MetricsRegistry()

@@ -245,6 +245,9 @@ class ShardedScanner:
         import time
 
         from ..observability.metrics import global_registry
+        from ..observability.profiling import (PHASE_DISPATCH, PHASE_ENCODE,
+                                               PHASE_HOST_COMPLETE,
+                                               PHASE_READBACK, global_profiler)
         from ..observability.tracing import global_tracer
         from ..tpu.engine import TpuEngine
         from ..tpu.evaluator import HOST
@@ -257,16 +260,26 @@ class ShardedScanner:
                if complete_host else None)
         tables = []
         pending = []  # (device verdicts future, tile slice, n_valid)
+        # every chunk span is an EXPLICIT child of one scan-level
+        # context: tile spans stay causally connected to this scan no
+        # matter which thread (or async drain order) touches them
+        scan_span = global_tracer.start_span(
+            "scan_stream", resources=n, tile=tile)
+        scan_ctx = scan_span.context
 
         def drain():
             dv, sl, nv = pending.pop(0)
             t0 = time.perf_counter()
-            with global_tracer.span("scan_device_wait", tile=nv):
+            with global_profiler.phase(PHASE_READBACK), \
+                    global_tracer.span("scan_device_wait", parent=scan_ctx,
+                                       tile=nv):
                 table = np.asarray(dv)[:, :nv]  # blocks on the device
             stats["device_s"] += time.perf_counter() - t0
             if eng is not None:
                 t0 = time.perf_counter()
-                with global_tracer.span("scan_host_complete", tile=nv):
+                with global_profiler.phase(PHASE_HOST_COMPLETE), \
+                        global_tracer.span("scan_host_complete",
+                                           parent=scan_ctx, tile=nv):
                     res = eng.assemble(
                         table, resources[sl],
                         namespace_labels,
@@ -278,32 +291,46 @@ class ShardedScanner:
             else:
                 tables.append(table)
 
-        for start in range(0, max(n, 1), tile):
-            sl = slice(start, min(start + tile, n))
-            chunk = resources[sl]
-            nv = len(chunk)
-            t0 = time.perf_counter()
-            with global_tracer.span("scan_encode", tile=nv):
-                padded = list(chunk) + [{} for _ in range(tile - nv)]
-                ops = None
-                if operations:
-                    ops = list(operations[sl]) + [""] * (tile - nv)
-                batch, _ = self.encode(padded, namespace_labels, ops)
-            stats["encode_s"] += time.perf_counter() - t0
-            # async sharded put then dispatch: the H2D copy of tile k+1
-            # overlaps the device compute of tiles k, k-1, ...
-            verdicts, _ = self._step(self.put(batch))
-            pending.append((verdicts, sl, nv))
-            stats["tiles"] += 1
-            while len(pending) > max(in_flight, 1):
+        try:
+            for start in range(0, max(n, 1), tile):
+                sl = slice(start, min(start + tile, n))
+                chunk = resources[sl]
+                nv = len(chunk)
+                t0 = time.perf_counter()
+                with global_profiler.phase(PHASE_ENCODE), \
+                        global_tracer.span("scan_encode", parent=scan_ctx,
+                                           tile=nv):
+                    padded = list(chunk) + [{} for _ in range(tile - nv)]
+                    ops = None
+                    if operations:
+                        ops = list(operations[sl]) + [""] * (tile - nv)
+                    batch, _ = self.encode(padded, namespace_labels, ops)
+                stats["encode_s"] += time.perf_counter() - t0
+                # async sharded put then dispatch: the H2D copy of tile
+                # k+1 overlaps the device compute of tiles k, k-1, ...
+                with global_profiler.phase(PHASE_DISPATCH), \
+                        global_tracer.span("scan_dispatch", parent=scan_ctx,
+                                           tile=nv):
+                    verdicts, _ = self._step(self.put(batch))
+                pending.append((verdicts, sl, nv))
+                stats["tiles"] += 1
+                while len(pending) > max(in_flight, 1):
+                    drain()
+            while pending:
                 drain()
-        while pending:
-            drain()
+        except BaseException as e:
+            scan_span.set_status("error", f"{type(e).__name__}: {e}")
+            raise
+        finally:
+            scan_span.attributes["tiles"] = stats["tiles"]
+            global_tracer.end_span(scan_span)
         # phase timings land in metrics too (SURVEY §5: emit the
-        # per-phase costs scan_stream collects)
-        global_registry.scan_encode_seconds.observe(stats["encode_s"])
-        global_registry.scan_device_seconds.observe(stats["device_s"])
-        global_registry.scan_host_seconds.observe(stats["host_s"])
+        # per-phase costs scan_stream collects), exemplar-linked to the
+        # scan's trace so a slow bucket names the trace that caused it
+        ex = {"trace_id": scan_ctx.trace_id}
+        global_registry.scan_encode_seconds.observe(stats["encode_s"], exemplar=ex)
+        global_registry.scan_device_seconds.observe(stats["device_s"], exemplar=ex)
+        global_registry.scan_host_seconds.observe(stats["host_s"], exemplar=ex)
 
         from ..tpu.engine import ScanResult
 

@@ -140,6 +140,15 @@ class CircuitBreaker:
         if frm != to:
             self.metrics.breaker_transitions.inc(
                 {"breaker": self.name, "from": frm, "to": to})
+            # a transition inside a traced operation (dispatch span)
+            # lands on that span, so the trace of the batch that tripped
+            # or healed the breaker says so itself
+            from ..observability.tracing import global_tracer
+
+            global_tracer.add_event(
+                "breaker_transition", breaker=self.name,
+                from_state=frm, to_state=to,
+                consecutive_failures=self._consecutive_failures)
         self._publish_state()
 
     def _publish_state(self) -> None:

@@ -224,9 +224,14 @@ class FaultRegistry:
     @staticmethod
     def _count(spec: FaultSpec) -> None:
         from ..observability.metrics import global_registry
+        from ..observability.tracing import global_tracer
 
         global_registry.faults_injected.inc(
             {"site": spec.site, "mode": spec.mode})
+        # chaos runs must be attributable per-trace: the span under
+        # which the fault fired records it as an event
+        global_tracer.add_event("fault_injected", site=spec.site,
+                                mode=spec.mode, fired=spec.fired)
 
 
 global_faults = FaultRegistry()

@@ -89,6 +89,8 @@ def retry_call(
         from ..observability.metrics import global_registry
 
         metrics = global_registry
+    from ..observability.tracing import global_tracer
+
     rng = rng or random.Random()
     if deadline is None:
         deadline = Deadline(policy.deadline_s, clock=clock)
@@ -102,6 +104,9 @@ def retry_call(
                 metrics.retry_attempts.inc(
                     {"site": site or "unknown", "outcome": "recovered"},
                     value=attempt)
+                global_tracer.add_event(
+                    "retry_recovered", site=site or "unknown",
+                    attempts=attempt + 1)
             return out
         except PermanentError:
             # deterministic failure: surface it now, the backend will
@@ -111,6 +116,9 @@ def retry_call(
             raise
         except Exception as e:  # noqa: BLE001 — other failures are transient
             last = e
+            global_tracer.add_event(
+                "retry_attempt_failed", site=site or "unknown",
+                attempt=attempt + 1, error=f"{type(e).__name__}: {e}")
             if attempt + 1 >= policy.max_attempts:
                 break
             pause = policy.delay(attempt, rng)

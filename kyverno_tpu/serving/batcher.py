@@ -26,6 +26,9 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
 from ..observability.metrics import MetricsRegistry, global_registry
+from ..observability.profiling import (PATH_DEVICE, last_dispatch_path,
+                                       set_dispatch_path)
+from ..observability.tracing import global_tracer
 from .queue import (AdmissionQueue, DeadlineExceededError, QueuedRequest,
                     QueueFullError)
 
@@ -107,37 +110,50 @@ class AdmissionPipeline:
                   else self.config.deadline_ms) / 1000.0
         grace = (eval_grace_s if eval_grace_s is not None
                  else self.config.eval_grace_s)
-        t0 = time.monotonic()
-        try:
-            req = self.queue.put(payload, t0 + budget, now=t0)
-        except QueueFullError:
-            with self._stats_lock:
-                self.stats["shed"] += 1
-            if self.config.shed_mode == "scalar" and self._scalar is not None:
-                self.metrics.serving_shed_total.inc({"outcome": "scalar"})
-                out = self._scalar(payload)
-                self.metrics.serving_request_latency.observe(
-                    time.monotonic() - t0, {"path": "shed"})
-                return out
-            self.metrics.serving_shed_total.inc({"outcome": "rejected"})
-            raise
-        self.metrics.serving_queue_depth.set(self.queue.depth())
-        # the deadline governs QUEUE time; only a request that actually
-        # made it onto the device earns eval_grace_s to complete — a
-        # request still queued past its budget (wedged flusher) resolves
-        # per failurePolicy NOW, honoring the webhook's request timeout
-        if not req.event.wait(budget):
-            if not req.dispatched:
-                raise DeadlineExceededError(
-                    "request deadline expired while queued")
-            if not req.event.wait(grace):
-                raise DeadlineExceededError(
-                    "admission batch evaluation timed out")
-        self.metrics.serving_request_latency.observe(
-            time.monotonic() - t0, {"path": "batched"})
-        if isinstance(req.result, BaseException):
-            raise req.result
-        return req.result
+        # ONE trace per request: the submit span is the root, its
+        # context rides the queue entry across the flusher handoff, and
+        # the latency histogram carries the trace id as an exemplar so a
+        # slow bucket links back to a concrete trace (/debug/traces)
+        with global_tracer.span("admission.submit") as root:
+            exemplar = {"trace_id": root.trace_id}
+            t0 = time.monotonic()
+            try:
+                req = self.queue.put(payload, t0 + budget, now=t0,
+                                     trace_ctx=root.context)
+            except QueueFullError:
+                with self._stats_lock:
+                    self.stats["shed"] += 1
+                root.add_event("shed", depth=self.queue.high_water)
+                if self.config.shed_mode == "scalar" and self._scalar is not None:
+                    self.metrics.serving_shed_total.inc({"outcome": "scalar"})
+                    with global_tracer.span("admission.scalar_fallback",
+                                            parent=root.context,
+                                            reason="shed"):
+                        out = self._scalar(payload)
+                    self.metrics.serving_request_latency.observe(
+                        time.monotonic() - t0, {"path": "shed"},
+                        exemplar=exemplar)
+                    return out
+                self.metrics.serving_shed_total.inc({"outcome": "rejected"})
+                raise
+            self.metrics.serving_queue_depth.set(self.queue.depth())
+            # the deadline governs QUEUE time; only a request that
+            # actually made it onto the device earns eval_grace_s to
+            # complete — a request still queued past its budget (wedged
+            # flusher) resolves per failurePolicy NOW, honoring the
+            # webhook's request timeout
+            if not req.event.wait(budget):
+                if not req.dispatched:
+                    raise DeadlineExceededError(
+                        "request deadline expired while queued")
+                if not req.event.wait(grace):
+                    raise DeadlineExceededError(
+                        "admission batch evaluation timed out")
+            self.metrics.serving_request_latency.observe(
+                time.monotonic() - t0, {"path": "batched"}, exemplar=exemplar)
+            if isinstance(req.result, BaseException):
+                raise req.result
+            return req.result
 
     def stop(self) -> None:
         with self.queue.cv:
@@ -212,6 +228,15 @@ class AdmissionPipeline:
         # cv, so submit()'s wait has eval_grace_s slack for them)
         if now is None:
             now = time.monotonic()
+        # queue-wait spans materialize HERE, retroactively, in each
+        # request's own trace: the flusher owns the drain timestamp and
+        # the queue entry carried the submit span's context over
+        for req in batch:
+            if req.trace_ctx is not None:
+                global_tracer.record_span(
+                    "admission.queue_wait", req.enqueued_at,
+                    req.drained_at or now, parent=req.trace_ctx,
+                    flush_reason=reason)
         live: List[QueuedRequest] = []
         for req in batch:
             if req.deadline <= now:
@@ -242,6 +267,8 @@ class AdmissionPipeline:
         self.metrics.serving_batch_size.observe(len(live))
         self.metrics.serving_batch_occupancy.observe(len(live) / bucket)
         padded = [req.payload for req in live] + [None] * (bucket - len(live))
+        t_eval0 = time.monotonic()
+        set_dispatch_path(PATH_DEVICE)  # evaluator overwrites on fallback
         try:
             # chaos hook: an armed serving.flush fault lands here, so
             # an injected flush failure takes the SAME path a real
@@ -254,11 +281,67 @@ class AdmissionPipeline:
             if len(results) < len(live):
                 raise RuntimeError("batch evaluator returned wrong arity")
         except BaseException as e:  # propagate to every waiter
+            t_eval1 = time.monotonic()
             for req in live:
                 req.resolve(e)
+            self._record_flush_spans(live, reason, bucket, now, t_eval0,
+                                     t_eval1, error=f"{type(e).__name__}: {e}")
             return
+        t_eval1 = time.monotonic()
+        t_resolve0 = time.monotonic()
         for req, result in zip(live, results):
             req.resolve(result)
+        t_resolve1 = time.monotonic()
+        # span recording (and any exporter I/O it triggers) happens
+        # AFTER every waiter is woken: the spans carry explicit
+        # timestamps, so ordering costs nothing — doing it first would
+        # tax every request's latency with tracing overhead
+        self._record_flush_spans(live, reason, bucket, now, t_eval0, t_eval1)
+        for req in live:
+            if req.trace_ctx is not None:
+                global_tracer.record_span(
+                    "admission.verdict_dispatch", t_resolve0, t_resolve1,
+                    parent=req.trace_ctx, batch_size=len(live))
+
+    def _record_flush_spans(self, live: List[QueuedRequest], reason: str,
+                            bucket: int, drained_at: float,
+                            t_eval0: float, t_eval1: float,
+                            error: Optional[str] = None) -> None:
+        """Per-request flush + dispatch spans: the batch evaluation is
+        shared work, but each request's trace must tell the whole story,
+        so the shared timings are recorded once per participating trace
+        — named by HOW the batch actually resolved (the engine marks the
+        device-vs-scalar path in a thread-local this flusher thread
+        reads back). With ``error`` set (the evaluator raised), the
+        flush span records the failure and no dispatch span is emitted —
+        nothing dispatched."""
+        traced = [r for r in live if r.trace_ctx is not None]
+        if not traced:
+            return
+        if error is not None:
+            for req in traced:
+                global_tracer.record_span(
+                    "admission.flush", req.drained_at or drained_at, t_eval1,
+                    parent=req.trace_ctx, status="error", reason=reason,
+                    batch_size=len(live), bucket=bucket, error=error)
+            return
+        path = last_dispatch_path()
+        dispatch_name = ("admission.device_dispatch" if path == PATH_DEVICE
+                         else "admission.scalar_fallback")
+        try:
+            from ..resilience.breaker import tpu_breaker
+
+            breaker_state = tpu_breaker().state
+        except Exception:
+            breaker_state = "unknown"
+        for req in traced:
+            global_tracer.record_span(
+                "admission.flush", req.drained_at or drained_at, t_eval1,
+                parent=req.trace_ctx, reason=reason, batch_size=len(live),
+                bucket=bucket)
+            global_tracer.record_span(
+                dispatch_name, t_eval0, t_eval1, parent=req.trace_ctx,
+                engine=path, breaker=breaker_state, batch_size=len(live))
 
     # -- introspection
 
@@ -266,3 +349,28 @@ class AdmissionPipeline:
         with self._stats_lock:
             flushes = sum(self.stats["flushes_by_bucket"].values())
             return self.stats["evaluated"] / flushes if flushes else 0.0
+
+    def state(self) -> Dict[str, Any]:
+        """JSON-ready snapshot for /debug/state: queue pressure, bucket
+        occupancy, flush accounting."""
+        with self._stats_lock:
+            stats = {k: (dict(v) if isinstance(v, dict) else v)
+                     for k, v in self.stats.items()}
+        flushes = sum(stats["flushes_by_bucket"].values())
+        return {
+            "queue_depth": self.queue.depth(),
+            "high_water": self.queue.high_water,
+            "stopped": self._stopped,
+            "mean_batch_size": round(
+                stats["evaluated"] / flushes, 3) if flushes else 0.0,
+            "mean_occupancy": round(
+                stats["occupancy_sum"] / flushes, 3) if flushes else 0.0,
+            "config": {
+                "max_batch_size": self.config.max_batch_size,
+                "max_wait_ms": self.config.max_wait_ms,
+                "deadline_ms": self.config.deadline_ms,
+                "min_bucket": self.config.min_bucket,
+                "shed_mode": self.config.shed_mode,
+            },
+            "stats": stats,
+        }

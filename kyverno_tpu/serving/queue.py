@@ -23,14 +23,21 @@ class DeadlineExceededError(TimeoutError):
 
 class QueuedRequest:
     __slots__ = ("payload", "enqueued_at", "deadline", "event", "result",
-                 "dispatched")
+                 "dispatched", "trace_ctx", "drained_at")
 
-    def __init__(self, payload: Any, enqueued_at: float, deadline: float):
+    def __init__(self, payload: Any, enqueued_at: float, deadline: float,
+                 trace_ctx: Any = None):
         self.payload = payload
         self.enqueued_at = enqueued_at
         self.deadline = deadline  # absolute monotonic time
         self.event = threading.Event()
         self.result: Any = None
+        # the submitting request's SpanContext, carried by VALUE across
+        # the queue handoff so the flusher thread's queue-wait / flush /
+        # dispatch / verdict spans land in the SAME trace as the
+        # submit span (observability/tracing.py)
+        self.trace_ctx = trace_ctx
+        self.drained_at: float = 0.0
         # set under the queue cv the instant drain() hands this entry
         # to the flusher: submit() only extends its wait past the
         # deadline budget for requests the flusher owns (eval grace),
@@ -59,9 +66,9 @@ class AdmissionQueue:
         self._items: List[QueuedRequest] = []
 
     def put(self, payload: Any, deadline: float,
-            now: Optional[float] = None) -> QueuedRequest:
+            now: Optional[float] = None, trace_ctx: Any = None) -> QueuedRequest:
         req = QueuedRequest(payload, now if now is not None
-                            else time.monotonic(), deadline)
+                            else time.monotonic(), deadline, trace_ctx)
         with self.cv:
             if self.closed:
                 raise RuntimeError("admission queue is closed")
@@ -75,8 +82,10 @@ class AdmissionQueue:
     def drain(self, max_n: int) -> List[QueuedRequest]:
         """Pop up to max_n oldest entries. Callers hold self.cv."""
         batch, self._items = self._items[:max_n], self._items[max_n:]
+        now = time.monotonic()
         for req in batch:
             req.dispatched = True
+            req.drained_at = now  # queue-wait span boundary
         return batch
 
     def drain_all(self) -> List[QueuedRequest]:

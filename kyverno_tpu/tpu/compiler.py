@@ -70,11 +70,25 @@ class CompiledPolicySet:
         return sorted({e.policy_idx for e in self.rules if e.device_row is None})
 
     def device_fn(self) -> Callable:
-        """The jitted batch program (compiled lazily, cached)."""
+        """The jitted batch program (compiled lazily, cached). Every
+        lookup is attributed on kyverno_tpu_compile_cache_total so the
+        hit/miss ratio — the recompilation-churn signal SURVEY §7 warns
+        about — is scrapeable, not inferred from latency spikes."""
+        from ..observability.metrics import global_registry
+        from ..observability.profiling import PHASE_COMPILE, global_profiler
+        from ..observability.tracing import global_tracer
+
         if self._fn is None:
-            self._fn = jax.jit(
-                build_program(self.device_programs, self.encode_cfg.max_instances)
-            )
+            global_registry.compile_cache.inc({"outcome": "miss"})
+            with global_profiler.phase(PHASE_COMPILE), \
+                    global_tracer.span("xla_jit_build",
+                                       programs=len(self.device_programs)):
+                self._fn = jax.jit(
+                    build_program(self.device_programs,
+                                  self.encode_cfg.max_instances)
+                )
+        else:
+            global_registry.compile_cache.inc({"outcome": "hit"})
         return self._fn
 
     def coverage(self) -> Tuple[int, int]:
@@ -88,9 +102,11 @@ def compile_policy_set(
     meta_cfg: Optional[MetaConfig] = None,
     data_sources=None,
 ) -> CompiledPolicySet:
+    from ..observability.profiling import PHASE_COMPILE, global_profiler
     from ..observability.tracing import global_tracer
 
-    with global_tracer.span("policy_set_compile", policies=len(policies)):
+    with global_profiler.phase(PHASE_COMPILE), \
+            global_tracer.span("policy_set_compile", policies=len(policies)):
         return _compile_policy_set(policies, encode_cfg, meta_cfg, data_sources)
 
 

@@ -25,6 +25,12 @@ from ..engine.engine import Engine as ScalarEngine
 from ..engine.match import RequestInfo
 from ..engine.policycontext import PolicyContext
 from ..engine.response import EngineResponse
+from ..observability.profiling import (PATH_DEVICE, PATH_SCALAR_FALLBACK,
+                                       PHASE_DISPATCH, PHASE_ENCODE,
+                                       PHASE_HOST_COMPLETE, PHASE_READBACK,
+                                       global_profiler, maybe_xla_trace,
+                                       set_dispatch_path)
+from ..observability.tracing import global_tracer
 from ..resilience.faults import SITE_TPU_DISPATCH, global_faults
 from .compiler import CompiledPolicySet, compile_policy_set
 from .evaluator import ERROR, FAIL, HOST, NOT_MATCHED, PASS, SKIP, batch_to_host
@@ -405,7 +411,11 @@ class TpuEngine:
         infos = (list(admission_infos) + [None] * (padded_n - n)) \
             if admission_infos else None
         try:
-            batch, rows, meta = self.encode(padded, namespace_labels, ops, infos)
+            with global_profiler.phase(PHASE_ENCODE), \
+                    global_tracer.span("tpu.encode", resources=n,
+                                       padded=padded_n):
+                batch, rows, meta = self.encode(padded, namespace_labels,
+                                                ops, infos)
         except Exception:
             # a hostile resource broke batch encoding: quarantine it so
             # the rest of the batch still evaluates (device or scalar),
@@ -427,23 +437,35 @@ class TpuEngine:
         from ..observability.metrics import global_registry
 
         if not self.breaker.allow():
+            set_dispatch_path(PATH_SCALAR_FALLBACK)
             global_registry.breaker_fallback.inc({"reason": "open"})
+            global_tracer.add_event("breaker_fallback", reason="open",
+                                    breaker=self.breaker.name)
             return None
         try:
-            global_faults.fire(SITE_TPU_DISPATCH)
-            table = dispatch_fn()
-            table = global_faults.corrupt(SITE_TPU_DISPATCH, table)
-            if not (isinstance(table, np.ndarray)
-                    and table.shape == want_shape
-                    and np.issubdtype(table.dtype, np.integer)):
-                raise DeviceResultError(
-                    f"device returned shape "
-                    f"{getattr(table, 'shape', None)}, want {want_shape}")
-            self.breaker.record_success()
-            return table
-        except Exception:
+            with global_tracer.span("tpu.dispatch",
+                                    breaker=self.breaker.state) as span:
+                global_faults.fire(SITE_TPU_DISPATCH)
+                table = dispatch_fn()
+                table = global_faults.corrupt(SITE_TPU_DISPATCH, table)
+                if not (isinstance(table, np.ndarray)
+                        and table.shape == want_shape
+                        and np.issubdtype(table.dtype, np.integer)):
+                    raise DeviceResultError(
+                        f"device returned shape "
+                        f"{getattr(table, 'shape', None)}, want {want_shape}")
+                self.breaker.record_success()
+                set_dispatch_path(PATH_DEVICE)
+                span.attributes["engine"] = PATH_DEVICE
+                return table
+        except Exception as e:
             self.breaker.record_failure()
+            set_dispatch_path(PATH_SCALAR_FALLBACK)
             global_registry.breaker_fallback.inc({"reason": "error"})
+            global_tracer.add_event(
+                "breaker_fallback", reason="error", breaker=self.breaker.name,
+                breaker_state=self.breaker.state,
+                error=f"{type(e).__name__}: {e}")
             return None
 
     def _dispatch(self, batch, padded_n: int) -> np.ndarray:
@@ -456,8 +478,14 @@ class TpuEngine:
             import jax
 
             # one batched H2D put for the whole lane dict — per-lane
-            # transfer pays a link round-trip per array (see batch_to_host)
-            return np.asarray(self.cps.device_fn()(jax.device_put(batch)))
+            # transfer pays a link round-trip per array (see batch_to_host).
+            # dispatch (async launch + any XLA compile at this shape) and
+            # readback (the blocking D2H) are attributed separately
+            with maybe_xla_trace():
+                with global_profiler.phase(PHASE_DISPATCH):
+                    out = self.cps.device_fn()(jax.device_put(batch))
+                with global_profiler.phase(PHASE_READBACK):
+                    return np.asarray(out)
 
         D = len(self.cps.device_programs)
         table = self.guarded_dispatch(run, (D, padded_n))
@@ -582,29 +610,30 @@ class TpuEngine:
         from ..engine.match import matches_resource_description
 
         cache: Dict[Tuple[int, int], Dict[str, int]] = {}
-        for (pi, ci) in host_cells:
-            policy = self.cps.policies[pi]
-            res = resources[ci]
-            kind = res.get("kind", "")
-            ns = (res.get("metadata") or {}).get("namespace", "")
-            nsl = ns_labels.get((res.get("metadata") or {}).get("name", "") if kind == "Namespace" else ns, {})
-            op = (operations[ci] if operations else "") or ""
-            info = admission_infos[ci] if admission_infos else None
-            # pre-screen with the (cheap) matcher before paying for
-            # context construction + full validation: in a realistic
-            # mix most host (policy, resource) cells are simply not
-            # matched (kind/selector mismatch), making the fallback
-            # cost scale with MATCHED cells, not policies x resources
-            if not any(
-                    not matches_resource_description(
-                        res, rule, info, nsl,
-                        policy_namespace=policy.namespace,
-                        operation=op or "CREATE")
-                    for rule in policy.get_rules() if rule.has_validate()):
-                cache[(pi, ci)] = {}  # every rule NOT_MATCHED
-                continue
-            pctx = build_scan_context(policy, res, nsl, op, info)
-            cache[(pi, ci)] = _scalar_rule_verdicts(self.scalar, policy, pctx)
+        with global_profiler.phase(PHASE_HOST_COMPLETE):
+            for (pi, ci) in host_cells:
+                policy = self.cps.policies[pi]
+                res = resources[ci]
+                kind = res.get("kind", "")
+                ns = (res.get("metadata") or {}).get("namespace", "")
+                nsl = ns_labels.get((res.get("metadata") or {}).get("name", "") if kind == "Namespace" else ns, {})
+                op = (operations[ci] if operations else "") or ""
+                info = admission_infos[ci] if admission_infos else None
+                # pre-screen with the (cheap) matcher before paying for
+                # context construction + full validation: in a realistic
+                # mix most host (policy, resource) cells are simply not
+                # matched (kind/selector mismatch), making the fallback
+                # cost scale with MATCHED cells, not policies x resources
+                if not any(
+                        not matches_resource_description(
+                            res, rule, info, nsl,
+                            policy_namespace=policy.namespace,
+                            operation=op or "CREATE")
+                        for rule in policy.get_rules() if rule.has_validate()):
+                    cache[(pi, ci)] = {}  # every rule NOT_MATCHED
+                    continue
+                pctx = build_scan_context(policy, res, nsl, op, info)
+                cache[(pi, ci)] = _scalar_rule_verdicts(self.scalar, policy, pctx)
         for ri, entry in enumerate(self.cps.rules):
             for (pi, ci), verdicts in cache.items():
                 if pi != entry.policy_idx:

@@ -176,6 +176,10 @@ class Handlers:
         if self.toggles.engine == "scalar":
             # toggle-gated host path (pkg/toggle analogue): same verdict
             # table, computed by the scalar oracle per (policy, resource)
+            from ..observability.profiling import (PATH_SCALAR_FALLBACK,
+                                                   set_dispatch_path)
+
+            set_dispatch_path(PATH_SCALAR_FALLBACK)
             out = [self._scalar_verdict_rows(p) for p in filled[:real_n]]
             self.metrics.device_dispatch.observe(time.perf_counter() - t0,
                                                  {"engine": "scalar"})
@@ -196,6 +200,66 @@ class Handlers:
                                              {"engine": "tpu"})
         self.metrics.batch_size.observe(real_n)
         return [resource_verdicts(result, ci) for ci in range(real_n)]
+
+    # -- health / introspection
+
+    def ready(self) -> Tuple[bool, Dict[str, Any]]:
+        """/readyz: the loaded policy set compiles AND the TPU breaker
+        is not OPEN. An OPEN breaker still serves correct verdicts (the
+        scalar ladder), but a rollout gate that can't tell "healthy" from
+        "limping on the host oracle" will happily scale a degraded
+        fleet — readiness is where that distinction surfaces."""
+        from ..resilience.breaker import tpu_breaker
+
+        detail: Dict[str, Any] = {}
+        try:
+            rev, eng = self._engine()
+            dev, total = eng.coverage()
+            detail["policy_revision"] = rev
+            detail["compiled_rules"] = total
+            detail["device_rules"] = dev
+            compiled = True
+        except Exception as e:
+            detail["compile_error"] = f"{type(e).__name__}: {e}"
+            compiled = False
+        breaker = tpu_breaker()
+        detail["breaker"] = breaker.state
+        ok = compiled and breaker.state != "open"
+        detail["ready"] = ok
+        return ok, detail
+
+    def debug_state(self) -> Dict[str, Any]:
+        """/debug/state: one JSON document answering "what is the
+        engine doing RIGHT NOW" — queue depth and bucket occupancy,
+        breaker state, compile-cache contents, armed faults, and the
+        accumulated per-phase cost split."""
+        from ..observability.profiling import global_profiler
+        from ..resilience.breaker import tpu_breaker
+        from ..resilience.faults import global_faults
+
+        breaker = tpu_breaker()
+        with self._lock:
+            compile_cache = [{
+                "revision": rev,
+                "device_rules": eng.coverage()[0],
+                "total_rules": eng.coverage()[1],
+                "dyn_slots": len(eng.cps.dyn_slots),
+                "jit_built": eng.cps._fn is not None,
+                "policies": [p.name for p in eng.cps.policies],
+            } for rev, eng in self._engines.items()]
+        state: Dict[str, Any] = {
+            "engine_toggle": self.toggles.engine,
+            "breaker": {"name": breaker.name, "state": breaker.state},
+            "compile_cache": compile_cache,
+            "faults_armed": {
+                site: {"mode": spec.mode, "calls": spec.calls,
+                       "fired": spec.fired}
+                for site, spec in global_faults.armed().items()},
+            "phase_breakdown": global_profiler.breakdown(),
+        }
+        if self.pipeline is not None:
+            state["pipeline"] = self.pipeline.state()
+        return state
 
     # -- public handlers
 
@@ -541,6 +605,56 @@ def build_handlers(cache: PolicyCache, snapshot=None, aggregator=None, **kw) -> 
     return Handlers(cache, snapshot, aggregator, **kw)
 
 
+def handle_debug_path(path: str, handlers: Optional[Handlers] = None
+                      ) -> Tuple[int, bytes, str]:
+    """One debug router shared by the admission server and the serve
+    control plane's metrics port — the two surfaces must answer
+    identically or operators end up debugging the debug endpoints."""
+    from urllib.parse import parse_qs, urlparse
+
+    from ..observability.tracing import global_tracer
+
+    parsed = urlparse(path)
+    route = parsed.path
+    query = parse_qs(parsed.query)
+    if route == "/debug/traces":
+        try:
+            min_ms = float(query.get("min_ms", ["0"])[0])
+        except ValueError:
+            return 400, b'{"error": "min_ms must be a number"}\n', "application/json"
+        traces = global_tracer.recent_traces(min_duration_s=min_ms / 1000.0)
+        return 200, (json.dumps({"traces": traces}) + "\n").encode(), \
+            "application/json"
+    if route == "/debug/state":
+        state = handlers.debug_state() if handlers is not None else {}
+        return 200, (json.dumps(state) + "\n").encode(), "application/json"
+    if route == "/debug/spans":
+        lines = []
+        for s in global_tracer.finished()[-200:]:
+            attrs = " ".join(f"{k}={v}" for k, v in s.attributes.items())
+            lines.append(f"{s.name} {s.duration * 1e3:.3f}ms "
+                         f"trace={s.trace_id} status={s.status} {attrs}".rstrip())
+        return 200, ("\n".join(lines) + "\n").encode(), "text/plain"
+    if route.startswith("/debug/xla/start"):
+        import jax
+
+        out_dir = query.get("dir", ["/tmp/kyverno-tpu-xla-trace"])[0]
+        try:
+            jax.profiler.start_trace(out_dir)
+        except Exception as e:
+            return 500, f"profiler start failed: {e}\n".encode(), "text/plain"
+        return 200, f"xla trace started -> {out_dir}\n".encode(), "text/plain"
+    if route.startswith("/debug/xla/stop"):
+        import jax
+
+        try:
+            jax.profiler.stop_trace()
+        except Exception as e:
+            return 500, f"profiler stop failed: {e}\n".encode(), "text/plain"
+        return 200, b"xla trace stopped\n", "text/plain"
+    return 404, b"unknown debug path\n", "text/plain"
+
+
 class AdmissionServer:
     """ThreadingHTTPServer wrapper with optional TLS."""
 
@@ -565,15 +679,25 @@ class AdmissionServer:
                 pass
 
             def do_GET(self):
-                if self.path in ("/health/liveness", "/health/readiness"):
+                if self.path in ("/health/liveness", "/health/readiness",
+                                 "/healthz"):
                     self.send_response(200)
                     self.end_headers()
                     self.wfile.write(b"ok")
+                elif self.path == "/readyz":
+                    ok, detail = outer.handlers.ready()
+                    body = json.dumps(detail).encode()
+                    self.send_response(200 if ok else 503)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
                 elif self.path.startswith("/debug/") and outer.enable_debug:
                     # pprof-equivalent surface (pkg/profiling, SURVEY §5)
-                    code, body = outer.handle_debug(self.path)
+                    code, body, ctype = outer.handle_debug(self.path)
                     self.send_response(code)
-                    self.send_header("Content-Type", "text/plain")
+                    self.send_header("Content-Type", ctype)
+                    self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)
                 else:
@@ -645,43 +769,20 @@ class AdmissionServer:
             self._httpd.socket = ctx.wrap_socket(self._httpd.socket, server_side=True)
         self._thread: Optional[threading.Thread] = None
 
-    def handle_debug(self, path: str) -> Tuple[int, bytes]:
-        """Profiling surface (pkg/profiling pprof analogue + the XLA
-        profiler hook, SURVEY §5):
+    def handle_debug(self, path: str) -> Tuple[int, bytes, str]:
+        """Debug introspection surface (pkg/profiling pprof analogue +
+        the XLA profiler hook, SURVEY §5). Shared with the serve
+        control plane's metrics port (cli/serve.py):
 
-        /debug/spans            recent tracer spans (phase breakdown)
-        /debug/xla/start?dir=D  start the JAX/XLA profiler trace
-        /debug/xla/stop         stop it (trace lands in the dir)
+        /debug/traces[?min_ms=N]  recent traces as JSON, filterable by
+                                  total trace duration
+        /debug/state              queue/breaker/compile-cache/faults/
+                                  phase-split snapshot as JSON
+        /debug/spans              recent spans, one line each (legacy)
+        /debug/xla/start?dir=D    start the JAX/XLA profiler trace
+        /debug/xla/stop           stop it (trace lands in the dir)
         """
-        from ..observability.tracing import global_tracer
-
-        if path.startswith("/debug/spans"):
-            lines = []
-            for s in global_tracer.finished()[-200:]:
-                attrs = " ".join(f"{k}={v}" for k, v in s.attributes.items())
-                lines.append(f"{s.name} {s.duration * 1e3:.3f}ms "
-                             f"status={s.status} {attrs}".rstrip())
-            return 200, ("\n".join(lines) + "\n").encode()
-        if path.startswith("/debug/xla/start"):
-            import jax
-
-            out_dir = "/tmp/kyverno-tpu-xla-trace"
-            if "dir=" in path:
-                out_dir = path.split("dir=", 1)[1].split("&")[0]
-            try:
-                jax.profiler.start_trace(out_dir)
-            except Exception as e:
-                return 500, f"profiler start failed: {e}\n".encode()
-            return 200, f"xla trace started -> {out_dir}\n".encode()
-        if path.startswith("/debug/xla/stop"):
-            import jax
-
-            try:
-                jax.profiler.stop_trace()
-            except Exception as e:
-                return 500, f"profiler stop failed: {e}\n".encode()
-            return 200, b"xla trace stopped\n"
-        return 404, b"unknown debug path\n"
+        return handle_debug_path(path, self.handlers)
 
     def reload_cert(self, certfile: str, keyfile: Optional[str] = None) -> None:
         """Hot cert rotation (tls/renewer.go): reloading the chain into

@@ -1,0 +1,138 @@
+#!/bin/bash
+# Observability gate: tier-1 must hold, then a smoke leg drives the
+# serve control plane under concurrent admission load WITH a
+# tpu.dispatch fault armed, hitting /metrics and /debug/state on every
+# iteration — asserting the Prometheus exposition stays parseable
+# under load and that the trace of a scalar-fallback batch records the
+# breaker state that caused it.
+#
+# Usage: ./scripts_obs_check.sh
+set -o pipefail
+cd "$(dirname "$0")"
+rc=0
+
+echo "=== leg 1/2: tier-1 (faults disarmed) ==="
+KYVERNO_TPU_FAULTS= JAX_PLATFORMS=cpu timeout -k 10 870 \
+  python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors \
+  -p no:cacheprovider -p no:xdist -p no:randomly || rc=1
+
+echo "=== leg 2/2: /metrics + /debug/* smoke under load, tpu.dispatch armed ==="
+KYVERNO_TPU_FAULTS="tpu.dispatch:raise:p=1.0" JAX_PLATFORMS=cpu \
+  timeout -k 10 300 python - <<'EOF' || rc=1
+import http.client
+import json
+import re
+import sys
+import threading
+
+from kyverno_tpu.api.policy import ClusterPolicy
+from kyverno_tpu.cli.serve import ControlPlane
+
+POLICY = ClusterPolicy.from_dict({
+    "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+    "metadata": {"name": "obs-smoke"},
+    "spec": {"validationFailureAction": "Enforce", "rules": [{
+        "name": "named",
+        "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+        "validate": {"message": "m",
+                     "pattern": {"metadata": {"name": "?*"}}},
+    }]}})
+
+REVIEW = json.dumps({
+    "apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview",
+    "request": {"uid": "u1", "operation": "CREATE",
+                "object": {"apiVersion": "v1", "kind": "Pod",
+                           "metadata": {"name": "p", "namespace": "d"},
+                           "spec": {"containers": [
+                               {"name": "c", "image": "nginx"}]}}}})
+
+METRIC_LINE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? [0-9.eE+-]+|NaN"
+    r"( # \{[^{}]*\} [0-9.eE+-]+( [0-9.eE+-]+)?)?$")
+
+
+def get(port, path):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    conn.request("GET", path)
+    resp = conn.getresponse()
+    body = resp.read()
+    conn.close()
+    return resp.status, body
+
+
+def post(port, path, body):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    conn.request("POST", path, body,
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    out = resp.read()
+    conn.close()
+    return resp.status, out
+
+
+cp = ControlPlane([POLICY], port=0, metrics_port=0, batching=True)
+cp.start(scan_interval=3600.0)
+adm, met = cp.admission.port, cp.metrics_server.server_address[1]
+failures = []
+try:
+    def worker(n):
+        for _ in range(n):
+            status, out = post(adm, "/validate", REVIEW)
+            if status != 200:
+                failures.append(f"/validate -> {status}")
+                return
+            if "response" not in json.loads(out):
+                failures.append("validate response missing body")
+                return
+
+    threads = [threading.Thread(target=worker, args=(10,))
+               for _ in range(8)]
+    for t in threads:
+        t.start()
+    # scrape WHILE the load runs: exposition must parse mid-flight
+    scrapes = 0
+    while any(t.is_alive() for t in threads):
+        status, body = get(met, "/metrics")
+        assert status == 200, status
+        for line in body.decode().splitlines():
+            if line.startswith("#") or not line:
+                continue
+            assert METRIC_LINE.match(line), f"unparseable: {line!r}"
+        status, body = get(met, "/debug/state")
+        assert status == 200, status
+        json.loads(body)  # must be valid JSON under load
+        scrapes += 1
+    for t in threads:
+        t.join()
+    assert not failures, failures
+    assert scrapes > 0
+
+    # the armed fault forces every device dispatch to fail -> breaker
+    # trips -> batches complete via the scalar ladder; the TRACES must
+    # say so: a scalar_fallback span carrying the breaker state
+    status, body = get(met, "/debug/traces")
+    assert status == 200
+    traces = json.loads(body)["traces"]
+    fallback_spans = [s for t in traces for s in t["spans"]
+                      if s["name"] == "admission.scalar_fallback"]
+    assert fallback_spans, "no scalar_fallback span traced under faults"
+    assert any("breaker" in s["attributes"] for s in fallback_spans), \
+        "fallback span lacks breaker state"
+    state = json.loads(get(met, "/debug/state")[1])
+    assert state["breaker"]["state"] in ("open", "half_open", "closed")
+    assert state["faults_armed"].get("tpu.dispatch", {}).get("fired", 0) > 0
+    text = get(met, "/metrics")[1].decode()
+    assert "kyverno_tpu_breaker_fallback_total" in text
+    print(f"OBS SMOKE OK: {scrapes} live scrapes, "
+          f"{len(fallback_spans)} fallback spans, "
+          f"breaker={state['breaker']['state']}")
+finally:
+    cp.stop()
+EOF
+
+if [ "$rc" -eq 0 ]; then
+  echo "OBS GATE: all legs passed"
+else
+  echo "OBS GATE: FAILURES (see above)"
+fi
+exit $rc

@@ -32,7 +32,7 @@ def test_metrics_exposition():
 
 def test_tracer_spans_nest():
     tr = Tracer()
-    with tr.span("scan", resources=10):
+    with tr.span("scan", resources=10) as scan:
         with tr.span("encode"):
             pass
         with tr.span("dispatch"):
@@ -40,7 +40,11 @@ def test_tracer_spans_nest():
     spans = tr.finished()
     names = [s.name for s in spans]
     assert names == ["encode", "dispatch", "scan"]
-    assert spans[0].parent == "scan"
+    # parentage is by span ID (identity), and children share the
+    # root's 128-bit trace id
+    assert spans[0].parent == scan.span_id
+    assert spans[1].parent == scan.span_id
+    assert spans[0].trace_id == scan.trace_id
     assert spans[2].parent is None
 
 
