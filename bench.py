@@ -23,6 +23,10 @@ Other configs (run `python bench.py <name>`):
              end-to-end including encode + host completions
   admission  config #5: 50k AdmissionReview replay through the
              micro-batching frontend; reports p50/p99 latency
+  churn      steady-state admission throughput + p99 latency while a
+             mutator add/update/deletes policies every 50ms — exercises
+             the lifecycle compile-ahead hot-swap ladder
+             (BENCH_CHURN_SECONDS / _WORKERS / _MUTATE_EVERY_S)
 """
 
 import json
@@ -504,6 +508,138 @@ def bench_admission(n_requests=None, workers=64):
 
 
 # ---------------------------------------------------------------------------
+# policy churn: steady-state admission throughput + p99 while a mutator
+# add/update/deletes policies continuously — the compile-ahead swap
+# ladder must keep the serving path hot (no synchronous recompile
+# stalls), so regressions here are lifecycle regressions
+
+
+def bench_churn(workers=None, duration_s=None):
+    import threading
+
+    import numpy as np
+
+    from kyverno_tpu.api.policy import ClusterPolicy
+    from kyverno_tpu.cluster import PolicyCache
+    from kyverno_tpu.engine.match import RequestInfo
+    from kyverno_tpu.policies import load_pss_policies
+    from kyverno_tpu.serving import BatchConfig
+    from kyverno_tpu.webhooks import build_handlers
+    from kyverno_tpu.webhooks.server import AdmissionPayload
+
+    workers = int(os.environ.get("BENCH_CHURN_WORKERS", "32")) \
+        if workers is None else workers
+    duration_s = float(os.environ.get("BENCH_CHURN_SECONDS", "8")) \
+        if duration_s is None else duration_s
+    mutate_every_s = float(os.environ.get("BENCH_CHURN_MUTATE_EVERY_S",
+                                          "0.05"))
+
+    def churn_policy(i):
+        return ClusterPolicy.from_dict({
+            "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+            "metadata": {"name": "churned"},
+            "spec": {"validationFailureAction": "Enforce", "rules": [{
+                "name": "r",
+                "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+                "validate": {"message": f"v{i}", "pattern": {
+                    "spec": {"containers": [{"=(securityContext)": {
+                        "=(privileged)": "true" if i % 2 else "false"}}]}}},
+            }]}})
+
+    cache = PolicyCache()
+    for p in load_pss_policies():
+        cache.set(p)
+    cache.set(churn_policy(0))
+    handlers = build_handlers(
+        cache, batching=True,
+        batch_config=BatchConfig(max_batch_size=64, max_wait_ms=2.0,
+                                 deadline_ms=30_000.0, eval_grace_s=120.0))
+    handlers.lifecycle.start()
+    pods = make_snapshot(512, seed=13)
+    # wait out the initial compile-ahead (incl. its XLA warm at the
+    # smallest bucket) OUTSIDE the measured window, then prime the
+    # pipeline once so steady-state timing starts from a hot program
+    deadline = time.perf_counter() + 600
+    while handlers.lifecycle.active is None and time.perf_counter() < deadline:
+        time.sleep(0.1)
+    handlers.pipeline.submit(AdmissionPayload(
+        pods[0], "CREATE", RequestInfo(), "default"))
+
+    stop = threading.Event()
+    latencies = []
+    lat_lock = threading.Lock()
+    served = set()
+    errors = [0]
+
+    def worker():
+        rng = random.Random(threading.get_ident())
+        local, local_served, local_errors = [], set(), 0
+        while not stop.is_set():
+            payload = AdmissionPayload(rng.choice(pods), "CREATE",
+                                       RequestInfo(), "default")
+            t0 = time.perf_counter()
+            try:
+                rows = handlers.pipeline.submit(payload)
+            except Exception:  # noqa: BLE001 — counted, not fatal
+                local_errors += 1
+                continue
+            local.append(time.perf_counter() - t0)
+            local_served.add(getattr(rows, "revision", -1))
+        with lat_lock:
+            latencies.extend(local)
+            served.update(local_served)
+            errors[0] += local_errors
+
+    def mutator():
+        i = 0
+        while not stop.is_set():
+            i += 1
+            cache.set(churn_policy(i))
+            stop.wait(mutate_every_s)
+        return i
+
+    threads = [threading.Thread(target=worker) for _ in range(workers)]
+    mut = threading.Thread(target=mutator)
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    mut.start()
+    time.sleep(duration_s)
+    stop.set()
+    for t in threads:
+        t.join()
+    mut.join()
+    wall = time.perf_counter() - t0
+    stats = dict(handlers.pipeline.stats)
+    life = handlers.lifecycle.stats
+    handlers.lifecycle.stop()
+    handlers.pipeline.stop()
+    handlers.batcher.stop()
+    lat = np.array(latencies) if latencies else np.array([0.0])
+    p99_ms = float(np.percentile(lat, 99)) * 1000
+    return {
+        "metric": "churn_p99_latency_ms",
+        "value": round(p99_ms, 2),
+        "unit": "ms",
+        "vs_baseline": round(10_000 / max(p99_ms, 1e-9), 1),
+        "p50_ms": round(float(np.percentile(lat, 50)) * 1000, 2),
+        "requests": len(latencies),
+        "requests_per_sec": round(len(latencies) / wall, 1),
+        "workers": workers,
+        "errors": errors[0],
+        "shed": stats["shed"],
+        "expired": stats["expired"],
+        "cache_revisions": cache.revision,
+        "swaps": life["swaps"],
+        "compile_failures": life["compile_failures"],
+        "revisions_served": len(served),
+        "mean_batch_size": round(
+            stats["evaluated"] / max(sum(
+                stats["flushes_by_bucket"].values()), 1), 1),
+    }
+
+
+# ---------------------------------------------------------------------------
 # forced host-fallback: a host-only rule over a mixed snapshot must cost
 # O(matched cells), not O(policies x resources) — the scalar completion
 # pre-screens with the matcher before building contexts
@@ -632,6 +768,7 @@ FNS = {
     "apply": lambda: bench_apply(),
     "admission": lambda: bench_admission(),
     "fallback": lambda: bench_fallback(),
+    "churn": lambda: bench_churn(),
 }
 
 
@@ -747,7 +884,8 @@ def run_all():
     except Exception as e:  # noqa: BLE001
         out["mixed_corpus_coverage"] = {"error": repr(e)[:300]}
     emit(out)
-    for name in ("match", "overlay", "apply", "admission", "fallback"):
+    for name in ("match", "overlay", "apply", "admission", "fallback",
+                 "churn"):
         if only and name not in only:
             continue
         t0 = time.perf_counter()

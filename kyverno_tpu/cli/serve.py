@@ -61,6 +61,15 @@ def add_parser(sub: argparse._SubParsersAction) -> None:
     p.add_argument("--trace-export", default=None, metavar="PATH",
                    help="append every finished span to PATH as "
                         "newline-delimited OTLP-JSON (offline trace capture)")
+    # live policy churn (lifecycle/): poll DIR for policy file changes
+    # and hot-swap the compiled set via the compile-ahead worker —
+    # serving keeps answering on the last-known-good version throughout
+    p.add_argument("--policy-watch", default=None, metavar="DIR",
+                   help="poll DIR (mtime/hash) for policy YAML changes and "
+                        "hot-reload them through the compile-ahead swap "
+                        "ladder (snapshot -> compile -> atomic swap)")
+    p.add_argument("--reload-interval", type=float, default=2.0,
+                   help="seconds between --policy-watch polls")
     p.set_defaults(func=run)
 
 
@@ -69,7 +78,8 @@ class ControlPlane:
 
     def __init__(self, policies, port=0, metrics_port=0, cert=None, key=None,
                  configuration=None, toggles=None, batching=False,
-                 batch_config=None, request_timeout_s=10.0):
+                 batch_config=None, request_timeout_s=10.0,
+                 policy_watch=None, reload_interval=2.0):
         self.cache = PolicyCache()
         for p in policies:
             self.cache.set(p)
@@ -107,13 +117,47 @@ class ControlPlane:
             configuration=self.configuration, toggles=self.toggles,
             batching=batching, batch_config=batch_config,
             request_timeout_s=request_timeout_s)
+        # policy-set lifecycle: the compile-ahead worker owns recompiles
+        # from here on (started in start()); webhook-config and VAP
+        # reconciliation ride every cache mutation so hot-reloaded
+        # policies also refresh the materialized admission plumbing
+        self.lifecycle = self.handlers.lifecycle
+        self.cache.subscribe(self._on_policy_change)
+        self.watcher = None
+        if policy_watch:
+            from ..lifecycle import PolicyDirWatcher
+
+            self.watcher = PolicyDirWatcher(
+                policy_watch, self.cache, interval_s=reload_interval)
         self.admission = AdmissionServer(
             self.handlers, port=port, certfile=cert, keyfile=key)
         self.metrics_server = _metrics_server(self, metrics_port)
         self._stop = threading.Event()
         self._scan_thread: threading.Thread | None = None
 
+    def _on_policy_change(self, key: str, change: str, revision: int) -> None:
+        # materialized admission plumbing follows every cache mutation:
+        # webhook configurations AND the generated VAP/binding pairs —
+        # a hot-reloaded CEL policy materializes its pair exactly like a
+        # startup policy, and a deleted policy retracts its stale pair
+        try:
+            self.webhook_config.reconcile()
+        except Exception:
+            pass  # materialized config refresh must not block mutation
+        try:
+            if change == "delete":
+                self.vap_generator.on_policy_deleted(key.rpartition("/")[2])
+            else:
+                policy = self.cache.get(key)  # raw, like the startup pass
+                if policy is not None:
+                    self.vap_generator.reconcile(policy)
+        except Exception:
+            pass
+
     def start(self, scan_interval: float = 30.0) -> None:
+        self.lifecycle.start()
+        if self.watcher is not None:
+            self.watcher.start()
         self.admission.start()
         threading.Thread(
             target=self.metrics_server.serve_forever, daemon=True).start()
@@ -123,7 +167,10 @@ class ControlPlane:
 
     def stop(self) -> None:
         self._stop.set()
+        if self.watcher is not None:
+            self.watcher.stop()
         self.admission.stop()
+        self.lifecycle.stop()
         self.metrics_server.shutdown()
         self._cleanup_on_shutdown(self.snapshot, self.lease_store)
 
@@ -233,7 +280,13 @@ def run(args: argparse.Namespace) -> int:
                       cert=args.cert, key=args.key,
                       configuration=configuration, toggles=toggles,
                       batching=args.batching, batch_config=batch_config,
-                      request_timeout_s=args.request_timeout_s)
+                      request_timeout_s=args.request_timeout_s,
+                      policy_watch=args.policy_watch,
+                      reload_interval=args.reload_interval)
+    if args.policy_watch:
+        print(f"policy watch on {args.policy_watch} "
+              f"(every {args.reload_interval}s): changes compile ahead and "
+              f"hot-swap atomically", file=sys.stderr)
     from ..resilience.faults import global_faults
 
     armed = global_faults.armed()

@@ -11,9 +11,10 @@ from __future__ import annotations
 
 import enum
 import threading
-from typing import Dict, List, Optional, Set, Tuple
+from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from ..api.policy import ClusterPolicy
+from ..lifecycle.snapshot import (PolicySetSnapshot, policy_content_hash)
 from ..policy.autogen import expand_policy
 from ..utils import kube
 from ..utils.wildcard import match as wildcard_match
@@ -62,31 +63,74 @@ class PolicyCache:
         self._expanded: Dict[str, ClusterPolicy] = {}
         self._types: Dict[str, PolicyType] = {}
         self._kinds: Dict[str, Set[str]] = {}
+        self._hashes: Dict[str, str] = {}
         self._revision = 0
+        # lifecycle subscribers: called AFTER a mutation commits, with
+        # (key, change, revision). Fired outside the lock — a listener
+        # that re-reads the cache (compile-ahead worker) must not
+        # deadlock or serialize mutators behind its work.
+        self._listeners: List[Callable[[str, str, int], None]] = []
+
+    def subscribe(self, fn: Callable[[str, str, int], None]) -> None:
+        with self._lock:
+            self._listeners.append(fn)
+
+    def _notify(self, key: str, change: str, revision: int) -> None:
+        from ..observability.metrics import global_registry
+
+        global_registry.policy_changes.inc({"type": change})
+        with self._lock:
+            listeners = list(self._listeners)
+        for fn in listeners:
+            try:
+                fn(key, change, revision)
+            except Exception:  # a sick listener must not block mutation
+                pass
 
     def set(self, policy: ClusterPolicy) -> None:
         key = f"{policy.namespace}/{policy.name}" if policy.namespace else policy.name
+        # expansion and hashing are pure and potentially expensive:
+        # compute OUTSIDE the lock, commit every index + the revision
+        # bump under ONE acquisition so a concurrent get_policies /
+        # snapshot can never observe a torn entry (policy present but
+        # types/kinds/hash stale) or a revision that lags its content
         expanded = expand_policy(policy)
+        types = _policy_types(expanded)
+        kinds = _match_kinds(expanded)
+        h = policy_content_hash(policy)
         with self._lock:
+            change = "update" if key in self._policies else "create"
             self._policies[key] = policy
             self._expanded[key] = expanded
-            self._types[key] = _policy_types(expanded)
-            self._kinds[key] = _match_kinds(expanded)
+            self._types[key] = types
+            self._kinds[key] = kinds
+            self._hashes[key] = h
             self._revision += 1
+            revision = self._revision
+        self._notify(key, change, revision)
 
     def unset(self, name: str, namespace: str = "") -> None:
         key = f"{namespace}/{name}" if namespace else name
         with self._lock:
-            if self._policies.pop(key, None) is not None:
-                self._expanded.pop(key, None)
-                self._types.pop(key, None)
-                self._kinds.pop(key, None)
-                self._revision += 1
+            if self._policies.pop(key, None) is None:
+                return
+            self._expanded.pop(key, None)
+            self._types.pop(key, None)
+            self._kinds.pop(key, None)
+            self._hashes.pop(key, None)
+            self._revision += 1
+            revision = self._revision
+        self._notify(key, "delete", revision)
 
     @property
     def revision(self) -> int:
         with self._lock:
             return self._revision
+
+    def get(self, key: str) -> Optional[ClusterPolicy]:
+        """The RAW (un-expanded) policy at a cache key, or None."""
+        with self._lock:
+            return self._policies.get(key)
 
     def get_policies(
         self,
@@ -119,3 +163,16 @@ class PolicyCache:
         """(revision, all expanded policies) — the scan compiler input."""
         with self._lock:
             return self._revision, list(self._expanded.values())
+
+    def policyset_snapshot(self) -> PolicySetSnapshot:
+        """Immutable snapshot (revision, policies, content hashes) for
+        the lifecycle manager. Captured under ONE lock acquisition so
+        revision, policy list, and hashes always describe the same
+        instant — the compile-ahead worker keys its artifact on the
+        combined content hash."""
+        with self._lock:
+            return PolicySetSnapshot(
+                revision=self._revision,
+                policies=tuple(self._expanded.values()),
+                policy_hashes=dict(self._hashes),
+            )

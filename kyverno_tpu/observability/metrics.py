@@ -196,6 +196,22 @@ class MetricsRegistry:
         self.faults_injected = self.counter(
             "kyverno_resilience_faults_injected_total",
             "injected faults fired by site and mode")
+        # policy-set lifecycle (lifecycle/manager.py): the served
+        # compiled revision, hot-swap promotions, compile-ahead
+        # failures, and the quarantine population — a policy churn
+        # problem must be an alert, not a latency mystery
+        self.policyset_revision = self.gauge(
+            "kyverno_policyset_revision",
+            "policy-set revision of the active compiled version")
+        self.policyset_swaps = self.counter(
+            "kyverno_policyset_swaps_total",
+            "compiled policy-set versions promoted (atomic hot swaps)")
+        self.policyset_compile_failures = self.counter(
+            "kyverno_policyset_compile_failures_total",
+            "compile-ahead failures by kind (set-level rollbacks)")
+        self.policyset_quarantined = self.gauge(
+            "kyverno_policyset_quarantined",
+            "policies currently quarantined off the device path")
         # scan_stream phase split (SURVEY §5: encode/device/host costs)
         self.scan_encode_seconds = self.histogram(
             "kyverno_tpu_scan_encode_seconds", "host encode time per scan")

@@ -8,6 +8,8 @@ the failure physically happens:
     context.image_data  the imageRegistry context backend
     gctx.refresh        the GlobalContext external-API poll (entry.py)
     serving.flush       the admission pipeline's batch evaluation
+    policyset.compile   the lifecycle manager's compile-ahead lowering
+                        (full-set compiles AND per-policy bisect probes)
 
 Tests (and the ``KYVERNO_TPU_FAULTS`` env knob) arm a site with a
 probability- or count-based trigger and a mode — ``raise``, ``delay``,
@@ -43,10 +45,11 @@ SITE_CONTEXT_API_CALL = "context.api_call"
 SITE_CONTEXT_IMAGE_DATA = "context.image_data"
 SITE_GCTX_REFRESH = "gctx.refresh"
 SITE_SERVING_FLUSH = "serving.flush"
+SITE_POLICYSET_COMPILE = "policyset.compile"
 
 KNOWN_SITES = frozenset({
     SITE_TPU_DISPATCH, SITE_CONTEXT_API_CALL, SITE_CONTEXT_IMAGE_DATA,
-    SITE_GCTX_REFRESH, SITE_SERVING_FLUSH,
+    SITE_GCTX_REFRESH, SITE_SERVING_FLUSH, SITE_POLICYSET_COMPILE,
 })
 
 MODES = ("raise", "delay", "corrupt")

@@ -76,9 +76,15 @@ class AdmissionPipeline:
         scalar_fallback: Optional[Callable[[Any], Any]] = None,
         config: Optional[BatchConfig] = None,
         metrics: Optional[MetricsRegistry] = None,
+        version_provider: Optional[Callable[[], Any]] = None,
     ) -> None:
         self._fn = evaluate_fn
         self._scalar = scalar_fallback
+        # policy-set version pinning (lifecycle/): with a provider, the
+        # flusher captures ONE compiled version per flush and hands it
+        # to evaluate_fn(padded, version) — a hot swap landing mid-queue
+        # affects the NEXT flush; no batch ever mixes revisions
+        self._version_provider = version_provider
         self.config = config or BatchConfig()
         self.metrics = metrics or global_registry
         self.queue = AdmissionQueue(self.config.high_water)
@@ -267,6 +273,20 @@ class AdmissionPipeline:
         self.metrics.serving_batch_size.observe(len(live))
         self.metrics.serving_batch_occupancy.observe(len(live) / bucket)
         padded = [req.payload for req in live] + [None] * (bucket - len(live))
+        # pin the compiled policy-set version for this WHOLE flush
+        # before evaluation: every request drained into this batch
+        # evaluates against exactly this version, even if a hot swap
+        # promotes a newer one while the batch is on the device
+        pin = None
+        if self._version_provider is not None:
+            try:
+                pin = self._version_provider()
+            except BaseException:
+                pin = None  # evaluator owns the unavailability ladder
+        pin_rev = getattr(pin, "revision", None)
+        if pin_rev is not None:
+            with self._stats_lock:
+                self.stats["last_flush_revision"] = pin_rev
         t_eval0 = time.monotonic()
         set_dispatch_path(PATH_DEVICE)  # evaluator overwrites on fallback
         try:
@@ -277,7 +297,8 @@ class AdmissionPipeline:
             from ..resilience.faults import SITE_SERVING_FLUSH, global_faults
 
             global_faults.fire(SITE_SERVING_FLUSH)
-            results = self._fn(padded)
+            results = (self._fn(padded) if self._version_provider is None
+                       else self._fn(padded, pin))
             if len(results) < len(live):
                 raise RuntimeError("batch evaluator returned wrong arity")
         except BaseException as e:  # propagate to every waiter
@@ -285,7 +306,8 @@ class AdmissionPipeline:
             for req in live:
                 req.resolve(e)
             self._record_flush_spans(live, reason, bucket, now, t_eval0,
-                                     t_eval1, error=f"{type(e).__name__}: {e}")
+                                     t_eval1, error=f"{type(e).__name__}: {e}",
+                                     revision=pin_rev)
             return
         t_eval1 = time.monotonic()
         t_resolve0 = time.monotonic()
@@ -296,7 +318,8 @@ class AdmissionPipeline:
         # AFTER every waiter is woken: the spans carry explicit
         # timestamps, so ordering costs nothing — doing it first would
         # tax every request's latency with tracing overhead
-        self._record_flush_spans(live, reason, bucket, now, t_eval0, t_eval1)
+        self._record_flush_spans(live, reason, bucket, now, t_eval0, t_eval1,
+                                 revision=pin_rev)
         for req in live:
             if req.trace_ctx is not None:
                 global_tracer.record_span(
@@ -306,7 +329,8 @@ class AdmissionPipeline:
     def _record_flush_spans(self, live: List[QueuedRequest], reason: str,
                             bucket: int, drained_at: float,
                             t_eval0: float, t_eval1: float,
-                            error: Optional[str] = None) -> None:
+                            error: Optional[str] = None,
+                            revision: Optional[int] = None) -> None:
         """Per-request flush + dispatch spans: the batch evaluation is
         shared work, but each request's trace must tell the whole story,
         so the shared timings are recorded once per participating trace
@@ -318,12 +342,14 @@ class AdmissionPipeline:
         traced = [r for r in live if r.trace_ctx is not None]
         if not traced:
             return
+        rev_attr = {} if revision is None else {"policyset_revision": revision}
         if error is not None:
             for req in traced:
                 global_tracer.record_span(
                     "admission.flush", req.drained_at or drained_at, t_eval1,
                     parent=req.trace_ctx, status="error", reason=reason,
-                    batch_size=len(live), bucket=bucket, error=error)
+                    batch_size=len(live), bucket=bucket, error=error,
+                    **rev_attr)
             return
         path = last_dispatch_path()
         dispatch_name = ("admission.device_dispatch" if path == PATH_DEVICE
@@ -338,7 +364,7 @@ class AdmissionPipeline:
             global_tracer.record_span(
                 "admission.flush", req.drained_at or drained_at, t_eval1,
                 parent=req.trace_ctx, reason=reason, batch_size=len(live),
-                bucket=bucket)
+                bucket=bucket, **rev_attr)
             global_tracer.record_span(
                 dispatch_name, t_eval0, t_eval1, parent=req.trace_ctx,
                 engine=path, breaker=breaker_state, batch_size=len(live))

@@ -62,6 +62,10 @@ class CompiledPolicySet:
     # global host-resolved operand slots (per-request context values
     # feeding the device program as canonical lanes)
     dyn_slots: List[DynSlot] = field(default_factory=list)
+    # lifecycle quarantine: policy indices excluded from lowering
+    # (their rules are host-fallback RuleEntries tagged "quarantined:"),
+    # with the compile error that put them there
+    quarantined: Dict[int, str] = field(default_factory=dict)
     _fn: Optional[Callable] = field(default=None, repr=False)
 
     @property
@@ -101,13 +105,22 @@ def compile_policy_set(
     encode_cfg: Optional[EncodeConfig] = None,
     meta_cfg: Optional[MetaConfig] = None,
     data_sources=None,
+    quarantine: Optional[Dict[int, str]] = None,
 ) -> CompiledPolicySet:
+    """``quarantine`` maps policy indices the lifecycle manager has
+    quarantined (their last compile CRASHED, not merely Unsupported) to
+    the error string; their rules skip lowering entirely and become
+    host-fallback entries, so the rest of the set still runs on the
+    device while the quarantined policy degrades to the scalar oracle
+    (per-rule ERROR when even the oracle cannot evaluate it)."""
     from ..observability.profiling import PHASE_COMPILE, global_profiler
     from ..observability.tracing import global_tracer
 
     with global_profiler.phase(PHASE_COMPILE), \
-            global_tracer.span("policy_set_compile", policies=len(policies)):
-        return _compile_policy_set(policies, encode_cfg, meta_cfg, data_sources)
+            global_tracer.span("policy_set_compile", policies=len(policies),
+                               quarantined=len(quarantine or ())):
+        return _compile_policy_set(policies, encode_cfg, meta_cfg,
+                                   data_sources, quarantine)
 
 
 def _compile_policy_set(
@@ -115,9 +128,11 @@ def _compile_policy_set(
     encode_cfg: Optional[EncodeConfig] = None,
     meta_cfg: Optional[MetaConfig] = None,
     data_sources=None,
+    quarantine: Optional[Dict[int, str]] = None,
 ) -> CompiledPolicySet:
     encode_cfg = encode_cfg or EncodeConfig()
     meta_cfg = meta_cfg or MetaConfig()
+    quarantine = dict(quarantine or {})
     entries: List[RuleEntry] = []
     programs: List[RuleProgram] = []
     byte_paths: Set[int] = set()
@@ -125,8 +140,13 @@ def _compile_policy_set(
     deps: Dict[str, Optional[str]] = {}
     dyn_slots: List[DynSlot] = []
     for pi, policy in enumerate(policies):
+        q_err = quarantine.get(pi)
         for rule in policy.get_rules():
             if not rule.has_validate():
+                continue
+            if q_err is not None:
+                entries.append(RuleEntry(pi, policy.name, rule.name, None,
+                                         f"quarantined: {q_err}"))
                 continue
             try:
                 prog = compile_rule(policy, rule, data_sources, deps)
@@ -170,4 +190,5 @@ def _compile_policy_set(
         meta_cfg=meta_cfg,
         context_deps=deps,
         dyn_slots=dyn_slots,
+        quarantined=quarantine,
     )

@@ -609,31 +609,38 @@ class TpuEngine:
 
         from ..engine.match import matches_resource_description
 
-        cache: Dict[Tuple[int, int], Dict[str, int]] = {}
+        cache: Dict[Tuple[int, int], Optional[Dict[str, int]]] = {}
         with global_profiler.phase(PHASE_HOST_COMPLETE):
             for (pi, ci) in host_cells:
                 policy = self.cps.policies[pi]
                 res = resources[ci]
-                kind = res.get("kind", "")
-                ns = (res.get("metadata") or {}).get("namespace", "")
-                nsl = ns_labels.get((res.get("metadata") or {}).get("name", "") if kind == "Namespace" else ns, {})
-                op = (operations[ci] if operations else "") or ""
-                info = admission_infos[ci] if admission_infos else None
-                # pre-screen with the (cheap) matcher before paying for
-                # context construction + full validation: in a realistic
-                # mix most host (policy, resource) cells are simply not
-                # matched (kind/selector mismatch), making the fallback
-                # cost scale with MATCHED cells, not policies x resources
-                if not any(
-                        not matches_resource_description(
-                            res, rule, info, nsl,
-                            policy_namespace=policy.namespace,
-                            operation=op or "CREATE")
-                        for rule in policy.get_rules() if rule.has_validate()):
-                    cache[(pi, ci)] = {}  # every rule NOT_MATCHED
-                    continue
-                pctx = build_scan_context(policy, res, nsl, op, info)
-                cache[(pi, ci)] = _scalar_rule_verdicts(self.scalar, policy, pctx)
+                try:
+                    kind = res.get("kind", "")
+                    ns = (res.get("metadata") or {}).get("namespace", "")
+                    nsl = ns_labels.get((res.get("metadata") or {}).get("name", "") if kind == "Namespace" else ns, {})
+                    op = (operations[ci] if operations else "") or ""
+                    info = admission_infos[ci] if admission_infos else None
+                    # pre-screen with the (cheap) matcher before paying for
+                    # context construction + full validation: in a realistic
+                    # mix most host (policy, resource) cells are simply not
+                    # matched (kind/selector mismatch), making the fallback
+                    # cost scale with MATCHED cells, not policies x resources
+                    if not any(
+                            not matches_resource_description(
+                                res, rule, info, nsl,
+                                policy_namespace=policy.namespace,
+                                operation=op or "CREATE")
+                            for rule in policy.get_rules() if rule.has_validate()):
+                        cache[(pi, ci)] = {}  # every rule NOT_MATCHED
+                        continue
+                    pctx = build_scan_context(policy, res, nsl, op, info)
+                    cache[(pi, ci)] = _scalar_rule_verdicts(self.scalar, policy, pctx)
+                except Exception:
+                    # the scalar oracle itself choked on this (policy,
+                    # resource) — a quarantined policy whose pattern is
+                    # genuinely broken lands here. The cell reports
+                    # per-rule ERROR; the rest of the batch is untouched.
+                    cache[(pi, ci)] = None
         for ri, entry in enumerate(self.cps.rules):
             for (pi, ci), verdicts in cache.items():
                 if pi != entry.policy_idx:
@@ -642,7 +649,8 @@ class TpuEngine:
                         or total[ri, ci] == HOST):
                     # pre-screened cells carry no verdict rows: the
                     # whole policy was unmatched (HOST must not escape)
-                    total[ri, ci] = verdicts.get(entry.rule_name, NOT_MATCHED)
+                    total[ri, ci] = ERROR if verdicts is None \
+                        else verdicts.get(entry.rule_name, NOT_MATCHED)
 
         return ScanResult(
             verdicts=total,

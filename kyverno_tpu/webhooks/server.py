@@ -22,6 +22,8 @@ import time
 from ..api.policy import ClusterPolicy
 from ..cluster.policycache import PolicyCache, PolicyType
 from ..config import Configuration, Toggles
+from ..lifecycle import (PolicySetLifecycleManager, PolicySetUnavailable,
+                         PolicySetVersion)
 from ..observability.metrics import MetricsRegistry, global_registry
 from ..cluster.reports import ReportAggregator, ReportResult
 from ..cluster.snapshot import ClusterSnapshot, resource_uid
@@ -34,6 +36,21 @@ from ..tpu.engine import (TpuEngine, VERDICT_NAMES, _scalar_rule_verdicts,
 from ..tpu.evaluator import ERROR, FAIL, NOT_MATCHED
 from ..utils.jsonpatch import diff as jsonpatch_diff
 from .batcher import MicroBatcher
+
+
+class VerdictRows(list):
+    """Per-request verdict rows [((policy, rule), code)] tagged with
+    the compiled policy-set version that produced them. The tag is how
+    batch pinning becomes ASSERTABLE: a churn test can check each
+    response against the scalar oracle evaluated at the exact revision
+    that served it, and validate() derives its Enforce set from the
+    same version instead of racing the live cache."""
+
+    def __init__(self, rows, version: Optional[PolicySetVersion] = None,
+                 revision: int = -1):
+        super().__init__(rows)
+        self.version = version
+        self.revision = version.revision if version is not None else revision
 
 
 class AdmissionPayload:
@@ -84,9 +101,16 @@ class Handlers:
         # deadline so an overrun resolves per failurePolicy, not a 500
         self.request_timeout_s = request_timeout_s
         self.scalar = ScalarEngine(exceptions=self.exceptions)
-        self._engines: Dict[int, TpuEngine] = {}
         self._rbac_needed: Dict[int, bool] = {}  # per cache revision
         self._lock = threading.Lock()
+        # policy-set lifecycle: every cache mutation snapshots +
+        # compiles ahead off the request path; serving acquires the
+        # last-known-good compiled version (lifecycle/manager.py). The
+        # worker thread is started by the control plane (serve) — with
+        # it stopped, stale revisions compile synchronously, preserving
+        # the classic compile-on-demand behavior for CLI and tests.
+        self.lifecycle = PolicySetLifecycleManager(
+            cache, compile_fn=self._compile_version, metrics=self.metrics)
         self.batcher = MicroBatcher(self._evaluate_batch, max_batch, max_wait_ms)
         # --batching: the serving pipeline replaces the plain batcher on
         # the validate path — shape-bucketed padding, deadline-aware
@@ -103,19 +127,43 @@ class Handlers:
                 self._evaluate_padded,
                 scalar_fallback=self._scalar_verdict_rows,
                 config=cfg,
-                metrics=self.metrics)
+                metrics=self.metrics,
+                version_provider=self._pin_version)
 
-    # -- engine cache keyed by policy revision (compile-cache churn control)
+    # -- versioned engine acquisition (lifecycle/manager.py)
+
+    def _compile_version(self, policies, quarantine) -> TpuEngine:
+        from ..tpu.compiler import compile_policy_set
+
+        cps = compile_policy_set(policies, quarantine=quarantine)
+        eng = TpuEngine(cps=cps, exceptions=self.exceptions)
+        # with the compile-ahead worker running, "ahead" includes the
+        # XLA build at the smallest shape bucket: one warm scan here
+        # means the first post-swap flush dispatches a ready program
+        # instead of paying the jit on the request path. Bisect PROBE
+        # compiles skip it — those engines are thrown away, and a jit
+        # per probe would dominate the bisect cost.
+        lifecycle = getattr(self, "lifecycle", None)
+        if (lifecycle is not None and lifecycle.worker_running
+                and not lifecycle.probing and cps.device_programs):
+            try:
+                eng.scan([{}])
+            except Exception:
+                pass  # warmup is best-effort; dispatch has its own ladder
+        return eng
+
+    def _pin_version(self) -> Optional[PolicySetVersion]:
+        """Flush-time pin for the serving pipeline: None when no
+        compiled version exists yet (the evaluator then degrades to the
+        pure scalar ladder instead of failing the batch)."""
+        try:
+            return self.lifecycle.acquire()
+        except PolicySetUnavailable:
+            return None
 
     def _engine(self) -> Tuple[int, TpuEngine]:
-        rev, policies = self.cache.snapshot()
-        with self._lock:
-            eng = self._engines.get(rev)
-            if eng is None:
-                eng = TpuEngine(policies, exceptions=self.exceptions)
-                self._engines.clear()  # single live revision
-                self._engines[rev] = eng
-        return rev, eng
+        ver = self.lifecycle.acquire()
+        return ver.revision, ver.engine
 
     def _need_roles(self) -> bool:
         """Binding resolution is O(snapshot) — skip it unless some
@@ -131,36 +179,78 @@ class Handlers:
                 self._rbac_needed[rev] = need
         return need
 
-    def _scalar_verdict_rows(self, payload: AdmissionPayload):
+    def _scalar_verdict_rows(self, payload: AdmissionPayload,
+                             version: Optional[PolicySetVersion] = None):
         """One request through the scalar oracle, emitted in the same
         compiled-rule row order as the batch path (the shed/degradation
-        path must be bit-identical to the batched one)."""
-        _, eng = self._engine()
+        path must be bit-identical to the batched one). With no compiled
+        version available at all (initial compile still failing), the
+        rows come straight from the live cache policies — the deepest
+        rung of the ladder still answers."""
+        if version is None:
+            try:
+                version = self.lifecycle.acquire()
+            except PolicySetUnavailable:
+                return self._pure_scalar_rows(payload)
+        eng = version.engine
         res = payload.old if (payload.operation == "DELETE" and payload.old) \
             else payload.resource
         ns_labels = self.snapshot.namespace_labels() if self.snapshot else {}
-        per_policy: Dict[int, Dict[str, int]] = {}
+        per_policy: Dict[int, Optional[Dict[str, int]]] = {}
         rows = []
         for entry in eng.cps.rules:
-            verdicts = per_policy.get(entry.policy_idx)
-            if verdicts is None:
+            if entry.policy_idx not in per_policy:
                 policy = eng.cps.policies[entry.policy_idx]
+                try:
+                    pctx = build_scan_context(
+                        policy, res, ns_labels.get(payload.namespace, {}),
+                        payload.operation, payload.info)
+                    per_policy[entry.policy_idx] = _scalar_rule_verdicts(
+                        self.scalar, policy, pctx)
+                except Exception:
+                    # oracle choked on this policy (quarantined-and-
+                    # broken): per-rule ERROR, never a lost request
+                    per_policy[entry.policy_idx] = None
+            verdicts = per_policy[entry.policy_idx]
+            rows.append(((entry.policy_name, entry.rule_name),
+                         ERROR if verdicts is None
+                         else verdicts.get(entry.rule_name, NOT_MATCHED)))
+        return VerdictRows(rows, version=version)
+
+    def _pure_scalar_rows(self, payload: AdmissionPayload):
+        """No compiled artifact exists: evaluate the live cache's
+        policies on the scalar engine, rows in the same (policy order,
+        validate-rule order) layout the compiler would emit."""
+        rev, policies = self.cache.snapshot()
+        res = payload.old if (payload.operation == "DELETE" and payload.old) \
+            else payload.resource
+        ns_labels = self.snapshot.namespace_labels() if self.snapshot else {}
+        rows = []
+        for policy in policies:
+            try:
                 pctx = build_scan_context(
                     policy, res, ns_labels.get(payload.namespace, {}),
                     payload.operation, payload.info)
                 verdicts = _scalar_rule_verdicts(self.scalar, policy, pctx)
-                per_policy[entry.policy_idx] = verdicts
-            rows.append(((entry.policy_name, entry.rule_name),
-                         verdicts.get(entry.rule_name, NOT_MATCHED)))
-        return rows
+            except Exception:
+                verdicts = None
+            for rule in policy.get_rules():
+                if not rule.has_validate():
+                    continue
+                rows.append(((policy.name, rule.name),
+                             ERROR if verdicts is None
+                             else verdicts.get(rule.name, NOT_MATCHED)))
+        return VerdictRows(rows, revision=rev)
 
     def _evaluate_batch(self, payloads: List[AdmissionPayload]):
         # unpadded MicroBatcher path: same evaluator as the serving
         # pipeline (zero pad slots), so batched and non-batched verdict
-        # computation cannot drift
+        # computation cannot drift. The single _engine() acquisition
+        # below pins one compiled version for this flush too.
         return self._evaluate_padded(payloads)
 
-    def _evaluate_padded(self, payloads: List[Optional[AdmissionPayload]]):
+    def _evaluate_padded(self, payloads: List[Optional[AdmissionPayload]],
+                         pinned: Optional[PolicySetVersion] = None):
         """Batch evaluator shared by the MicroBatcher (no pad slots) and
         the serving pipeline, whose batches arrive padded with trailing
         None up to their shape bucket; pad slots encode as empty
@@ -168,23 +258,40 @@ class Handlers:
         (compile-cached) shape. HOST-flagged cells inside eng.scan
         complete via the scalar engine — a request the device path can't
         cover degrades to the host oracle instead of failing the whole
-        batch."""
+        batch. ``pinned`` is the policy-set version the flusher captured
+        for this flush (serving/batcher.py): the whole batch evaluates
+        against exactly that version, never a mid-swap mix."""
         pad = AdmissionPayload({}, "", RequestInfo(), "")
         real_n = sum(1 for p in payloads if p is not None)
         filled = [p if p is not None else pad for p in payloads]
         t0 = time.perf_counter()
-        if self.toggles.engine == "scalar":
-            # toggle-gated host path (pkg/toggle analogue): same verdict
-            # table, computed by the scalar oracle per (policy, resource)
+        if pinned is None:
+            # ONE acquire for the whole flush, before ANY branch: the
+            # scalar-toggle path must pin exactly like the device path,
+            # or requests in one batch could straddle a hot swap
+            try:
+                pinned = self.lifecycle.acquire()
+            except PolicySetUnavailable:
+                pinned = None  # pure scalar ladder below
+        if self.toggles.engine == "scalar" or pinned is None:
+            # toggle-gated host path (pkg/toggle analogue), and the
+            # deepest rung (no compiled artifact at all): the same
+            # verdict table, computed by the scalar oracle per
+            # (policy, resource) — against the pinned version when one
+            # exists, else the live cache revision
             from ..observability.profiling import (PATH_SCALAR_FALLBACK,
                                                    set_dispatch_path)
 
             set_dispatch_path(PATH_SCALAR_FALLBACK)
-            out = [self._scalar_verdict_rows(p) for p in filled[:real_n]]
+            if pinned is None:
+                out = [self._pure_scalar_rows(p) for p in filled[:real_n]]
+            else:
+                out = [self._scalar_verdict_rows(p, version=pinned)
+                       for p in filled[:real_n]]
             self.metrics.device_dispatch.observe(time.perf_counter() - t0,
                                                  {"engine": "scalar"})
             return out
-        _, eng = self._engine()
+        eng = pinned.engine
         resources = [
             p.old if (p.operation == "DELETE" and p.old) else p.resource
             for p in filled
@@ -199,7 +306,8 @@ class Handlers:
         self.metrics.device_dispatch.observe(time.perf_counter() - t0,
                                              {"engine": "tpu"})
         self.metrics.batch_size.observe(real_n)
-        return [resource_verdicts(result, ci) for ci in range(real_n)]
+        return [VerdictRows(resource_verdicts(result, ci), version=pinned)
+                for ci in range(real_n)]
 
     # -- health / introspection
 
@@ -224,6 +332,17 @@ class Handlers:
             compiled = False
         breaker = tpu_breaker()
         detail["breaker"] = breaker.state
+        # lifecycle surface: the ACTIVE compiled revision (what traffic
+        # is really served with — may trail the cache revision while a
+        # compile-ahead runs) and the quarantine list. A stale-but-
+        # compiled set is still ready; quarantine is visible, not fatal.
+        ls = self.lifecycle.state()
+        detail["policyset"] = {
+            "active_revision": ls["active_revision"],
+            "cache_revision": ls["cache_revision"],
+            "quarantined": [q["policy"] for q in ls["quarantined"]],
+            "compile_breaker": ls["compile_breaker"],
+        }
         ok = compiled and breaker.state != "open"
         detail["ready"] = ok
         return ok, detail
@@ -238,19 +357,20 @@ class Handlers:
         from ..resilience.faults import global_faults
 
         breaker = tpu_breaker()
-        with self._lock:
-            compile_cache = [{
-                "revision": rev,
-                "device_rules": eng.coverage()[0],
-                "total_rules": eng.coverage()[1],
-                "dyn_slots": len(eng.cps.dyn_slots),
-                "jit_built": eng.cps._fn is not None,
-                "policies": [p.name for p in eng.cps.policies],
-            } for rev, eng in self._engines.items()]
+        active = self.lifecycle.active
+        compile_cache = [] if active is None else [{
+            "revision": active.revision,
+            "device_rules": active.engine.coverage()[0],
+            "total_rules": active.engine.coverage()[1],
+            "dyn_slots": len(active.engine.cps.dyn_slots),
+            "jit_built": active.engine.cps._fn is not None,
+            "policies": [p.name for p in active.engine.cps.policies],
+        }]
         state: Dict[str, Any] = {
             "engine_toggle": self.toggles.engine,
             "breaker": {"name": breaker.name, "state": breaker.state},
             "compile_cache": compile_cache,
+            "policyset": self.lifecycle.state(),
             "faults_armed": {
                 site: {"mode": spec.mode, "calls": spec.calls,
                        "fired": spec.fired}
@@ -263,25 +383,30 @@ class Handlers:
 
     # -- public handlers
 
-    def _lookup_policy(self, policy_key):
+    def _lookup_policy(self, policy_key, policies=None):
         """Fine-grained URL param -> policy (handlers.go:206-219): a
         missing policy is an evaluation error, not a silent allow."""
         ns, name = policy_key
-        _, policies = self.cache.snapshot()
+        if policies is None:
+            _, policies = self.cache.snapshot()
         for p in policies:
             if p.name == name and (not ns or getattr(p, "namespace", "") == ns):
                 return p
         raise KeyError(f"key {ns}/{name}: policy not found")
 
-    def _class_filter(self, failure_policy: str, policy_key):
+    def _class_filter(self, failure_policy: str, policy_key, policies=None):
         """handlers.go:244 filterPolicies: the /fail and /ignore webhook
         paths each evaluate only their failurePolicy class; the bare
         path ("all") evaluates everything. Fine-grained paths scope to
         the one named policy (also class-filtered). Returns the set of
-        evaluable policy names, or None for no filtering."""
+        evaluable policy names, or None for no filtering. ``policies``
+        scopes the filter to a pinned version's set (validate recomputes
+        it from the SERVED version so the filter and the verdict rows
+        can never straddle two revisions under churn)."""
         if failure_policy not in ("fail", "ignore") and policy_key is None:
             return None
-        _, policies = self.cache.snapshot()
+        if policies is None:
+            _, policies = self.cache.snapshot()
         names = set()
         for p in policies:
             cls = "ignore" if (p.spec.failure_policy or "Fail") == "Ignore" \
@@ -290,7 +415,7 @@ class Handlers:
                 continue
             names.add(p.name)
         if policy_key is not None:
-            scoped = self._lookup_policy(policy_key)  # raises KeyError
+            scoped = self._lookup_policy(policy_key, policies)  # raises KeyError
             # verdict rows are keyed by bare policy name; refuse the
             # fine-grained route when that name is ambiguous rather
             # than leak another policy's verdicts into the decision
@@ -359,6 +484,18 @@ class Handlers:
         except Exception as e:
             return _response(req, self._fail_open(failure_policy),
                              f"evaluation error: {e}")
+        served = getattr(verdicts, "version", None)
+        if served is not None:
+            # recompute the class filter from the SERVED version: the
+            # pre-submit value fast-failed missing fine-grained routes,
+            # but the filter applied to the rows must describe the same
+            # revision that produced them, not the live cache
+            try:
+                evaluable = self._class_filter(failure_policy, policy_key,
+                                               policies=served.policies)
+            except KeyError as e:
+                return _response(req, self._fail_open(failure_policy),
+                                 f"evaluation error: {e}")
         if evaluable is not None:
             # the batch evaluates the full compiled program (one device
             # dispatch for every concurrent request); rows outside this
@@ -366,9 +503,16 @@ class Handlers:
             # the decision and reports only reflect the routed policies
             verdicts = [(pr, code) for pr, code in verdicts
                         if pr[0] in evaluable]
-        _, eng = self._engine()
+        # the Enforce set comes from the SAME policy-set version that
+        # produced the verdict rows (VerdictRows.version) — reading the
+        # live cache here would mix revisions when a hot swap lands
+        # between the flush and this decision
+        if served is not None:
+            decision_policies = served.policies
+        else:
+            _, decision_policies = self.cache.snapshot()
         enforce = {
-            p.name for p in eng.cps.policies
+            p.name for p in decision_policies
             if (p.spec.validation_failure_action or "Audit").lower().startswith("enforce")
         }
         # DELETE requests carry the object in oldObject (object is null)

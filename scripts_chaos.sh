@@ -12,21 +12,27 @@ set -o pipefail
 cd "$(dirname "$0")"
 rc=0
 
-echo "=== leg 1/3: tier-1 (faults disarmed) ==="
+echo "=== leg 1/4: tier-1 (faults disarmed) ==="
 KYVERNO_TPU_FAULTS= JAX_PLATFORMS=cpu timeout -k 10 870 \
   python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors \
   -p no:cacheprovider -p no:xdist -p no:randomly || rc=1
 
-echo "=== leg 2/3: slow chaos + resilience suites (tests arm faults) ==="
+echo "=== leg 2/4: slow chaos + resilience suites (tests arm faults) ==="
 KYVERNO_TPU_FAULTS= JAX_PLATFORMS=cpu timeout -k 10 870 \
   python -m pytest tests/test_chaos_load.py tests/test_resilience.py \
   tests/test_serving_load.py -q -p no:cacheprovider || rc=1
 
-echo "=== leg 3/3: serving suite under ambient env-armed faults ==="
+echo "=== leg 3/4: serving suite under ambient env-armed faults ==="
 KYVERNO_TPU_FAULTS="${AMBIENT_FAULTS:-tpu.dispatch:raise:p=0.3,seed=7}" \
   JAX_PLATFORMS=cpu timeout -k 10 870 \
   python -m pytest tests/test_serving.py tests/test_resilience.py -q \
   -p no:cacheprovider || rc=1
+
+echo "=== leg 4/4: policy churn — 64-thread load + 50ms mutator ==="
+# zero dropped requests, batch-pinned revisions, verdicts bit-identical
+# to the scalar oracle at the revision that served them
+KYVERNO_TPU_FAULTS= JAX_PLATFORMS=cpu timeout -k 10 870 \
+  python -m pytest tests/test_policy_churn.py -q -p no:cacheprovider || rc=1
 
 if [ "$rc" -eq 0 ]; then
   echo "CHAOS GATE: all legs passed"

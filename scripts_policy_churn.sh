@@ -1,0 +1,151 @@
+#!/bin/bash
+# Policy-churn gate: tier-1 must hold, then the churn chaos leg (64
+# threads + a 50ms mutator, zero drops + batch-pinned revisions +
+# oracle-exact verdicts), then an end-to-end smoke driving the serve
+# control plane with --policy-watch semantics: policy files change on
+# disk, the compile-ahead worker hot-swaps the compiled set, and
+# /debug/state + /metrics must report the revision movement, swap
+# counters, and (under an armed policyset.compile fault) the
+# compile-failure rollback.
+#
+# Usage: ./scripts_policy_churn.sh
+set -o pipefail
+cd "$(dirname "$0")"
+rc=0
+
+echo "=== leg 1/3: tier-1 (faults disarmed) ==="
+KYVERNO_TPU_FAULTS= JAX_PLATFORMS=cpu timeout -k 10 870 \
+  python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors \
+  -p no:cacheprovider -p no:xdist -p no:randomly || rc=1
+
+echo "=== leg 2/3: churn chaos (64-thread load + 50ms mutator) ==="
+KYVERNO_TPU_FAULTS= JAX_PLATFORMS=cpu timeout -k 10 870 \
+  python -m pytest tests/test_policy_churn.py tests/test_lifecycle.py -q \
+  -p no:cacheprovider || rc=1
+
+echo "=== leg 3/3: --policy-watch smoke: hot swap + rollback on /debug/state ==="
+KYVERNO_TPU_FAULTS= JAX_PLATFORMS=cpu timeout -k 10 300 python - <<'EOF' || rc=1
+import http.client
+import json
+import os
+import tempfile
+import time
+
+from kyverno_tpu.cli.serve import ControlPlane, _load_policies
+from kyverno_tpu.resilience.faults import global_faults
+
+POLICY = """\
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: watched
+spec:
+  validationFailureAction: Enforce
+  rules:
+  - name: r
+    match:
+      any:
+      - resources:
+          kinds: [Pod]
+    validate:
+      message: %s
+      pattern:
+        spec:
+          containers:
+          - "=(securityContext)":
+              "=(privileged)": "%s"
+"""
+
+
+def get(port, path):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    conn.request("GET", path)
+    resp = conn.getresponse()
+    body = resp.read()
+    conn.close()
+    return resp.status, body
+
+
+watch = tempfile.mkdtemp(prefix="kyverno-policy-watch-")
+with open(os.path.join(watch, "p.yaml"), "w") as f:
+    f.write(POLICY % ("v1", "false"))
+
+cp = ControlPlane(_load_policies([watch]), port=0, metrics_port=0,
+                  batching=True, policy_watch=watch, reload_interval=0.1)
+cp.start(scan_interval=3600.0)
+met = cp.metrics_server.server_address[1]
+try:
+    # the initial compile (+ XLA warm) runs in the worker: poll until
+    # the first version is promoted
+    deadline = time.monotonic() + 60
+    ps = None
+    while time.monotonic() < deadline:
+        status, body = get(met, "/debug/state")
+        assert status == 200, status
+        ps = json.loads(body)["policyset"]
+        if ps["active_revision"] is not None:
+            break
+        time.sleep(0.05)
+    rev0 = ps["active_revision"]
+    assert rev0 is not None and ps["worker_running"], ps
+
+    # mutate the watched file -> compile-ahead -> atomic swap
+    time.sleep(0.02)
+    with open(os.path.join(watch, "p.yaml"), "w") as f:
+        f.write(POLICY % ("v2", "true"))
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        ps = json.loads(get(met, "/debug/state")[1])["policyset"]
+        if ps["active_revision"] and ps["active_revision"] > rev0:
+            break
+        time.sleep(0.05)
+    assert ps["active_revision"] > rev0, ps
+    assert ps["active_revision"] == ps["cache_revision"], ps
+    text = get(met, "/metrics")[1].decode()
+    assert "kyverno_policyset_swaps_total" in text
+    assert f'kyverno_policyset_revision {ps["active_revision"]}' in text
+    status, body = get(met, "/readyz")
+    assert status == 200, (status, body)
+    ready = json.loads(body)
+    assert ready["policyset"]["active_revision"] == ps["active_revision"]
+
+    # arm the compile fault: the next change must ROLL BACK (serve the
+    # prior revision) and report the failure, then heal on disarm
+    served_before = ps["active_revision"]
+    global_faults.arm("policyset.compile", mode="raise", p=1.0)
+    time.sleep(0.02)
+    with open(os.path.join(watch, "p.yaml"), "w") as f:
+        f.write(POLICY % ("v3", "false"))
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        ps = json.loads(get(met, "/debug/state")[1])["policyset"]
+        if ps.get("last_compile_error"):
+            break
+        time.sleep(0.05)
+    assert ps.get("last_compile_error"), ps
+    assert ps["active_revision"] == served_before, ps  # rollback held
+    assert "kyverno_policyset_compile_failures_total" in \
+        get(met, "/metrics")[1].decode()
+
+    global_faults.disarm("policyset.compile")
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        ps = json.loads(get(met, "/debug/state")[1])["policyset"]
+        if ps["active_revision"] == ps["cache_revision"] \
+                and not ps.get("last_compile_error"):
+            break
+        time.sleep(0.05)
+    assert ps["active_revision"] == ps["cache_revision"], ps
+    print(f"POLICY WATCH SMOKE OK: rev {rev0} -> {ps['active_revision']}, "
+          f"swaps={ps['stats']['swaps']}, "
+          f"rollbacks={ps['stats']['rollbacks']}")
+finally:
+    cp.stop()
+EOF
+
+if [ "$rc" -eq 0 ]; then
+  echo "POLICY CHURN GATE: all legs passed"
+else
+  echo "POLICY CHURN GATE: FAILURES (see above)"
+fi
+exit $rc

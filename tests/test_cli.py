@@ -77,7 +77,8 @@ def test_serve_batching_help(capsys):
     assert exc.value.code == 0
     out = capsys.readouterr().out
     for flag in ("--batching", "--max-batch-size", "--max-wait-ms",
-                 "--deadline-ms", "--queue-high-water", "--shed-mode"):
+                 "--deadline-ms", "--queue-high-water", "--shed-mode",
+                 "--policy-watch", "--reload-interval"):
         assert flag in out
 
 

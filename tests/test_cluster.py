@@ -226,3 +226,64 @@ def test_configmap_context_folds_to_device_and_invalidates():
     assert svc.aggregator.summary()["fail"] == 1
     res = [r for _, r, _ in snap.items() if r.get("kind") == "Pod"]
     assert len(res) == 2
+
+
+def test_policy_cache_concurrent_mutation_never_tears_a_snapshot():
+    """Revision races (lifecycle satellite): set/unset commit every
+    index + the revision bump under one lock acquisition, so concurrent
+    readers can never observe a torn set — two snapshots at the same
+    revision must be identical, revisions are monotonic per reader, and
+    get_policies mid-swap always returns a coherent list."""
+    import threading
+
+    cache = PolicyCache()
+    cache.set(make_policy("base", "Enforce"))
+    N_MUT = 200
+    stop = threading.Event()
+    errors = []
+
+    def mutator():
+        try:
+            for i in range(N_MUT):
+                action = "Enforce" if i % 2 == 0 else "Audit"
+                cache.set(make_policy(f"churn-{i % 4}", action))
+                if i % 5 == 4:
+                    cache.unset(f"churn-{i % 4}")
+        except Exception as e:  # noqa: BLE001
+            errors.append(repr(e))
+        finally:
+            stop.set()
+
+    def reader():
+        last_rev = -1
+        try:
+            while not stop.is_set():
+                s1 = cache.policyset_snapshot()
+                s2 = cache.policyset_snapshot()
+                assert s1.revision >= last_rev, "revision went backwards"
+                last_rev = s1.revision
+                if s1.revision == s2.revision:
+                    assert s1.keys() == s2.keys()
+                    assert s1.content_hash == s2.content_hash
+                # hash map and policy tuple captured under ONE lock:
+                # they must describe the same policy set
+                assert set(s1.policy_hashes) == set(s1.keys())
+                pols = cache.get_policies(PolicyType.VALIDATE_ENFORCE,
+                                          kind="Pod")
+                for p in pols:  # never a half-registered entry
+                    assert p.name
+                rev, listed = cache.snapshot()
+                assert len(listed) == len(set(pp.name for pp in listed))
+        except Exception as e:  # noqa: BLE001
+            errors.append(repr(e))
+
+    threads = [threading.Thread(target=reader) for _ in range(4)]
+    m = threading.Thread(target=mutator)
+    for t in threads:
+        t.start()
+    m.start()
+    m.join(timeout=60)
+    for t in threads:
+        t.join(timeout=60)
+    assert not errors, errors
+    assert cache.revision >= N_MUT

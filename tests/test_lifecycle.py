@@ -1,0 +1,465 @@
+"""Policy-set lifecycle: versioned snapshots, compile-ahead hot swap,
+per-policy quarantine, rollback, and the --policy-watch directory
+reconciler. Fast tier — chaos under concurrent load lives in
+test_policy_churn.py (slow)."""
+
+import os
+import time
+
+import pytest
+
+from kyverno_tpu.api.policy import ClusterPolicy
+from kyverno_tpu.cluster import PolicyCache
+from kyverno_tpu.lifecycle import (PolicyDirWatcher,
+                                   PolicySetLifecycleManager,
+                                   PolicySetSnapshot, PolicySetUnavailable,
+                                   policy_content_hash, policy_key)
+from kyverno_tpu.observability.metrics import global_registry
+from kyverno_tpu.resilience.faults import global_faults
+from kyverno_tpu.resilience.retry import RetryPolicy
+from kyverno_tpu.tpu.compiler import compile_policy_set
+from kyverno_tpu.tpu.engine import TpuEngine
+from kyverno_tpu.tpu.evaluator import ERROR, FAIL
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    global_faults.disarm()
+    yield
+    global_faults.disarm()
+
+
+def _pol_dict(name, priv="false", boom=False):
+    return {
+        "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+        "metadata": {"name": name,
+                     **({"annotations": {"boom": "true"}} if boom else {})},
+        "spec": {"validationFailureAction": "Enforce", "rules": [{
+            "name": "r",
+            "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+            "validate": {"message": "m", "pattern": {"spec": {"containers": [
+                {"=(securityContext)": {"=(privileged)": priv}}]}}},
+        }]},
+    }
+
+
+def _pol(name, priv="false", boom=False):
+    return ClusterPolicy.from_dict(_pol_dict(name, priv, boom))
+
+
+def _pod(name="p", priv=True):
+    return {"apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": name, "namespace": "default"},
+            "spec": {"containers": [{"name": "c", "image": "nginx",
+                                     "securityContext": {"privileged": priv}}]}}
+
+
+def _fast_retry():
+    return RetryPolicy(base_delay_s=0.02, max_delay_s=0.05, jitter=0.0,
+                       deadline_s=None)
+
+
+# ---------------------------------------------------------------------------
+# snapshots
+
+
+def test_snapshot_content_hash_is_order_insensitive_and_content_keyed():
+    a, b = _pol("a"), _pol("b")
+    c1 = PolicySetSnapshot(1, (a, b), {"a": policy_content_hash(a),
+                                       "b": policy_content_hash(b)})
+    c2 = PolicySetSnapshot(9, (b, a), {"b": policy_content_hash(b),
+                                       "a": policy_content_hash(a)})
+    assert c1.content_hash == c2.content_hash  # same content, any order
+    b2 = _pol("b", priv="true")
+    c3 = PolicySetSnapshot(2, (a, b2), {"a": policy_content_hash(a),
+                                        "b": policy_content_hash(b2)})
+    assert c3.content_hash != c1.content_hash  # content moved
+
+
+def test_cache_policyset_snapshot_atomic_and_hashed():
+    cache = PolicyCache()
+    cache.set(_pol("a"))
+    s1 = cache.policyset_snapshot()
+    assert s1.revision == 1 and s1.keys() == ("a",)
+    cache.set(_pol("a"))  # idempotent re-apply: same content hash
+    s2 = cache.policyset_snapshot()
+    assert s2.revision == 2
+    assert s2.content_hash == s1.content_hash
+    cache.set(_pol("a", priv="true"))
+    assert cache.policyset_snapshot().content_hash != s1.content_hash
+
+
+def test_cache_subscribe_fires_after_commit_with_revision():
+    cache = PolicyCache()
+    seen = []
+    cache.subscribe(lambda key, change, rev: seen.append((key, change, rev)))
+    cache.set(_pol("a"))
+    cache.set(_pol("a", priv="true"))
+    cache.unset("a")
+    cache.unset("a")  # no-op: no event
+    assert seen == [("a", "create", 1), ("a", "update", 2), ("a", "delete", 3)]
+
+
+# ---------------------------------------------------------------------------
+# compile-ahead swap
+
+
+def test_compile_ahead_worker_swaps_atomically_and_pins_old_version():
+    cache = PolicyCache()
+    cache.set(_pol("a"))
+    mgr = PolicySetLifecycleManager(cache, retry_policy=_fast_retry())
+    mgr.start()
+    try:
+        v1 = mgr.acquire()
+        assert v1.revision == 1
+        swaps0 = mgr.stats["swaps"]
+        cache.set(_pol("b", priv="true"))
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and mgr.active.revision != 2:
+            time.sleep(0.02)
+        v2 = mgr.acquire()
+        assert v2.revision == 2 and v2 is not v1
+        assert mgr.stats["swaps"] == swaps0 + 1
+        # the OLD version object is immutable and still evaluates — an
+        # in-flight batch that pinned it finishes on it
+        res = v1.engine.scan([_pod()])
+        assert {pn for pn, _ in res.rules} == {"a"}
+        assert (v2.snapshot.policy_hashes.keys()) == {"a", "b"}
+        text = global_registry.exposition()
+        assert "kyverno_policyset_revision 2" in text
+    finally:
+        mgr.stop()
+
+
+def test_sync_mode_compiles_on_demand_like_classic_path():
+    cache = PolicyCache()
+    cache.set(_pol("a"))
+    mgr = PolicySetLifecycleManager(cache, retry_policy=_fast_retry())
+    assert mgr.acquire().revision == 1
+    cache.set(_pol("b"))
+    assert mgr.acquire().revision == 2  # no worker: stale compiles now
+    # unchanged content at a bumped revision reuses the artifact
+    v = mgr.acquire()
+    cache.set(_pol("b"))  # no content movement
+    assert mgr.acquire().engine is v.engine
+
+
+# ---------------------------------------------------------------------------
+# quarantine: a policy whose lowering CRASHES is bisected out, the rest
+# of the set still runs on the device, and healing the policy exits
+
+
+def _boom_compile_fn(policies, quarantine):
+    """Simulates a lowering crash (non-Unsupported) for any policy
+    annotated boom=true that is not already quarantined."""
+    for i, p in enumerate(policies):
+        if i not in quarantine and p.annotations.get("boom") == "true":
+            raise RuntimeError("lowering crashed: boom")
+    return TpuEngine(cps=compile_policy_set(policies, quarantine=quarantine))
+
+
+def test_compile_failure_quarantines_offender_rest_stays_on_device():
+    cache = PolicyCache()
+    cache.set(_pol("good"))
+    cache.set(_pol("bad", boom=True))
+    mgr = PolicySetLifecycleManager(cache, compile_fn=_boom_compile_fn,
+                                    retry_policy=_fast_retry())
+    v = mgr.acquire()
+    assert v.quarantined == ("bad",)
+    # quarantined rules are host-fallback entries tagged as such; the
+    # good policy still lowered to the device
+    q_rows = [e for e in v.engine.cps.rules if e.policy_name == "bad"]
+    assert q_rows and all(e.device_row is None and
+                          e.fallback_reason.startswith("quarantined:")
+                          for e in q_rows)
+    good_dev = [e for e in v.engine.cps.rules
+                if e.policy_name == "good" and e.device_row is not None]
+    assert good_dev, "the healthy policy must stay on the device path"
+    # the scalar oracle answers for the quarantined policy: verdicts
+    # stay bit-identical (the policy is valid, only its lowering crashed)
+    res = v.engine.scan([_pod(priv=True)])
+    by_rule = {rn: int(c) for (pn, rn), c in
+               zip(res.rules, res.verdicts[:, 0]) if pn == "bad"}
+    assert by_rule["r"] == FAIL
+    # observability: gauge + debug list
+    assert global_registry.policyset_quarantined._values[()] == 1.0
+    assert mgr.state()["quarantined"][0]["policy"] == "bad"
+
+    # healing the policy exits quarantine automatically
+    cache.set(_pol("bad", boom=False))
+    v2 = mgr.acquire()
+    assert v2.quarantined == ()
+    assert all(e.device_row is not None for e in v2.engine.cps.rules
+               if e.policy_name == "bad" and e.rule_name == "r")
+    assert global_registry.policyset_quarantined._values[()] == 0.0
+
+
+def test_quarantined_policy_scalar_crash_yields_per_rule_error():
+    """When even the scalar oracle cannot evaluate the quarantined
+    policy (a genuinely broken pattern), its rules report ERROR — the
+    batch never aborts and the rest of the set still answers."""
+    cache = PolicyCache()
+    cache.set(_pol("good"))
+    cache.set(_pol("bad", boom=True))
+    mgr = PolicySetLifecycleManager(cache, compile_fn=_boom_compile_fn,
+                                    retry_policy=_fast_retry())
+    v = mgr.acquire()
+    assert v.quarantined == ("bad",)
+
+    # break the scalar oracle for the bad policy only
+    orig = v.engine.scalar.validate
+
+    def crashing_validate(pctx):
+        if pctx.policy.name == "bad":
+            raise RuntimeError("oracle cannot evaluate this either")
+        return orig(pctx)
+
+    v.engine.scalar.validate = crashing_validate
+    res = v.engine.scan([_pod(priv=True)])
+    codes = {(pn, rn): int(c) for (pn, rn), c in
+             zip(res.rules, res.verdicts[:, 0])}
+    assert codes[("bad", "r")] == ERROR
+    assert codes[("good", "r")] == FAIL  # rest of the set unaffected
+
+
+def test_deleting_quarantined_policy_clears_quarantine():
+    cache = PolicyCache()
+    cache.set(_pol("good"))
+    cache.set(_pol("bad", boom=True))
+    mgr = PolicySetLifecycleManager(cache, compile_fn=_boom_compile_fn,
+                                    retry_policy=_fast_retry())
+    assert mgr.acquire().quarantined == ("bad",)
+    cache.unset("bad")
+    v = mgr.acquire()
+    assert v.quarantined == ()
+    assert {pn for pn, _ in ((e.policy_name, e.rule_name)
+                             for e in v.engine.cps.rules)} == {"good"}
+
+
+# ---------------------------------------------------------------------------
+# set-level failure: rollback to the prior version + capped retry
+
+
+def test_set_level_compile_failure_rolls_back_and_recovers():
+    cache = PolicyCache()
+    cache.set(_pol("a"))
+    cache.set(_pol("b"))
+    mgr = PolicySetLifecycleManager(cache, retry_policy=_fast_retry())
+    v1 = mgr.acquire()
+    global_faults.arm("policyset.compile", mode="raise", p=1.0)
+    cache.set(_pol("c"))
+    v = mgr.acquire()
+    # rollback = serving stays on the last-known-good version
+    assert v.revision == v1.revision
+    assert mgr.stats["rollbacks"] >= 1
+    assert mgr.state()["last_compile_error"]
+    # an infrastructure failure (every bisect probe fails) must NOT
+    # quarantine the whole set
+    assert mgr.state()["quarantined"] == []
+    global_faults.disarm("policyset.compile")
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        time.sleep(0.03)
+        if mgr.acquire().revision == cache.revision:
+            break
+    assert mgr.acquire().revision == cache.revision
+    assert mgr.state().get("last_compile_error") is None
+
+
+def test_initial_compile_failure_raises_unavailable_then_heals():
+    cache = PolicyCache()
+    cache.set(_pol("a"))
+    global_faults.arm("policyset.compile", mode="raise", p=1.0)
+    mgr = PolicySetLifecycleManager(cache, retry_policy=_fast_retry())
+    with pytest.raises(PolicySetUnavailable):
+        mgr.acquire()
+    global_faults.disarm("policyset.compile")
+    deadline = time.monotonic() + 10
+    v = None
+    while time.monotonic() < deadline:
+        time.sleep(0.03)
+        try:
+            v = mgr.acquire()
+            break
+        except PolicySetUnavailable:
+            continue
+    assert v is not None and v.revision == cache.revision
+
+
+# ---------------------------------------------------------------------------
+# webhook integration: no compiled set -> pure scalar ladder still answers
+
+
+def test_handlers_degrade_to_pure_scalar_when_nothing_compiled():
+    from kyverno_tpu.webhooks import build_handlers
+
+    cache = PolicyCache()
+    cache.set(_pol("a"))
+    handlers = build_handlers(cache)
+    global_faults.arm("policyset.compile", mode="raise", p=1.0)
+    # fresh manager state: force it to have no active version
+    handlers.lifecycle._active = None
+    handlers.lifecycle._synced_revision = -1
+    review = {"apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview",
+              "request": {"uid": "u", "operation": "CREATE",
+                          "namespace": "default", "object": _pod(priv=True)}}
+    out = handlers.validate(review)
+    # privileged pod denied by the Enforce policy — decided WITHOUT any
+    # compiled artifact, on the deepest rung of the ladder
+    assert out["response"]["allowed"] is False
+    handlers.batcher.stop()
+    ok, detail = handlers.ready()
+    assert ok is False and "compile_error" in detail
+
+
+# ---------------------------------------------------------------------------
+# --policy-watch directory reconciler
+
+
+def _write(path, *docs):
+    import yaml
+
+    with open(path, "w") as f:
+        yaml.safe_dump_all(list(docs), f)
+
+
+def test_policy_dir_watcher_add_update_delete_and_malformed(tmp_path):
+    cache = PolicyCache()
+    w = PolicyDirWatcher(str(tmp_path), cache, interval_s=0.01)
+    _write(tmp_path / "a.yaml", _pol_dict("a"))
+    assert w.sync_once() is True
+    assert cache.policyset_snapshot().keys() == ("a",)
+    rev = cache.revision
+
+    # unchanged file: no mutation, no revision burn
+    assert w.sync_once() is False
+    assert cache.revision == rev
+
+    # update content -> one revision
+    time.sleep(0.01)
+    _write(tmp_path / "a.yaml", _pol_dict("a", priv="true"))
+    assert w.sync_once() is True
+    assert cache.revision == rev + 1
+
+    # second file with two policies
+    _write(tmp_path / "b.yaml", _pol_dict("b"), _pol_dict("c"))
+    assert w.sync_once() is True
+    assert set(cache.policyset_snapshot().keys()) == {"a", "b", "c"}
+
+    # malformed file: skipped, nothing unloaded, error surfaced
+    (tmp_path / "bad.yaml").write_text("{unbalanced: [")
+    assert w.sync_once() is False
+    assert set(cache.policyset_snapshot().keys()) == {"a", "b", "c"}
+    assert "bad.yaml" in " ".join(w.state()["parse_errors"])
+
+    # policy removed from a file unloads; file removal unloads the rest
+    time.sleep(0.01)
+    _write(tmp_path / "b.yaml", _pol_dict("b"))
+    assert w.sync_once() is True
+    assert set(cache.policyset_snapshot().keys()) == {"a", "b"}
+    os.unlink(tmp_path / "a.yaml")
+    assert w.sync_once() is True
+    assert set(cache.policyset_snapshot().keys()) == {"b"}
+
+
+def test_watcher_thread_drives_lifecycle_swap(tmp_path):
+    cache = PolicyCache()
+    mgr = PolicySetLifecycleManager(cache, retry_policy=_fast_retry())
+    _write(tmp_path / "a.yaml", _pol_dict("a"))
+    w = PolicyDirWatcher(str(tmp_path), cache, interval_s=0.02)
+    mgr.start()
+    w.start()
+    try:
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and mgr.active is None:
+            time.sleep(0.02)
+        assert mgr.active is not None
+        rev1 = mgr.active.revision
+        time.sleep(0.01)
+        _write(tmp_path / "a.yaml", _pol_dict("a", priv="true"))
+        while time.monotonic() < deadline and (
+                mgr.active is None or mgr.active.revision == rev1):
+            time.sleep(0.02)
+        assert mgr.active.revision > rev1
+    finally:
+        w.stop()
+        mgr.stop()
+
+
+def test_watcher_cross_file_move_never_transiently_unloads(tmp_path):
+    """A policy moving from one watched file to another in the SAME
+    poll must not be unset-then-set: ownership updates for every file
+    before any unload decision."""
+    cache = PolicyCache()
+    unloads = []
+    cache.subscribe(lambda key, change, rev:
+                    unloads.append(key) if change == "delete" else None)
+    w = PolicyDirWatcher(str(tmp_path), cache, interval_s=0.01)
+    _write(tmp_path / "a.yaml", _pol_dict("moved"), _pol_dict("stays"))
+    assert w.sync_once() is True
+    time.sleep(0.01)
+    # move "moved" from a.yaml (sorted first) to z.yaml (sorted last)
+    _write(tmp_path / "a.yaml", _pol_dict("stays"))
+    _write(tmp_path / "z.yaml", _pol_dict("moved"))
+    assert w.sync_once() is False  # ownership moved; no cache mutation
+    assert unloads == []
+    assert set(cache.policyset_snapshot().keys()) == {"moved", "stays"}
+
+
+def test_control_plane_reconciles_vap_and_webhook_config_on_churn():
+    """Hot-reloaded policies refresh the materialized admission
+    plumbing: a CEL-eligible policy materializes its VAP/binding pair,
+    and deleting it retracts the pair (cli/serve.py cache listener)."""
+    from kyverno_tpu.cli.serve import ControlPlane
+
+    cel = ClusterPolicy.from_dict({
+        "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+        "metadata": {"name": "cel-live", "uid": "u-cel"},
+        "spec": {"validationFailureAction": "Enforce", "rules": [{
+            "name": "require-team",
+            "match": {"any": [{"resources": {
+                "kinds": ["Pod"], "operations": ["CREATE"]}}]},
+            "validate": {"cel": {"expressions": [{
+                "expression": "has(object.metadata.labels)",
+                "message": "labels required"}]}},
+        }]}})
+
+    def vaps():
+        return [r for _uid, r, _h in cp.snapshot.items()
+                if r.get("kind") == "ValidatingAdmissionPolicy"]
+
+    cp = ControlPlane([_pol("boot")], port=0, metrics_port=0)
+    try:
+        assert not vaps()
+        cp.cache.set(cel)  # hot add, no restart
+        assert any(v for v in vaps()), "VAP pair not materialized on churn"
+        cp.cache.unset("cel-live")
+        assert not vaps(), "stale VAP pair left after policy delete"
+    finally:
+        cp.metrics_server.server_close()
+        cp.lifecycle.stop()
+
+
+def test_reverted_mutation_clears_set_failure_state_without_compile():
+    """If the cache content heals BACK to the active version (the bad
+    mutation is reverted) the recorded set-level failure must clear
+    without a compile — no stale last_compile_error, no pending retry
+    schedule busy-waking the worker."""
+    cache = PolicyCache()
+    cache.set(_pol("a"))
+    mgr = PolicySetLifecycleManager(cache, retry_policy=RetryPolicy(
+        base_delay_s=30.0, max_delay_s=30.0, jitter=0.0, deadline_s=None))
+    v1 = mgr.acquire()
+    global_faults.arm("policyset.compile", mode="raise", p=1.0)
+    cache.set(_pol("b"))
+    assert mgr.acquire().revision == v1.revision  # rollback held
+    assert mgr.state()["last_compile_error"]
+    assert mgr._retry_due() is False  # 30s backoff pending
+    global_faults.disarm("policyset.compile")
+    cache.unset("b")  # revert: content now matches the active version
+    v = mgr.acquire()
+    assert v.snapshot.content_hash == cache.policyset_snapshot().content_hash
+    st = mgr.state()
+    assert "last_compile_error" not in st
+    assert "set_retry_in_s" not in st
+    assert mgr._retry_due() is False

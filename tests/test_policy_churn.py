@@ -1,0 +1,203 @@
+"""Policy-churn chaos (slow tier): 64-thread admission load while a
+mutator thread adds/updates/deletes policies every 50 ms.
+
+Asserts the lifecycle contract end to end:
+- zero dropped requests (no sheds, no deadline expiries, every submit
+  answered);
+- no batch ever evaluates a mixed-revision policy set — every response
+  carries the batch-pinned compiled version, whose snapshot content
+  hash must equal the cache's recorded content hash AT that revision;
+- every verdict is bit-identical to the scalar oracle evaluated at the
+  revision that served it;
+- after the churn settles, serving catches up to the final revision.
+"""
+
+import threading
+import time
+
+import pytest
+
+from kyverno_tpu.api.policy import ClusterPolicy
+from kyverno_tpu.cluster import PolicyCache
+from kyverno_tpu.engine.engine import Engine as ScalarEngine
+from kyverno_tpu.engine.match import RequestInfo
+from kyverno_tpu.observability.metrics import global_registry
+from kyverno_tpu.resilience import global_faults, tpu_breaker
+from kyverno_tpu.serving import BatchConfig
+from kyverno_tpu.tpu.engine import (_scalar_rule_verdicts,
+                                    build_scan_context)
+from kyverno_tpu.tpu.evaluator import NOT_MATCHED
+from kyverno_tpu.webhooks import build_handlers
+from kyverno_tpu.webhooks.server import AdmissionPayload
+
+pytestmark = pytest.mark.slow
+
+N_THREADS = 64
+REQUESTS_PER_THREAD = 4
+N_MUTATIONS = 40
+MUTATE_EVERY_S = 0.05
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    global_faults.disarm()
+    tpu_breaker().reset()
+    yield
+    global_faults.disarm()
+    tpu_breaker().reset()
+
+
+def _pol(name, priv="false", msg="m"):
+    return ClusterPolicy.from_dict({
+        "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+        "metadata": {"name": name},
+        "spec": {"validationFailureAction": "Enforce", "rules": [{
+            "name": "r",
+            "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+            "validate": {"message": msg, "pattern": {"spec": {"containers": [
+                {"=(securityContext)": {"=(privileged)": priv}}]}}},
+        }]}})
+
+
+def _pod(i):
+    return {"apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": f"p{i}", "namespace": "default"},
+            "spec": {"containers": [{
+                "name": "c", "image": "nginx",
+                "securityContext": {"privileged": i % 2 == 0}}]}}
+
+
+def _oracle_rows(version, resource):
+    """Scalar-oracle verdicts for one resource at EXACTLY the policy
+    set the version was compiled from (the revision that served it)."""
+    scalar = ScalarEngine()
+    out = {}
+    for entry in version.engine.cps.rules:
+        policy = version.engine.cps.policies[entry.policy_idx]
+        pctx = build_scan_context(policy, resource, {}, "CREATE",
+                                  RequestInfo())
+        verdicts = _scalar_rule_verdicts(scalar, policy, pctx)
+        out[(entry.policy_name, entry.rule_name)] = verdicts.get(
+            entry.rule_name, NOT_MATCHED)
+    return out
+
+
+def test_policy_churn_under_load_zero_drops_pinned_revisions_exact_verdicts():
+    cache = PolicyCache()
+    cache.set(_pol("stable"))
+    handlers = build_handlers(
+        cache, batching=True,
+        batch_config=BatchConfig(max_batch_size=16, max_wait_ms=2.0,
+                                 deadline_ms=30_000.0))
+    # single-mutator revlog: content hash of the cache at EVERY
+    # revision, recorded synchronously inside the mutation commit path
+    revlog = {}
+    revlog_lock = threading.Lock()
+
+    def record(_key, _change, _rev):
+        snap = cache.policyset_snapshot()
+        with revlog_lock:
+            revlog[snap.revision] = snap.content_hash
+
+    snap0 = cache.policyset_snapshot()
+    revlog[snap0.revision] = snap0.content_hash
+    cache.subscribe(record)
+    handlers.lifecycle.start()
+    pods = [_pod(i) for i in range(8)]
+    responses = []
+    res_lock = threading.Lock()
+    failures = []
+    start_barrier = threading.Barrier(N_THREADS + 1)
+
+    def worker(tid):
+        start_barrier.wait()
+        local = []
+        for i in range(REQUESTS_PER_THREAD):
+            pod = pods[(tid + i) % len(pods)]
+            try:
+                rows = handlers.pipeline.submit(AdmissionPayload(
+                    pod, "CREATE", RequestInfo(), "default"))
+                local.append((pod, rows))
+            except Exception as e:  # noqa: BLE001 — a drop is a failure
+                failures.append(f"t{tid}/{i}: {type(e).__name__}: {e}")
+                return
+            time.sleep(0.02)  # spread requests across the churn window
+        with res_lock:
+            responses.extend(local)
+
+    def mutator():
+        start_barrier.wait()
+        for i in range(N_MUTATIONS):
+            step = i % 4
+            if step == 0:
+                cache.set(_pol("churn", priv="true", msg=f"v{i}"))
+            elif step == 1:
+                cache.set(_pol("extra", msg=f"v{i}"))
+            elif step == 2:
+                cache.set(_pol("churn", priv="false", msg=f"v{i}"))
+            else:
+                cache.unset("extra")
+            time.sleep(MUTATE_EVERY_S)
+
+    threads = [threading.Thread(target=worker, args=(t,))
+               for t in range(N_THREADS)]
+    mut = threading.Thread(target=mutator)
+    for t in threads:
+        t.start()
+    mut.start()
+    mut.join(timeout=120)
+    for t in threads:
+        t.join(timeout=120)
+
+    try:
+        stats = dict(handlers.pipeline.stats)
+        # 1) zero dropped requests
+        assert not failures, failures[:5]
+        assert len(responses) == N_THREADS * REQUESTS_PER_THREAD
+        assert stats["shed"] == 0 and stats["expired"] == 0
+
+        served_revisions = set()
+        oracle_cache = {}
+        for pod, rows in responses:
+            ver = rows.version
+            # 2) every batch was pinned to one immutable compiled
+            # version whose snapshot matches what the cache actually
+            # contained at that revision — no torn/mixed set possible
+            assert ver is not None, "response served without a pinned version"
+            assert rows.revision == ver.snapshot.revision
+            assert revlog.get(rows.revision) == ver.snapshot.content_hash, (
+                f"revision {rows.revision} served content "
+                f"{ver.snapshot.content_hash}, cache recorded "
+                f"{revlog.get(rows.revision)}")
+            served_revisions.add(rows.revision)
+            # 3) bit-identical to the scalar oracle at THAT revision
+            key = (rows.revision, pod["metadata"]["name"])
+            if key not in oracle_cache:
+                oracle_cache[key] = _oracle_rows(ver, pod)
+            got = {pr: code for pr, code in rows}
+            assert got == oracle_cache[key], (
+                f"verdict drift at revision {rows.revision} "
+                f"for {pod['metadata']['name']}")
+
+        # churn really happened and swaps landed while serving
+        assert cache.revision >= N_MUTATIONS
+        assert handlers.lifecycle.stats["swaps"] >= 1
+        assert "kyverno_policyset_swaps_total" in global_registry.exposition()
+
+        # 4) the set settles: serving catches up to the final revision
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            final = handlers.pipeline.submit(AdmissionPayload(
+                pods[0], "CREATE", RequestInfo(), "default"))
+            if final.version.snapshot.content_hash \
+                    == cache.policyset_snapshot().content_hash:
+                break
+            time.sleep(0.1)
+        assert final.version.snapshot.content_hash \
+            == cache.policyset_snapshot().content_hash
+        assert {pr[0] for pr, _ in final} \
+            == {p.name for p in cache.snapshot()[1]}
+    finally:
+        handlers.lifecycle.stop()
+        handlers.pipeline.stop()
+        handlers.batcher.stop()
