@@ -27,6 +27,19 @@ Other configs (run `python bench.py <name>`):
              mutator add/update/deletes policies every 50ms — exercises
              the lifecycle compile-ahead hot-swap ladder
              (BENCH_CHURN_SECONDS / _WORKERS / _MUTATE_EVERY_S)
+  cached     content-addressed verdict/encode cache comparison: the
+             same snapshot scanned uncached, cache-cold (inserting),
+             and cache-warm (serving columns from the LRU); records
+             the hit rate and speedup (BENCH_CACHED_RESOURCES)
+
+The driver also measures the persistent XLA compilation cache
+(tpu/cache.py enable_xla_compile_cache): a cold-vs-warm compile of the
+PSS device program in throwaway subprocesses, recorded as
+``xla_compile`` in the artifact. The backend probe pre-warms the same
+program THROUGH that cache, so a probe that once burned its whole
+timeout on cold XLA compilation warm-starts in seconds on the next
+run — and a probe that dies compiling is reported as
+``compile_timeout``, distinct from ``backend_unavailable``.
 """
 
 import json
@@ -640,6 +653,76 @@ def bench_churn(workers=None, duration_s=None):
 
 
 # ---------------------------------------------------------------------------
+# content-addressed caches: repeat-scan of an unchanged snapshot must
+# serve verdict columns from the LRU instead of re-encoding and
+# re-dispatching — the "mostly-unchanged cluster" amortization lever
+
+
+def bench_cached(n_resources=None, tile=1024):
+    import numpy as np
+
+    from kyverno_tpu.observability.metrics import global_registry as reg
+    from kyverno_tpu.policies import load_pss_policies
+    from kyverno_tpu.policy.autogen import expand_policy
+    from kyverno_tpu.tpu.cache import global_encode_cache as ec
+    from kyverno_tpu.tpu.cache import global_verdict_cache as vc
+    from kyverno_tpu.tpu.engine import TpuEngine
+
+    if n_resources is None:
+        n_resources = int(os.environ.get("BENCH_CACHED_RESOURCES", "5000"))
+    policies = [expand_policy(p) for p in load_pss_policies()]
+    eng = TpuEngine(policies)
+    resources = make_snapshot(n_resources, seed=21)
+    tiles = [resources[i:i + tile] for i in range(0, n_resources, tile)]
+
+    def sweep():
+        return [eng.scan(t) for t in tiles]
+
+    v_cap, e_cap = vc._lru.capacity, ec._lru.capacity
+    try:
+        vc.set_capacity(0)
+        ec.set_capacity(0)
+        eng.scan(tiles[0])  # pay the per-shape XLA build outside timing
+        t0 = time.perf_counter()
+        base = sweep()
+        t_uncached = time.perf_counter() - t0
+        vc.set_capacity(max(v_cap, n_resources + 64))
+        ec.set_capacity(max(e_cap, n_resources + 64))
+        vc.clear()
+        ec.clear()
+        t0 = time.perf_counter()
+        cold = sweep()  # misses + inserts: the caching overhead leg
+        t_cold = time.perf_counter() - t0
+        h0 = reg.verdict_cache.value({"outcome": "hit"})
+        m0 = reg.verdict_cache.value({"outcome": "miss"})
+        t0 = time.perf_counter()
+        warm = sweep()  # content-identical repeat: columns from the LRU
+        t_warm = time.perf_counter() - t0
+        hits = reg.verdict_cache.value({"outcome": "hit"}) - h0
+        misses = reg.verdict_cache.value({"outcome": "miss"}) - m0
+    finally:
+        vc.set_capacity(v_cap)
+        ec.set_capacity(e_cap)
+    for a, b in zip(base, warm):
+        assert np.array_equal(a.verdicts, b.verdicts), \
+            "cached verdicts diverged from uncached"
+    hit_rate = hits / max(hits + misses, 1)
+    return {
+        "metric": "cached_rescan_speedup",
+        "value": round(t_uncached / max(t_warm, 1e-9), 2),
+        "unit": "x",
+        "vs_baseline": round(t_uncached / max(t_warm, 1e-9), 2),
+        "resources": n_resources,
+        "uncached_seconds": round(t_uncached, 3),
+        "cache_cold_seconds": round(t_cold, 3),
+        "cache_warm_seconds": round(t_warm, 3),
+        "verdict_cache_hit_rate": round(hit_rate, 4),
+        "warm_resources_per_sec": round(n_resources / max(t_warm, 1e-9), 1),
+        "bit_identical": True,
+    }
+
+
+# ---------------------------------------------------------------------------
 # forced host-fallback: a host-only rule over a mixed snapshot must cost
 # O(matched cells), not O(policies x resources) — the scalar completion
 # pre-screens with the matcher before building contexts
@@ -769,7 +852,13 @@ FNS = {
     "admission": lambda: bench_admission(),
     "fallback": lambda: bench_fallback(),
     "churn": lambda: bench_churn(),
+    "cached": lambda: bench_cached(),
 }
+
+
+def _default_xla_cache_dir():
+    return os.environ.get("KYVERNO_TPU_XLA_CACHE_DIR") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), ".xla_cache")
 
 
 def _parse_probe_phases(stdout):
@@ -797,18 +886,30 @@ def _probe_backend(retries=None, sleep_s=None, timeout_s=None):
     than emitting an error artifact.
 
     Returns None on success, else a dict with the failure breakdown:
-    ``error`` (one line), ``stderr_tail`` (last 400 chars of the probe's
-    stderr), and ``phases`` (the per-phase probe progress) — so the
-    BENCH artifact records WHERE the probe died, not just that it did."""
+    ``error`` (one line), ``kind`` (``backend_unavailable`` when the
+    probe died before the device attach completed, ``compile_timeout``
+    when the backend attached but the XLA pre-warm overran — a wedged
+    compile and a dead attach need different fixes), ``stderr_tail``
+    (last 400 chars of the probe's stderr), ``phases``, and
+    ``compile_s`` when the warm-up finished. The probe pre-warms the
+    PSS device program THROUGH the persistent XLA cache, so the first
+    run pays the build once and every later probe warm-starts from
+    disk."""
     import subprocess
 
     retries = int(os.environ.get("BENCH_PROBE_RETRIES", "2")) \
         if retries is None else retries
     sleep_s = float(os.environ.get("BENCH_PROBE_BACKOFF", "5")) \
         if sleep_s is None else sleep_s
-    timeout_s = float(os.environ.get("BENCH_PROBE_TIMEOUT", "60")) \
+    timeout_s = float(os.environ.get("BENCH_PROBE_TIMEOUT", "120")) \
         if timeout_s is None else timeout_s
-    last = {"error": "backend probe failed", "stderr_tail": "", "phases": {}}
+
+    def classify(phases):
+        return "compile_timeout" if "devices" in phases \
+            else "backend_unavailable"
+
+    last = {"error": "backend probe failed", "stderr_tail": "",
+            "phases": {}, "kind": "backend_unavailable"}
     for i in range(retries):
         try:
             r = subprocess.run(
@@ -816,23 +917,76 @@ def _probe_backend(retries=None, sleep_s=None, timeout_s=None):
                 capture_output=True, text=True, timeout=timeout_s)
             if r.returncode == 0 and "probe-ok" in r.stdout:
                 return None
+            phases = _parse_probe_phases(r.stdout)
             last = {"error": (r.stdout + r.stderr)[-400:]
                     or f"probe exited {r.returncode}",
                     "stderr_tail": (r.stderr or "")[-400:],
-                    "phases": _parse_probe_phases(r.stdout)}
+                    "phases": phases, "kind": classify(phases)}
         except subprocess.TimeoutExpired as e:
-            last = {"error": f"probe timed out after {timeout_s}s",
+            phases = _parse_probe_phases(
+                (e.stdout or b"").decode("utf-8", "replace")
+                if isinstance(e.stdout, bytes) else (e.stdout or ""))
+            kind = classify(phases)
+            last = {"error": f"probe timed out after {timeout_s}s "
+                             f"({kind}: phases reached "
+                             f"{sorted(phases) or 'none'})",
                     "stderr_tail": ((e.stderr or b"").decode("utf-8", "replace")
                                     if isinstance(e.stderr, bytes)
                                     else (e.stderr or ""))[-400:],
-                    "phases": _parse_probe_phases(
-                        (e.stdout or b"").decode("utf-8", "replace")
-                        if isinstance(e.stdout, bytes) else (e.stdout or ""))}
+                    "phases": phases, "kind": kind}
         except Exception as e:  # noqa: BLE001
-            last = {"error": repr(e)[:400], "stderr_tail": "", "phases": {}}
+            last = {"error": repr(e)[:400], "stderr_tail": "", "phases": {},
+                    "kind": "backend_unavailable"}
         if i < retries - 1:
             time.sleep(sleep_s * (i + 1))
     return last
+
+
+def _measure_xla_compile_cache(platform_env=None, timeout_s=None):
+    """Cold-vs-warm build of the PSS device program at MIN_BUCKET, each
+    in a throwaway subprocess: run 1 compiles into an EMPTY persistent
+    cache directory (true cold), run 2 starts a fresh process against
+    the now-populated directory — its speedup is exactly what a serve
+    restart or the next bench probe gets."""
+    import subprocess
+    import tempfile
+
+    timeout_s = float(os.environ.get("BENCH_COMPILE_TIMEOUT", "300")) \
+        if timeout_s is None else timeout_s
+    # measured against a THROWAWAY directory (the only way to observe a
+    # true cold build); the persistent default dir the probe and serve
+    # restarts actually warm from is recorded separately
+    out = {"measured_in": "throwaway-tempdir",
+           "default_cache_dir": _default_xla_cache_dir()}
+    with tempfile.TemporaryDirectory(prefix="xla-cache-bench-") as tmp:
+        for leg in ("cold", "warm"):
+            env = dict(os.environ)
+            env.update(platform_env or {})
+            try:
+                t0 = time.perf_counter()
+                r = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__),
+                     "_compilewarm", tmp],
+                    capture_output=True, text=True, timeout=timeout_s,
+                    env=env)
+                wall = time.perf_counter() - t0
+                if r.returncode != 0:
+                    out[f"{leg}_error"] = (r.stderr or r.stdout)[-300:]
+                    return out
+                out[f"{leg}_s"] = round(
+                    float(json.loads(r.stdout.splitlines()[-1])["compile_s"]),
+                    3)
+                out[f"{leg}_wall_s"] = round(wall, 3)
+            except subprocess.TimeoutExpired:
+                out[f"{leg}_error"] = f"compile leg timed out after " \
+                                      f"{timeout_s}s"
+                return out
+            except Exception as e:  # noqa: BLE001
+                out[f"{leg}_error"] = repr(e)[:300]
+                return out
+    if out.get("cold_s") and out.get("warm_s"):
+        out["speedup"] = round(out["cold_s"] / max(out["warm_s"], 1e-9), 1)
+    return out
 
 
 def _force_cpu_backend():
@@ -848,23 +1002,35 @@ def _force_cpu_backend():
 def run_all():
     out = {"metric": "rule_resource_evals_per_sec", "value": 0.0,
            "unit": "evals/s", "vs_baseline": 0.0}
+    # the persistent XLA cache is process-global state every stage (and
+    # every probe subprocess, via the env) warms and reads — enabling
+    # it here is what turns the second bench invocation's probe from a
+    # full recompile into a disk read
+    os.environ.setdefault("KYVERNO_TPU_XLA_CACHE_DIR",
+                          _default_xla_cache_dir())
     err = None if os.environ.get("BENCH_SKIP_PROBE") else _probe_backend()
+    platform_env = {}
     if err is not None:
         # the bench always emits a real throughput number: a dead TPU
         # attach degrades to a CPU-jitted run (smaller default sizes so
         # the host finishes inside the driver budget) instead of the
         # former 0.0 + error payload — and the artifact records WHERE
-        # the probe died (phase progress + stderr tail), not just that
-        # it did
+        # the probe died (phase progress + stderr tail) and WHY
+        # (backend_unavailable vs compile_timeout), not just that it did
         out["tpu_probe_error"] = \
             f"TPU backend unavailable: {err['error']}"[:500]
+        out["tpu_probe_error_kind"] = err.get("kind", "backend_unavailable")
         out["tpu_probe_stderr_tail"] = err["stderr_tail"]
         out["tpu_probe_phases"] = err["phases"]
         out["platform_fallback"] = "cpu"
         os.environ.setdefault("BENCH_RESOURCES", "20000")
         os.environ.setdefault("BENCH_ITERS", "3")
         os.environ.setdefault("BENCH_ADM_REQUESTS", "5000")
+        platform_env = {"JAX_PLATFORMS": "cpu"}
         _force_cpu_backend()
+    from kyverno_tpu.tpu.cache import enable_xla_compile_cache
+
+    enable_xla_compile_cache()
     only = [c for c in os.environ.get("BENCH_CONFIGS", "").split(",") if c]
     try:
         out.update(bench_scan())
@@ -879,13 +1045,19 @@ def run_all():
     # FIRST — it is the most expensive measurement and must survive a
     # hang in any later stage.
     emit(out)
+    if not os.environ.get("BENCH_SKIP_XLA_LEG"):
+        try:
+            out["xla_compile"] = _measure_xla_compile_cache(platform_env)
+        except Exception as e:  # noqa: BLE001
+            out["xla_compile"] = {"error": repr(e)[:300]}
+        emit(out)
     try:
         out["mixed_corpus_coverage"] = mixed_corpus_coverage()
     except Exception as e:  # noqa: BLE001
         out["mixed_corpus_coverage"] = {"error": repr(e)[:300]}
     emit(out)
     for name in ("match", "overlay", "apply", "admission", "fallback",
-                 "churn"):
+                 "cached", "churn"):
         if only and name not in only:
             continue
         t0 = time.perf_counter()
@@ -895,6 +1067,19 @@ def run_all():
         except Exception as e:  # noqa: BLE001
             configs[name] = {"error": repr(e)[:500]}
         emit(out)
+    # cache-wide accounting for the whole run: hit rates roll up here
+    # so the driver artifact always carries them even when the cached
+    # config leg is filtered out
+    from kyverno_tpu.observability.metrics import global_registry as _reg
+
+    out["verdict_cache"] = {
+        "hits": _reg.verdict_cache.value({"outcome": "hit"}),
+        "misses": _reg.verdict_cache.value({"outcome": "miss"}),
+        "bypass": _reg.verdict_cache.value({"outcome": "bypass"}),
+        "encode_hits": _reg.encode_cache.value({"outcome": "hit"}),
+        "encode_misses": _reg.encode_cache.value({"outcome": "miss"}),
+    }
+    emit(out)
 
 
 def _emit_phase_split():
@@ -911,10 +1096,12 @@ def main():
     argv = [a for a in sys.argv[1:] if a != "--phases"]
     want_phases = "--phases" in sys.argv[1:]
     config = argv[0] if argv else "all"
+    if config == "--cached":  # flag spelling of the cached config
+        config = "cached"
     if config == "_probe":
         # phase-stamped progress: the parent's failure artifact shows
-        # how far the probe got (import vs device attach) and how long
-        # each step took
+        # how far the probe got (import vs device attach vs compile)
+        # and how long each step took
         t0 = time.perf_counter()
         import jax
 
@@ -925,7 +1112,39 @@ def main():
         print(f"probe-phase devices {time.perf_counter() - t0:.3f}",
               flush=True)
         assert devices
+        # pre-warm the PSS device program at MIN_BUCKET through the
+        # persistent XLA cache: the first probe on a box pays the build
+        # once; every later probe (and the serve restart, and the real
+        # bench stages) reads it back from disk in seconds. A probe
+        # killed in THIS phase is a compile timeout, not a dead backend
+        # — the parent reports the two distinctly.
+        t0 = time.perf_counter()
+        from kyverno_tpu.policies import load_pss_policies
+        from kyverno_tpu.policy.autogen import expand_policy
+        from kyverno_tpu.tpu.cache import enable_xla_compile_cache
+        from kyverno_tpu.tpu.engine import TpuEngine
+
+        enable_xla_compile_cache()
+        eng = TpuEngine([expand_policy(p) for p in load_pss_policies()])
+        eng.scan([{}])
+        print(f"probe-phase compile {time.perf_counter() - t0:.3f}",
+              flush=True)
         print("probe-ok")
+        return
+    if config == "_compilewarm":
+        # one cold-or-warm build of the PSS device program against the
+        # persistent cache dir in argv (used by the driver's
+        # xla_compile cold/warm measurement)
+        from kyverno_tpu.policies import load_pss_policies
+        from kyverno_tpu.policy.autogen import expand_policy
+        from kyverno_tpu.tpu.cache import enable_xla_compile_cache
+        from kyverno_tpu.tpu.engine import TpuEngine
+
+        enable_xla_compile_cache(argv[1])
+        eng = TpuEngine([expand_policy(p) for p in load_pss_policies()])
+        t0 = time.perf_counter()
+        eng.scan([{}])  # jit build at MIN_BUCKET (cache hit when warm)
+        emit({"compile_s": time.perf_counter() - t0})
         return
     if config == "all":
         run_all()

@@ -70,6 +70,23 @@ def add_parser(sub: argparse._SubParsersAction) -> None:
                         "ladder (snapshot -> compile -> atomic swap)")
     p.add_argument("--reload-interval", type=float, default=2.0,
                    help="seconds between --policy-watch polls")
+    # performance: persistent XLA compile cache + content-addressed
+    # verdict/encode caches (tpu/cache.py)
+    p.add_argument("--xla-cache-dir", default=None, metavar="DIR",
+                   help="persistent XLA compilation cache directory so "
+                        "compiled device programs survive restarts "
+                        "(default: $KYVERNO_TPU_XLA_CACHE_DIR or "
+                        "./.xla_cache; 'none' disables)")
+    p.add_argument("--verdict-cache-size", type=int, default=None,
+                   metavar="N",
+                   help="verdict-column LRU capacity in entries "
+                        "(default $KYVERNO_TPU_VERDICT_CACHE or 65536; "
+                        "0 disables)")
+    p.add_argument("--encode-cache-size", type=int, default=None,
+                   metavar="N",
+                   help="encode-row LRU capacity in entries "
+                        "(default $KYVERNO_TPU_ENCODE_CACHE or 8192; "
+                        "0 disables)")
     p.set_defaults(func=run)
 
 
@@ -251,6 +268,17 @@ def run(args: argparse.Namespace) -> int:
     if not policies:
         print("no policies found", file=sys.stderr)
         return 2
+    # performance caches BEFORE any compile happens: the lifecycle
+    # compile-ahead warm (and every later jit) writes through the
+    # persistent XLA cache, so a serve restart warm-starts from disk
+    from ..tpu.cache import configure as configure_caches
+    from ..tpu.cache import enable_xla_compile_cache
+
+    configure_caches(verdict_capacity=args.verdict_cache_size,
+                     encode_capacity=args.encode_cache_size)
+    xla_dir = enable_xla_compile_cache(args.xla_cache_dir)
+    if xla_dir:
+        print(f"persistent XLA compile cache: {xla_dir}", file=sys.stderr)
     configuration = Configuration()
     if args.config:
         with open(args.config) as f:

@@ -171,6 +171,23 @@ class Context:
                 raise ContextEntryError(f"failed to load context entry {name!r}: {e}")
             self.add_context_entry(name, value)
 
+    def shallow_fork(self) -> "Context":
+        """Cheap clone for per-slot dyn-operand encoding (tpu/engine.py
+        _encode_dyn_cells): the expensive context build (resource,
+        image extraction) happens once per resource; each operand slot
+        loads its entries into a fork. The fork shares the request/
+        images subtrees BY REFERENCE but owns its top-level spine, so
+        entries one slot loads never leak into another slot's
+        substitution or query. Safe because context entry names may not
+        shadow reserved roots (request/images/element — policy
+        validation rejects them), so loads only ever create new
+        top-level keys."""
+        out = Context()
+        out._root = dict(self._root)
+        out._pinned = set(self._pinned)
+        out._deferred = list(self._deferred)
+        return out
+
     # -- checkpointing (context.go Checkpoint/Restore/Reset)
 
     def checkpoint(self) -> None:

@@ -48,6 +48,12 @@ class Counter:
         with self._lock:
             self._values[k] = self._values.get(k, 0.0) + value
 
+    def value(self, labels: Optional[Dict[str, str]] = None) -> float:
+        """Programmatic read (tests, bench artifacts) — exposition
+        parsing is for scrapers, not assertions."""
+        with self._lock:
+            return self._values.get(_labels_key(labels), 0.0)
+
     def collect(self) -> List[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
         with self._lock:
@@ -154,6 +160,35 @@ class MetricsRegistry:
             "kyverno_tpu_device_dispatch_seconds", "device program wall time")
         self.compile_cache = self.counter(
             "kyverno_tpu_compile_cache_total", "policy-set compiles by outcome")
+        # content-addressed result caches (tpu/cache.py): per-resource
+        # verdict-column and encode-row lookups by outcome, eviction
+        # pressure, and live size — the hit RATE is the amortization
+        # signal (a cold rate on a steady cluster means keys churn)
+        self.verdict_cache = self.counter(
+            "kyverno_tpu_verdict_cache_total",
+            "verdict-column cache lookups by outcome (hit/miss/bypass)")
+        self.verdict_cache_evictions = self.counter(
+            "kyverno_tpu_verdict_cache_evictions_total",
+            "verdict-column cache entries evicted at the LRU bound")
+        self.verdict_cache_size = self.gauge(
+            "kyverno_tpu_verdict_cache_size",
+            "verdict-column cache entries currently held")
+        self.encode_cache = self.counter(
+            "kyverno_tpu_encode_cache_total",
+            "encode-row cache lookups by outcome (hit/miss)")
+        self.encode_cache_evictions = self.counter(
+            "kyverno_tpu_encode_cache_evictions_total",
+            "encode-row cache entries evicted at the LRU bound")
+        # pipelined scan (tpu/pipeline.py): how much host work hid
+        # behind device time in the last pipelined scan (0 = strictly
+        # serial, higher = more overlap), plus chunk accounting
+        self.pipeline_overlap = self.gauge(
+            "kyverno_tpu_pipeline_overlap_ratio",
+            "(encode+device+host seconds - wall) / wall of the last "
+            "pipelined scan")
+        self.pipeline_chunks = self.counter(
+            "kyverno_tpu_pipeline_chunks_total",
+            "pipelined scan chunks by how they resolved")
         # serving pipeline instruments (serving/batcher.py): queue
         # depth, batch occupancy, flush reasons, shed/expiry counters,
         # and submit-to-verdict latency (p50-p99 read from buckets)

@@ -14,6 +14,10 @@ batched device computation:
 - evaluator: IR -> jitted batch program, vmapped over resources and
              unrolled over rules; MXU-friendly instance joins
 - engine:    TpuEngine facade + sharded scan entry points
+- cache:     content-addressed verdict/encode LRUs + the persistent
+             XLA compile cache (the amortization levers)
+- pipeline:  double-buffered scan — encode k+1, device k, and host
+             completion k-1 overlap instead of serializing
 """
 
 from .compiler import CompiledPolicySet, compile_policy_set

@@ -1,0 +1,372 @@
+"""Content-addressed result caches + the persistent XLA compile cache.
+
+Hardware pattern-matching engines get their throughput by amortizing:
+compiled automata are reused across packets, and identical flows skip
+re-matching entirely (PAPERS: Hyperflex SIMD-DFA DPI; in-memory
+pattern-matching codesign). This module brings the same two levers to
+the policy engine:
+
+- **VerdictCache** — a bounded LRU keyed by content, not identity:
+  (compiled-policy-set content key, resource content hash, digest of
+  ns-labels/operation/userinfo) -> that resource's (num_rules,) verdict
+  column. Repeat admissions of identical manifests and full rescans of
+  a mostly-unchanged cluster skip encoding AND the device entirely.
+  Invalidation is free: a policy mutation, quarantine change, config
+  knob, or context-dep (compile-folded configmap) movement changes the
+  policy-set key; a resource edit changes the resource hash; an
+  ns-label or userinfo change changes the request digest. Nothing is
+  ever explicitly flushed — stale keys just stop being looked up and
+  age out of the LRU.
+
+- **EncodeRowCache** — resource content hash (+ encode-path config
+  key) -> the resource's encoded lane rows, trimmed to the rows it
+  actually uses. A verdict-cache miss after a policy-set revision bump
+  still skips the Python tree-walk re-encode of unchanged resources
+  (the encode key deliberately excludes policy CONTENT — only the
+  encode caps and compiled byte paths shape the rows).
+
+- **enable_xla_compile_cache** — turns on JAX's persistent compilation
+  cache (``jax_compilation_cache_dir``) so ``device_fn`` builds survive
+  process restarts: the lifecycle compile-ahead warm scan and the bench
+  probe pay the multi-minute XLA build once per (program, shape), not
+  once per process.
+
+Caching is only consulted when the compiled set is *cache eligible*
+(TpuEngine.cache_eligible): no runtime dyn-operand slots (those do real
+context-backend I/O per request) and no host-routed rule with context
+entries (the scalar oracle would do live I/O). Compile-time folded
+configmaps are fine — their content hashes ride the policy-set key.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+
+def digest(*parts: Any) -> str:
+    """Stable short digest over JSON-serializable parts."""
+    payload = json.dumps(parts, sort_keys=True, default=str,
+                         separators=(",", ":"))
+    return hashlib.sha256(payload.encode()).hexdigest()[:16]
+
+
+def resource_content_hash(resource: Any) -> Optional[str]:
+    """Content hash of one resource dict; None when the object is not
+    canonically hashable (non-JSON values) — such resources simply
+    bypass the caches, they are never mis-keyed. MUST stay the same
+    function as cluster/snapshot.py resource_hash (asserted in tests):
+    the scanner threads the snapshot's stored hashes into
+    verdict_cache_keys instead of re-serializing every body."""
+    try:
+        payload = json.dumps(resource, sort_keys=True,
+                             separators=(",", ":"))
+    except (TypeError, ValueError):
+        return None
+    return hashlib.sha256(payload.encode()).hexdigest()[:16]
+
+
+def request_digest(ns_labels: Optional[Dict[str, str]], operation: str,
+                   info: Any) -> str:
+    """Digest of the per-request evaluation context that is NOT the
+    resource body: the resource's namespace labels (namespaceSelector
+    results can flip without the resource changing), the admission
+    operation (raw — '' and 'CREATE' evaluate differently), and the
+    requesting identity."""
+    ident: Tuple = ()
+    if info is not None:
+        ident = (getattr(info, "username", ""), getattr(info, "uid", ""),
+                 tuple(getattr(info, "groups", ()) or ()),
+                 tuple(getattr(info, "roles", ()) or ()),
+                 tuple(getattr(info, "cluster_roles", ()) or ()))
+    return digest(sorted((ns_labels or {}).items()), operation or "", ident)
+
+
+class LruCache:
+    """Thread-safe bounded LRU. ``capacity <= 0`` disables the cache
+    (get always misses, put is a no-op) — the disable knob the CLI
+    flags and tests use."""
+
+    def __init__(self, capacity: int, name: str = "lru"):
+        self.name = name
+        self._capacity = int(capacity)
+        self._data: "OrderedDict[Any, Any]" = OrderedDict()
+        self._lock = threading.Lock()
+        self.evictions = 0
+
+    @property
+    def capacity(self) -> int:
+        return self._capacity
+
+    def set_capacity(self, capacity: int) -> None:
+        with self._lock:
+            self._capacity = int(capacity)
+            while len(self._data) > max(self._capacity, 0):
+                self._data.popitem(last=False)
+                self.evictions += 1
+
+    @property
+    def enabled(self) -> bool:
+        return self._capacity > 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._data)
+
+    def get(self, key: Any) -> Optional[Any]:
+        with self._lock:
+            if key not in self._data:
+                return None
+            self._data.move_to_end(key)
+            return self._data[key]
+
+    def put(self, key: Any, value: Any) -> None:
+        if self._capacity <= 0:
+            return
+        with self._lock:
+            self._data[key] = value
+            self._data.move_to_end(key)
+            while len(self._data) > self._capacity:
+                self._data.popitem(last=False)
+                self.evictions += 1
+
+    def clear(self) -> None:
+        with self._lock:
+            self._data.clear()
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+class VerdictCache:
+    """LRU of verdict COLUMNS: key -> (num_rules,) int32 array. Values
+    are stored and returned as copies so callers can never alias a
+    cached column into a mutable verdict table."""
+
+    def __init__(self, capacity: Optional[int] = None, metrics=None):
+        if capacity is None:
+            capacity = _env_int("KYVERNO_TPU_VERDICT_CACHE", 65536)
+        self._lru = LruCache(capacity, name="verdict")
+        self._metrics = metrics
+
+    def _registry(self):
+        if self._metrics is None:
+            from ..observability.metrics import global_registry
+
+            self._metrics = global_registry
+        return self._metrics
+
+    @property
+    def enabled(self) -> bool:
+        return self._lru.enabled
+
+    @property
+    def evictions(self) -> int:
+        return self._lru.evictions
+
+    def set_capacity(self, capacity: int) -> None:
+        self._lru.set_capacity(capacity)
+
+    def __len__(self) -> int:
+        return len(self._lru)
+
+    def clear(self) -> None:
+        self._lru.clear()
+
+    def get(self, key: Any) -> Optional[np.ndarray]:
+        m = self._registry()
+        col = self._lru.get(key)
+        if col is None:
+            m.verdict_cache.inc({"outcome": "miss"})
+            return None
+        m.verdict_cache.inc({"outcome": "hit"})
+        return col.copy()
+
+    def bypass(self) -> None:
+        """Count a scan that skipped the cache (ineligible set)."""
+        self._registry().verdict_cache.inc({"outcome": "bypass"})
+
+    def put(self, key: Any, column: np.ndarray) -> None:
+        if not self._lru.enabled:
+            return
+        before = self._lru.evictions
+        self._lru.put(key, np.array(column, dtype=np.int32, copy=True))
+        m = self._registry()
+        evicted = self._lru.evictions - before
+        if evicted:
+            m.verdict_cache_evictions.inc(value=evicted)
+        m.verdict_cache_size.set(len(self._lru))
+
+
+# per-resource row lanes stored trimmed to the rows the resource uses
+# (everything past n_rows holds RowBatch defaults); pool slots trimmed
+# to the last one carrying bytes
+class _EncodedRows:
+    __slots__ = ("lanes", "pool", "pool_len", "n_rows", "fallback")
+
+    def __init__(self, lanes, pool, pool_len, n_rows, fallback):
+        self.lanes = lanes
+        self.pool = pool
+        self.pool_len = pool_len
+        self.n_rows = n_rows
+        self.fallback = fallback
+
+
+class EncodeRowCache:
+    """LRU of per-resource encoded rows. Keys are
+    (encode-path key, resource content hash): the encode-path key
+    covers the EncodeConfig caps and the compiled byte-path sets —
+    everything that shapes the rows — and deliberately NOT the policy
+    content, so a policy-set revision bump keeps every entry warm."""
+
+    def __init__(self, capacity: Optional[int] = None, metrics=None):
+        if capacity is None:
+            capacity = _env_int("KYVERNO_TPU_ENCODE_CACHE", 8192)
+        self._lru = LruCache(capacity, name="encode")
+        self._metrics = metrics
+
+    def _registry(self):
+        if self._metrics is None:
+            from ..observability.metrics import global_registry
+
+            self._metrics = global_registry
+        return self._metrics
+
+    @property
+    def enabled(self) -> bool:
+        return self._lru.enabled
+
+    @property
+    def evictions(self) -> int:
+        return self._lru.evictions
+
+    def set_capacity(self, capacity: int) -> None:
+        self._lru.set_capacity(capacity)
+
+    def __len__(self) -> int:
+        return len(self._lru)
+
+    def clear(self) -> None:
+        self._lru.clear()
+
+    @staticmethod
+    def encode_key(encode_cfg, byte_paths, key_byte_paths) -> str:
+        return digest(
+            (encode_cfg.max_rows, encode_cfg.max_instances,
+             encode_cfg.byte_pool_slots, encode_cfg.byte_pool_width),
+            sorted(byte_paths or ()), sorted(key_byte_paths or ()))
+
+    def get_into(self, key: Any, batch, i: int) -> bool:
+        """Write the cached rows for ``key`` into row ``i`` of a fresh
+        RowBatch (whose lanes still hold constructor defaults). Returns
+        False on miss."""
+        m = self._registry()
+        entry: Optional[_EncodedRows] = self._lru.get(key)
+        if entry is None:
+            m.encode_cache.inc({"outcome": "miss"})
+            return False
+        for name, row in entry.lanes.items():
+            getattr(batch, name)[i, : row.shape[0]] = row
+        if entry.pool is not None:
+            s = entry.pool.shape[0]
+            batch.pool[i, :s] = entry.pool
+            batch.pool_len[i, :s] = entry.pool_len
+        batch.n_rows[i] = entry.n_rows
+        batch.fallback[i] = entry.fallback
+        m.encode_cache.inc({"outcome": "hit"})
+        return True
+
+    def put_from(self, key: Any, batch, i: int) -> None:
+        """Trim + store row ``i`` of an encoded RowBatch."""
+        if not self._lru.enabled:
+            return
+        m = int(batch.n_rows[i])
+        lanes: Dict[str, np.ndarray] = {}
+        for name, arr in batch.arrays().items():
+            if name in ("pool", "pool_len", "n_rows", "fallback"):
+                continue
+            lanes[name] = arr[i, :m].copy()
+        used = np.nonzero(batch.pool_len[i] > 0)[0]
+        s = int(used.max()) + 1 if used.size else 0
+        pool = batch.pool[i, :s].copy() if s else None
+        pool_len = batch.pool_len[i, :s].copy() if s else None
+        before = self._lru.evictions
+        self._lru.put(key, _EncodedRows(lanes, pool, pool_len,
+                                        int(batch.n_rows[i]),
+                                        int(batch.fallback[i])))
+        reg = self._registry()
+        evicted = self._lru.evictions - before
+        if evicted:
+            reg.encode_cache_evictions.inc(value=evicted)
+
+
+global_verdict_cache = VerdictCache()
+global_encode_cache = EncodeRowCache()
+
+
+def configure(verdict_capacity: Optional[int] = None,
+              encode_capacity: Optional[int] = None) -> None:
+    """Resize (0 disables) the process-wide caches — the CLI knobs."""
+    if verdict_capacity is not None:
+        global_verdict_cache.set_capacity(verdict_capacity)
+    if encode_capacity is not None:
+        global_encode_cache.set_capacity(encode_capacity)
+
+
+# ---------------------------------------------------------------------------
+# persistent XLA compilation cache
+
+DEFAULT_XLA_CACHE_DIR = ".xla_cache"
+_xla_cache_lock = threading.Lock()
+_xla_cache_dir: Optional[str] = None
+
+
+def enable_xla_compile_cache(cache_dir: Optional[str] = None,
+                             ) -> Optional[str]:
+    """Point JAX's persistent compilation cache at ``cache_dir``
+    (flag --xla-cache-dir / env KYVERNO_TPU_XLA_CACHE_DIR, default
+    ``.xla_cache`` under the working directory). Compiled ``device_fn``
+    programs then survive process restarts: a serve restart or the
+    bench probe warm-starts in seconds instead of re-paying the full
+    XLA build. ``none``/``off``/empty disables. Idempotent; returns
+    the active directory or None when disabled."""
+    global _xla_cache_dir
+    if cache_dir is None:
+        cache_dir = os.environ.get("KYVERNO_TPU_XLA_CACHE_DIR",
+                                   DEFAULT_XLA_CACHE_DIR)
+    if not cache_dir or cache_dir.lower() in ("none", "off", "disabled"):
+        return None
+    cache_dir = os.path.abspath(cache_dir)
+    with _xla_cache_lock:
+        if _xla_cache_dir == cache_dir:
+            return cache_dir
+        import jax
+
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # default thresholds skip small/fast programs; a policy set's
+        # device_fn at MIN_BUCKET can compile fast on CPU yet still be
+        # worth persisting (the probe's whole point is a warm start)
+        for knob, value in (
+                ("jax_persistent_cache_min_compile_time_secs", 0.0),
+                ("jax_persistent_cache_min_entry_size_bytes", -1)):
+            try:
+                jax.config.update(knob, value)
+            except Exception:  # knob absent on this jax version
+                pass
+        _xla_cache_dir = cache_dir
+    return cache_dir
+
+
+def xla_cache_dir() -> Optional[str]:
+    return _xla_cache_dir

@@ -67,6 +67,7 @@ class CompiledPolicySet:
     # with the compile error that put them there
     quarantined: Dict[int, str] = field(default_factory=dict)
     _fn: Optional[Callable] = field(default=None, repr=False)
+    _cache_key: Optional[str] = field(default=None, repr=False)
 
     @property
     def host_rule_policies(self) -> List[int]:
@@ -98,6 +99,29 @@ class CompiledPolicySet:
     def coverage(self) -> Tuple[int, int]:
         dev = sum(1 for e in self.rules if e.device_row is not None)
         return dev, len(self.rules)
+
+    def cache_key(self) -> str:
+        """Content identity of this compiled artifact — the policy-set
+        half of every verdict-cache key (tpu/cache.py). Covers
+        everything that can change a verdict column for a fixed
+        (resource, request): policy content, quarantine set, encode and
+        metadata caps, and the content hashes of every compile-folded
+        context dependency — so a configmap moving under a specialized
+        program rotates the key instead of serving stale verdicts."""
+        if self._cache_key is None:
+            from ..lifecycle.snapshot import policy_content_hash
+            from .cache import digest
+
+            self._cache_key = digest(
+                [policy_content_hash(p) for p in self.policies],
+                sorted(self.quarantined.items()),
+                sorted(self.context_deps.items()),
+                (self.encode_cfg.max_rows, self.encode_cfg.max_instances,
+                 self.encode_cfg.byte_pool_slots,
+                 self.encode_cfg.byte_pool_width),
+                sorted(vars(self.meta_cfg).items()),
+                sorted(self.byte_paths), sorted(self.key_byte_paths))
+        return self._cache_key
 
 
 def compile_policy_set(

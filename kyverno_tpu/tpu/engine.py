@@ -188,6 +188,17 @@ class TpuEngine:
                 if any(t.contains(entry.policy_name, entry.rule_name)
                        for t in typed):
                     self._exception_rules.add(ri)
+        # verdict-cache identity (tpu/cache.py): exceptions change
+        # verdicts without changing the compiled set, so they join the
+        # policy-set content key
+        from .cache import digest as _digest
+
+        self._exceptions_digest = _digest(
+            [e if isinstance(e, dict) else getattr(e, "raw", None) or repr(e)
+             for e in exceptions]) if exceptions else ""
+        self._cache_ident: Optional[str] = None
+        self._cache_eligible: Optional[bool] = None
+        self._encode_cache_key: Optional[str] = None
 
     @classmethod
     def from_compiled(cls, cps: CompiledPolicySet) -> "TpuEngine":
@@ -204,8 +215,7 @@ class TpuEngine:
         operations: Optional[Sequence[str]] = None,
         admission_infos: Optional[Sequence[Optional[RequestInfo]]] = None,
     ):
-        rows = encode_resources(resources, self.cps.encode_cfg, self.cps.byte_paths,
-                                self.cps.key_byte_paths)
+        rows = self._encode_rows(resources)
         meta = encode_metadata(resources, namespace_labels, operations,
                                admission_infos, self.cps.meta_cfg)
         batch = batch_to_host(rows, meta)
@@ -213,6 +223,46 @@ class TpuEngine:
             batch.update(self._encode_dyn_lanes(resources, operations,
                                                 admission_infos))
         return batch, rows, meta
+
+    def _encode_rows(self, resources: Sequence[Dict[str, Any]]):
+        """Row encoding through the content-addressed encode cache: an
+        unchanged resource's lane rows restore from the LRU instead of
+        re-walking the JSON tree. Keyed by encode config + compiled
+        byte-path sets, NOT policy content — a policy-set revision bump
+        keeps every entry warm (the verdict cache misses, this one
+        doesn't)."""
+        from .cache import (EncodeRowCache, global_encode_cache,
+                            resource_content_hash)
+        from .flatten import RowBatch
+
+        ec = global_encode_cache
+        if not ec.enabled:
+            return encode_resources(resources, self.cps.encode_cfg,
+                                    self.cps.byte_paths,
+                                    self.cps.key_byte_paths)
+        if self._encode_cache_key is None:
+            self._encode_cache_key = EncodeRowCache.encode_key(
+                self.cps.encode_cfg, self.cps.byte_paths,
+                self.cps.key_byte_paths)
+        batch = RowBatch(len(resources), self.cps.encode_cfg)
+        misses: List[Tuple[int, Optional[Tuple[str, str]]]] = []
+        for i, res in enumerate(resources):
+            h = resource_content_hash(res)
+            key = (self._encode_cache_key, h) if h is not None else None
+            if key is None or not ec.get_into(key, batch, i):
+                misses.append((i, key))
+        if misses:
+            sub = encode_resources([resources[i] for i, _ in misses],
+                                   self.cps.encode_cfg, self.cps.byte_paths,
+                                   self.cps.key_byte_paths)
+            sub_arrays = sub.arrays()
+            batch_arrays = batch.arrays()
+            for j, (i, key) in enumerate(misses):
+                for name, arr in sub_arrays.items():
+                    batch_arrays[name][i] = arr[j]
+                if key is not None:
+                    ec.put_from(key, sub, j)
+        return batch
 
     def _encode_dyn_lanes(self, resources, operations, admission_infos):
         """Host-resolved context operands (SURVEY §7 context-dependent
@@ -270,8 +320,13 @@ class TpuEngine:
         for ci, res in enumerate(resources):
             op = (operations[ci] if operations else "") or ""
             info = admission_infos[ci] if admission_infos else None
+            # ONE context build per resource (image extraction is the
+            # expensive part); every slot loads into a shallow fork so
+            # entries one slot resolves never leak into another slot's
+            # substitution or query
+            base_ctx = _scan_json_context(res, op, info)
             for si, slot in enumerate(self.cps.dyn_slots):
-                ctx = _scan_json_context(res, op, info)
+                ctx = base_ctx.shallow_fork()
                 key = None
                 try:
                     from ..engine.variables import substitute_all
@@ -397,7 +452,119 @@ class TpuEngine:
             b *= 2
         return b
 
+    # -- verdict-column caching (tpu/cache.py)
+
+    @property
+    def cache_eligible(self) -> bool:
+        """A compiled set may serve verdicts from the content-addressed
+        cache only when evaluation is a pure function of the cache key:
+        no runtime dyn-operand slots (they do real context-backend I/O
+        per request), and no statically host-routed rule with context
+        entries (the scalar oracle would load them live). Compile-time
+        folded configmaps are fine — their content hashes are part of
+        the policy-set key, so movement rotates the key."""
+        if self._cache_eligible is None:
+            eligible = not self.cps.dyn_slots
+            if eligible:
+                for ri, entry in enumerate(self.cps.rules):
+                    if (entry.device_row is not None
+                            and ri not in self._exception_rules):
+                        continue
+                    policy = self.cps.policies[entry.policy_idx]
+                    for rule in policy.get_rules():
+                        if rule.name == entry.rule_name and rule.context:
+                            eligible = False
+            self._cache_eligible = eligible
+        return self._cache_eligible
+
+    def verdict_cache_keys(
+        self,
+        resources: Sequence[Dict[str, Any]],
+        namespace_labels: Optional[Dict[str, Dict[str, str]]] = None,
+        operations: Optional[Sequence[str]] = None,
+        admission_infos: Optional[Sequence[Optional[RequestInfo]]] = None,
+        resource_hashes: Optional[Sequence[Optional[str]]] = None,
+    ) -> Optional[List[Optional[Tuple[str, str, str]]]]:
+        """Per-resource verdict-cache keys, or None when this engine is
+        not cache eligible. Individual entries are None for resources
+        that cannot be content-hashed (those bypass the cache).
+        ``resource_hashes`` lets callers that already hold the content
+        hash (the cluster snapshot stores one per resource) skip the
+        re-serialization — it MUST be the canonical sha-16 the snapshot
+        computes, which is the same function used here."""
+        from .cache import request_digest, resource_content_hash
+
+        if not self.cache_eligible:
+            return None
+        if self._cache_ident is None:
+            self._cache_ident = self.cps.cache_key() + self._exceptions_digest
+        ns_labels = namespace_labels or {}
+        keys: List[Optional[Tuple[str, str, str]]] = []
+        for ci, res in enumerate(resources):
+            h = (resource_hashes[ci] if resource_hashes is not None
+                 else resource_content_hash(res))
+            if h is None:
+                keys.append(None)
+                continue
+            try:
+                meta = res.get("metadata") or {}
+                nsl = ns_labels.get(
+                    meta.get("name", "") if res.get("kind") == "Namespace"
+                    else meta.get("namespace", ""), {})
+            except Exception:  # not dict-shaped
+                keys.append(None)
+                continue
+            op = (operations[ci] if operations else "") or ""
+            info = admission_infos[ci] if admission_infos else None
+            keys.append((self._cache_ident, h,
+                         request_digest(nsl, op, info)))
+        return keys
+
     def scan(
+        self,
+        resources: Sequence[Dict[str, Any]],
+        namespace_labels: Optional[Dict[str, Dict[str, str]]] = None,
+        operations: Optional[Sequence[str]] = None,
+        admission_infos: Optional[Sequence[Optional[RequestInfo]]] = None,
+    ) -> ScanResult:
+        """Cached scan: verdict columns for content-identical
+        (resource, request) pairs restore from the LRU; only the misses
+        pay encode + dispatch (via the full uncached ladder). Columns
+        are per-resource independent in the device program, so a
+        miss-only sub-batch is bit-identical to scanning everything."""
+        from .cache import global_verdict_cache as vc
+
+        keys = (self.verdict_cache_keys(resources, namespace_labels,
+                                        operations, admission_infos)
+                if vc.enabled else None)
+        if keys is None:
+            if vc.enabled:
+                vc.bypass()
+            return self._scan_uncached(resources, namespace_labels,
+                                       operations, admission_infos)
+        n = len(resources)
+        rules = [(e.policy_name, e.rule_name) for e in self.cps.rules]
+        total = np.full((len(rules), n), NOT_MATCHED, dtype=np.int32)
+        miss: List[int] = []
+        for i, key in enumerate(keys):
+            col = vc.get(key) if key is not None else None
+            if col is None:
+                miss.append(i)
+            else:
+                total[:, i] = col
+        if miss:
+            sub = self._scan_uncached(
+                [resources[i] for i in miss], namespace_labels,
+                [operations[i] for i in miss] if operations else None,
+                [admission_infos[i] for i in miss] if admission_infos
+                else None)
+            for j, i in enumerate(miss):
+                total[:, i] = sub.verdicts[:, j]
+                if keys[i] is not None:
+                    vc.put(keys[i], sub.verdicts[:, j])
+        return ScanResult(verdicts=total, rules=rules)
+
+    def _scan_uncached(
         self,
         resources: Sequence[Dict[str, Any]],
         namespace_labels: Optional[Dict[str, Dict[str, str]]] = None,
@@ -427,45 +594,91 @@ class TpuEngine:
             device_table, resources, namespace_labels, operations, admission_infos
         )
 
+    def _breaker_open_fallback(self) -> None:
+        from ..observability.metrics import global_registry
+
+        set_dispatch_path(PATH_SCALAR_FALLBACK)
+        global_registry.breaker_fallback.inc({"reason": "open"})
+        global_tracer.add_event("breaker_fallback", reason="open",
+                                breaker=self.breaker.name)
+
+    def _record_dispatch_failure(self, e: Exception) -> None:
+        from ..observability.metrics import global_registry
+
+        self.breaker.record_failure()
+        set_dispatch_path(PATH_SCALAR_FALLBACK)
+        global_registry.breaker_fallback.inc({"reason": "error"})
+        global_tracer.add_event(
+            "breaker_fallback", reason="error", breaker=self.breaker.name,
+            breaker_state=self.breaker.state,
+            error=f"{type(e).__name__}: {e}")
+
     def guarded_dispatch(self, dispatch_fn, want_shape) -> Optional[np.ndarray]:
         """The ONE breaker-gated dispatch ladder (shared with
         ShardedScanner so the two paths cannot drift): fault hook,
         dispatch, corrupt filter, shape/dtype validation, breaker
         bookkeeping. Returns the validated verdict table, or None when
         the breaker is open or the dispatch failed — the caller falls
-        back to scalar completion (all-HOST)."""
-        from ..observability.metrics import global_registry
-
+        back to scalar completion (all-HOST). The pipelined scan uses
+        the same ladder split in two (guarded_launch/guarded_complete)
+        so the device can run chunk k while the host touches k±1."""
         if not self.breaker.allow():
-            set_dispatch_path(PATH_SCALAR_FALLBACK)
-            global_registry.breaker_fallback.inc({"reason": "open"})
-            global_tracer.add_event("breaker_fallback", reason="open",
-                                    breaker=self.breaker.name)
+            self._breaker_open_fallback()
             return None
         try:
             with global_tracer.span("tpu.dispatch",
                                     breaker=self.breaker.state) as span:
                 global_faults.fire(SITE_TPU_DISPATCH)
                 table = dispatch_fn()
-                table = global_faults.corrupt(SITE_TPU_DISPATCH, table)
-                if not (isinstance(table, np.ndarray)
-                        and table.shape == want_shape
-                        and np.issubdtype(table.dtype, np.integer)):
-                    raise DeviceResultError(
-                        f"device returned shape "
-                        f"{getattr(table, 'shape', None)}, want {want_shape}")
-                self.breaker.record_success()
-                set_dispatch_path(PATH_DEVICE)
+                table = self._validate_device_table(table, want_shape)
                 span.attributes["engine"] = PATH_DEVICE
                 return table
         except Exception as e:
-            self.breaker.record_failure()
-            set_dispatch_path(PATH_SCALAR_FALLBACK)
-            global_registry.breaker_fallback.inc({"reason": "error"})
-            global_tracer.add_event(
-                "breaker_fallback", reason="error", breaker=self.breaker.name,
-                breaker_state=self.breaker.state,
-                error=f"{type(e).__name__}: {e}")
+            self._record_dispatch_failure(e)
+            return None
+
+    def _validate_device_table(self, table, want_shape) -> np.ndarray:
+        table = global_faults.corrupt(SITE_TPU_DISPATCH, table)
+        if not (isinstance(table, np.ndarray)
+                and table.shape == want_shape
+                and np.issubdtype(table.dtype, np.integer)):
+            raise DeviceResultError(
+                f"device returned shape "
+                f"{getattr(table, 'shape', None)}, want {want_shape}")
+        self.breaker.record_success()
+        set_dispatch_path(PATH_DEVICE)
+        return table
+
+    def guarded_launch(self, launch_fn) -> Optional[Tuple[Any]]:
+        """Phase 1 of the async dispatch ladder (tpu/pipeline.py):
+        breaker gate + fault hook + async launch (device_put + jitted
+        call, NO blocking readback). Returns an opaque in-flight handle
+        for guarded_complete, or None when the breaker is open or the
+        launch itself raised — same fallback semantics as
+        guarded_dispatch."""
+        if not self.breaker.allow():
+            self._breaker_open_fallback()
+            return None
+        try:
+            global_faults.fire(SITE_TPU_DISPATCH)
+            return (launch_fn(),)
+        except Exception as e:
+            self._record_dispatch_failure(e)
+            return None
+
+    def guarded_complete(self, handle: Optional[Tuple[Any]], readback_fn,
+                         want_shape) -> Optional[np.ndarray]:
+        """Phase 2: blocking readback + corrupt filter + shape/dtype
+        validation + breaker bookkeeping. A None handle (failed launch)
+        passes through as None — the caller scalar-completes, exactly
+        like a failed guarded_dispatch."""
+        if handle is None:
+            return None
+        try:
+            return self._validate_device_table(readback_fn(handle[0]),
+                                               want_shape)
+        except Exception as e:
+            self._record_dispatch_failure(e)
             return None
 
     def _dispatch(self, batch, padded_n: int) -> np.ndarray:
@@ -641,12 +854,20 @@ class TpuEngine:
                     # genuinely broken lands here. The cell reports
                     # per-rule ERROR; the rest of the batch is untouched.
                     cache[(pi, ci)] = None
+        # merge indexed by policy: each rule row only visits its own
+        # policy's completed cells, so the pass is O(rules + host_cells)
+        # instead of quadratic on large policy sets
+        by_policy: Dict[int, List[Tuple[int, Optional[Dict[str, int]]]]] = {}
+        for (pi, ci), verdicts in cache.items():
+            by_policy.setdefault(pi, []).append((ci, verdicts))
         for ri, entry in enumerate(self.cps.rules):
-            for (pi, ci), verdicts in cache.items():
-                if pi != entry.policy_idx:
-                    continue
-                if (entry.device_row is None or ri in self._exception_rules
-                        or total[ri, ci] == HOST):
+            cells = by_policy.get(entry.policy_idx)
+            if not cells:
+                continue
+            host_rule = (entry.device_row is None
+                         or ri in self._exception_rules)
+            for ci, verdicts in cells:
+                if host_rule or total[ri, ci] == HOST:
                     # pre-screened cells carry no verdict rows: the
                     # whole policy was unmatched (HOST must not escape)
                     total[ri, ci] = ERROR if verdicts is None \

@@ -1,0 +1,230 @@
+"""Pipelined scan — overlap host encode, device execute, and host
+completion across consecutive chunks.
+
+The serial scan loop alternates host and device idle time: encode
+chunk k -> dispatch -> BLOCKING readback -> assemble -> report, with
+the device idle during encode/assemble and the host idle during the
+readback wait. Hardware matching engines hide exactly this host
+preprocessing behind the matcher's execution (PAPERS: Hyperflex
+SIMD-DFA pipelines packet staging against automata execution); JAX's
+async dispatch gives us the same lever for free — as long as nobody
+calls ``np.asarray`` too early.
+
+Structure (double buffer, depth-bounded):
+
+- a worker thread encodes chunk k+1 while the device executes chunk k
+  (encode results ride a bounded queue, so encode can run at most
+  ``depth`` chunks ahead — backpressure, not unbounded memory);
+- the main loop launches chunk k (async ``device_put`` + jitted call,
+  NO readback) and only then drains chunk k-1: the blocking
+  ``np.asarray`` for k-1 overlaps the device executing k, and the
+  host completion + report-row generation for k-1 (the ``on_result``
+  callback) overlaps it too.
+
+Verdicts are bit-identical to the serial path: every chunk goes
+through the engine's guarded dispatch ladder (breaker, fault hook,
+corrupt filter, validation) split into its launch/complete phases, a
+failed chunk scalar-completes via ``assemble`` exactly like a failed
+serial dispatch, and an encode failure falls back to the serial
+quarantining scan for that chunk.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..observability.metrics import global_registry
+from ..observability.profiling import (PHASE_DISPATCH, PHASE_ENCODE,
+                                       PHASE_HOST_COMPLETE, PHASE_READBACK,
+                                       global_profiler)
+from ..observability.tracing import global_tracer
+from .engine import ScanResult, TpuEngine
+from .evaluator import ERROR, HOST
+
+# on_result(chunk_idx, ScanResult) — called in pipeline order (chunk 0
+# first), overlapping the device time of later chunks
+OnResult = Callable[[int, ScanResult], None]
+
+
+class PipelinedScanner:
+    """Drive a ShardedScanner's encode/step through the overlap
+    pipeline, completing verdicts with the TpuEngine ladder."""
+
+    def __init__(self, scanner, depth: int = 2):
+        self.scanner = scanner
+        self.engine = TpuEngine(cps=scanner.cps,
+                                exceptions=scanner.exceptions)
+        self.depth = max(1, depth)
+
+    def scan_chunks(
+        self,
+        chunks: Sequence[Sequence[Dict[str, Any]]],
+        namespace_labels: Optional[Dict[str, Dict[str, str]]] = None,
+        operations: Optional[Sequence[Sequence[str]]] = None,
+        on_result: Optional[OnResult] = None,
+    ) -> Dict[str, Any]:
+        """Scan ``chunks`` (a list of resource lists). Results are
+        delivered through ``on_result`` per chunk, in order; the
+        returned stats carry the phase split and the measured overlap
+        ratio ((encode+device+host seconds - wall) / wall — 0 means
+        strictly serial)."""
+        stats: Dict[str, Any] = {
+            "encode_s": 0.0, "device_s": 0.0, "host_s": 0.0,
+            "chunks": len(chunks), "resources": sum(len(c) for c in chunks),
+            "encode_fallback_chunks": 0, "overlap_ratio": 0.0,
+        }
+        if not chunks:
+            return stats
+        t_wall0 = time.perf_counter()
+        scan_span = global_tracer.start_span(
+            "pipelined_scan", chunks=len(chunks),
+            resources=stats["resources"])
+        scan_ctx = scan_span.context
+        enc_q: "queue.Queue[Tuple[int, Optional[Any]]]" = queue.Queue(
+            maxsize=self.depth)
+        stop = threading.Event()
+
+        def encode_worker() -> None:
+            # encode chunk k+1 while the device executes chunk k; the
+            # bounded queue is the double buffer (encode never runs
+            # more than `depth` chunks ahead)
+            for idx, chunk in enumerate(chunks):
+                if stop.is_set():
+                    return
+                t0 = time.perf_counter()
+                try:
+                    with global_profiler.phase(PHASE_ENCODE), \
+                            global_tracer.span("scan_encode",
+                                               parent=scan_ctx,
+                                               tile=len(chunk)):
+                        ops = list(operations[idx]) if operations else None
+                        batch, n = self.scanner.encode(
+                            chunk, namespace_labels, ops)
+                    payload: Optional[Any] = (batch, n)
+                except Exception:
+                    payload = None  # serial quarantining fallback
+                stats["encode_s"] += time.perf_counter() - t0
+                while not stop.is_set():
+                    try:
+                        enc_q.put((idx, payload), timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue  # consumer died: stop flag ends us
+
+        worker = threading.Thread(target=encode_worker, daemon=True,
+                                  name="scan-encode")
+        worker.start()
+        eng = self.engine
+        D = len(eng.cps.device_programs)
+        inflight: List[Tuple[int, Optional[Tuple[Any]], int]] = []
+
+        def drain() -> None:
+            idx, handle, n = inflight.pop(0)
+            chunk = chunks[idx]
+            ops = list(operations[idx]) if operations else None
+            t0 = time.perf_counter()
+            with global_profiler.phase(PHASE_READBACK), \
+                    global_tracer.span("scan_device_wait", parent=scan_ctx,
+                                       tile=n):
+                table = eng.guarded_complete(
+                    handle, lambda fut: np.asarray(fut)[:, :n], (D, n))
+            stats["device_s"] += time.perf_counter() - t0
+            global_registry.device_dispatch.observe(
+                time.perf_counter() - t0, {"engine": "scan"})
+            if table is None:
+                # breaker open / launch or readback failed: the WHOLE
+                # chunk scalar-completes, bit-identical to the serial
+                # ladder's all-HOST fallback
+                table = np.full((D, n), HOST, dtype=np.int32)
+                global_registry.pipeline_chunks.inc({"path": "fallback"})
+            else:
+                global_registry.pipeline_chunks.inc({"path": "device"})
+            t0 = time.perf_counter()
+            with global_profiler.phase(PHASE_HOST_COMPLETE), \
+                    global_tracer.span("scan_host_complete",
+                                       parent=scan_ctx, tile=n):
+                result = eng.assemble(table, chunk, namespace_labels, ops)
+            if on_result is not None:
+                on_result(idx, result)
+            stats["host_s"] += time.perf_counter() - t0
+
+        def serial_chunk(idx: int) -> None:
+            """Encode failed for this chunk: the engine's quarantining
+            scan (and, if even that raises, a per-rule ERROR table)
+            answers — the pipeline never aborts a scan."""
+            chunk = chunks[idx]
+            ops = list(operations[idx]) if operations else None
+            stats["encode_fallback_chunks"] += 1
+            global_registry.pipeline_chunks.inc({"path": "encode_fallback"})
+            t0 = time.perf_counter()
+            try:
+                result = eng.scan(chunk, namespace_labels, ops)
+            except Exception:
+                rules = [(e.policy_name, e.rule_name)
+                         for e in eng.cps.rules]
+                result = ScanResult(
+                    verdicts=np.full((len(rules), len(chunk)), ERROR,
+                                     dtype=np.int32),
+                    rules=rules)
+                # infrastructure failure, not content truth: callers
+                # (cluster/scanner.py) must not verdict-cache these rows
+                result.infra_error = True
+            if on_result is not None:
+                on_result(idx, result)
+            stats["host_s"] += time.perf_counter() - t0
+
+        try:
+            done = 0
+            while done < len(chunks):
+                idx, payload = enc_q.get()
+                done += 1
+                if payload is None:
+                    # keep result ordering: everything in flight lands
+                    # before the fallback chunk's rows are emitted
+                    while inflight:
+                        drain()
+                    serial_chunk(idx)
+                    continue
+                batch, n = payload
+                t0 = time.perf_counter()
+                with global_profiler.phase(PHASE_DISPATCH), \
+                        global_tracer.span("scan_dispatch",
+                                           parent=scan_ctx, tile=n):
+                    handle = eng.guarded_launch(
+                        lambda: self.scanner._step(
+                            self.scanner.put(batch))[0])
+                stats["device_s"] += time.perf_counter() - t0
+                inflight.append((idx, handle, n))
+                # double buffer: with chunk k launched, the readback +
+                # host completion of chunk k-1 overlaps k's device time
+                while len(inflight) > 1:
+                    drain()
+            while inflight:
+                drain()
+        except BaseException as e:
+            stop.set()
+            scan_span.set_status("error", f"{type(e).__name__}: {e}")
+            raise
+        finally:
+            stop.set()
+            # unblock a worker stuck on a full queue before joining
+            while True:
+                try:
+                    enc_q.get_nowait()
+                except queue.Empty:
+                    break
+            worker.join(timeout=30.0)
+            wall = time.perf_counter() - t_wall0
+            busy = stats["encode_s"] + stats["device_s"] + stats["host_s"]
+            stats["wall_s"] = wall
+            stats["overlap_ratio"] = round(
+                max(0.0, busy - wall) / wall, 4) if wall > 0 else 0.0
+            global_registry.pipeline_overlap.set(stats["overlap_ratio"])
+            scan_span.attributes["overlap_ratio"] = stats["overlap_ratio"]
+            global_tracer.end_span(scan_span)
+        return stats

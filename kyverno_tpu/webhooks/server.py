@@ -128,7 +128,8 @@ class Handlers:
                 scalar_fallback=self._scalar_verdict_rows,
                 config=cfg,
                 metrics=self.metrics,
-                version_provider=self._pin_version)
+                version_provider=self._pin_version,
+                cache_lookup=self._cached_verdict_rows)
 
     # -- versioned engine acquisition (lifecycle/manager.py)
 
@@ -160,6 +161,37 @@ class Handlers:
             return self.lifecycle.acquire()
         except PolicySetUnavailable:
             return None
+
+    def _cached_verdict_rows(self, payload: AdmissionPayload):
+        """Submit-time verdict-cache lookup (tpu/cache.py): a repeat
+        admission of a content-identical manifest under the active
+        compiled version answers instantly — no queue, no flush, no
+        device. None on miss/ineligible; the request then batches
+        normally and its flush populates the cache."""
+        from ..tpu.cache import global_verdict_cache
+
+        if not global_verdict_cache.enabled:
+            return None  # --verdict-cache-size 0 must cost nothing
+        version = self.lifecycle.active  # wait-free; never compiles
+        if version is None:
+            return None
+        eng = version.engine
+        if not eng.cache_eligible:
+            return None  # before the O(snapshot) namespace-label walk
+        res = payload.old if (payload.operation == "DELETE" and payload.old) \
+            else payload.resource
+        ns_labels = self.snapshot.namespace_labels() if self.snapshot else {}
+        keys = eng.verdict_cache_keys(
+            [res], ns_labels, [payload.operation], [payload.info])
+        if keys is None or keys[0] is None:
+            return None
+        col = global_verdict_cache.get(keys[0])
+        if col is None:
+            return None
+        return VerdictRows(
+            [((e.policy_name, e.rule_name), int(col[row]))
+             for row, e in enumerate(eng.cps.rules)],
+            version=version)
 
     def _engine(self) -> Tuple[int, TpuEngine]:
         ver = self.lifecycle.acquire()
@@ -366,10 +398,29 @@ class Handlers:
             "jit_built": active.engine.cps._fn is not None,
             "policies": [p.name for p in active.engine.cps.policies],
         }]
+        from ..observability.metrics import global_registry as _reg
+        from ..tpu.cache import (global_encode_cache, global_verdict_cache,
+                                 xla_cache_dir)
+
         state: Dict[str, Any] = {
             "engine_toggle": self.toggles.engine,
             "breaker": {"name": breaker.name, "state": breaker.state},
             "compile_cache": compile_cache,
+            "perf_caches": {
+                "verdict": {
+                    "size": len(global_verdict_cache),
+                    "hits": _reg.verdict_cache.value({"outcome": "hit"}),
+                    "misses": _reg.verdict_cache.value({"outcome": "miss"}),
+                    "evictions": global_verdict_cache.evictions,
+                },
+                "encode": {
+                    "size": len(global_encode_cache),
+                    "hits": _reg.encode_cache.value({"outcome": "hit"}),
+                    "misses": _reg.encode_cache.value({"outcome": "miss"}),
+                    "evictions": global_encode_cache.evictions,
+                },
+                "xla_cache_dir": xla_cache_dir(),
+            },
             "policyset": self.lifecycle.state(),
             "faults_armed": {
                 site: {"mode": spec.mode, "calls": spec.calls,

@@ -123,9 +123,31 @@ try:
     assert state["faults_armed"].get("tpu.dispatch", {}).get("fired", 0) > 0
     text = get(met, "/metrics")[1].decode()
     assert "kyverno_tpu_breaker_fallback_total" in text
+
+    # verdict-cache metrics under admission load: repeated identical
+    # reviews must produce hit-labeled lookups on /metrics, and the
+    # pipelined background scan must publish its overlap gauge. Two
+    # full scans of an unchanged snapshot: the second must be >=90%
+    # cache-served (the repeat-scan amortization acceptance)
+    POD = {"apiVersion": "v1", "kind": "Pod",
+           "metadata": {"name": "scanme", "namespace": "d", "uid": "u-scan"},
+           "spec": {"containers": [{"name": "c", "image": "nginx"}]}}
+    assert post(met, "/snapshot/upsert", json.dumps(POD))[0] == 200
+    assert post(met, "/scan", json.dumps({"full": True}))[0] == 200
+    assert post(met, "/scan", json.dumps({"full": True}))[0] == 200
+    text = get(met, "/metrics")[1].decode()
+    assert 'kyverno_tpu_verdict_cache_total{outcome="hit"}' in text, \
+        "verdict cache hit counter missing from /metrics"
+    assert 'kyverno_tpu_verdict_cache_total{outcome="miss"}' in text
+    assert "kyverno_tpu_pipeline_overlap_ratio" in text and \
+        "kyverno_tpu_pipeline_chunks_total" in text, \
+        "pipeline metrics missing from /metrics"
+    perf = json.loads(get(met, "/debug/state")[1])["perf_caches"]
+    assert perf["verdict"]["hits"] >= 1
     print(f"OBS SMOKE OK: {scrapes} live scrapes, "
           f"{len(fallback_spans)} fallback spans, "
-          f"breaker={state['breaker']['state']}")
+          f"breaker={state['breaker']['state']}, "
+          f"verdict_cache={perf['verdict']}")
 finally:
     cp.stop()
 EOF

@@ -15,3 +15,32 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+# the content-addressed verdict/encode caches (tpu/cache.py) are
+# process-global by design; tests must not see each other's entries
+# (an engine mutated out-of-band — monkeypatched oracle, spied
+# device_fn — shares its content key with the unmutated one)
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_perf_caches():
+    from kyverno_tpu.tpu.cache import (global_encode_cache,
+                                       global_verdict_cache)
+
+    global_verdict_cache.clear()
+    global_encode_cache.clear()
+    yield
+
+
+@pytest.fixture
+def no_verdict_cache():
+    """Opt-out for tests that count device dispatches on repeat scans
+    of identical content (the cache legitimately skips those)."""
+    from kyverno_tpu.tpu.cache import global_verdict_cache
+
+    cap = global_verdict_cache._lru.capacity
+    global_verdict_cache.set_capacity(0)
+    yield
+    global_verdict_cache.set_capacity(cap)

@@ -301,7 +301,8 @@ def _mk_engine():
     return TpuEngine([ClusterPolicy.from_dict(POLICY_DOC)])
 
 
-def test_tpu_dispatch_fault_trips_breaker_and_verdicts_stay_identical():
+def test_tpu_dispatch_fault_trips_breaker_and_verdicts_stay_identical(
+        no_verdict_cache):
     eng = _mk_engine()
     eng.breaker.reset(failure_threshold=2, reset_timeout_s=60.0)
     resources = [_pod("a", True), _pod("b", False)]
@@ -318,7 +319,7 @@ def test_tpu_dispatch_fault_trips_breaker_and_verdicts_stay_identical():
     assert global_faults.armed()["tpu.dispatch"].fired == fired_before
 
 
-def test_tpu_dispatch_corrupt_shape_is_a_device_failure():
+def test_tpu_dispatch_corrupt_shape_is_a_device_failure(no_verdict_cache):
     eng = _mk_engine()
     eng.breaker.reset(failure_threshold=1, reset_timeout_s=0.0)
     resources = [_pod("a", True), _pod("b", False)]

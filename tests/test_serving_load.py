@@ -30,7 +30,10 @@ def _requests():
     return out
 
 
-def test_load_batched_equals_scalar_without_recompile():
+def test_load_batched_equals_scalar_without_recompile(no_verdict_cache):
+    # cache off: this test measures COALESCING (mean batch size, jit
+    # cache stability) — the verdict cache would legitimately answer
+    # repeat reviews at submit() and starve the queue it is probing
     from kyverno_tpu.webhooks.server import _payload_from_request
 
     batched = _mk_handlers(batching=True, max_batch_size=32, max_wait_ms=20.0)

@@ -363,7 +363,7 @@ def test_host_fallback_rules_complete():
     assert eng.coverage() == (1, 2)
 
 
-def test_engine_buckets_batch_shapes(monkeypatch):
+def test_engine_buckets_batch_shapes(monkeypatch, no_verdict_cache):
     """Two odd-sized batches must reuse one compiled shape (SURVEY §7
     recompilation churn: bucketing lives in the engine, not in caller
     convention)."""
