@@ -1079,7 +1079,44 @@ def run_all():
         "encode_hits": _reg.encode_cache.value({"outcome": "hit"}),
         "encode_misses": _reg.encode_cache.value({"outcome": "miss"}),
     }
+    # policy-observatory rollups: what the whole run taught the rule
+    # analytics + where the device feed stood (the encode-pool target
+    # metric) — always in the artifact, even with legs filtered out
+    try:
+        out["rule_stats"] = _rule_stats_rollup()
+    except Exception as e:  # noqa: BLE001
+        out["rule_stats"] = {"error": repr(e)[:300]}
+    try:
+        out["feed_starvation"] = _feed_starvation_rollup()
+    except Exception as e:  # noqa: BLE001
+        out["feed_starvation"] = {"error": repr(e)[:300]}
     emit(out)
+
+
+def _rule_stats_rollup():
+    from kyverno_tpu.observability.analytics import global_rule_stats
+
+    report = global_rule_stats.report(top=5)
+    return {
+        "rules_tracked": report["rules_tracked"],
+        "never_fired": len(report["never_fired"]),
+        "top": [{"policy": r["policy"], "rule": r["rule"],
+                 "fired": r["fired"], "fail": r["fail"]}
+                for r in report["top"]],
+        "policies": len(report["policies"]),
+    }
+
+
+def _feed_starvation_rollup():
+    from kyverno_tpu.observability.analytics import global_starvation
+    from kyverno_tpu.observability.metrics import global_registry as _reg
+
+    state = global_starvation.state()
+    return {
+        "ratio": state["ratio"],
+        "seconds_total": state["seconds_total"],
+        "pipeline_overlap_ratio": _reg.pipeline_overlap.value(),
+    }
 
 
 def _emit_phase_split():
@@ -1154,7 +1191,15 @@ def main():
     if config == "coverage":
         emit(mixed_corpus_coverage())
         return
-    emit(FNS[config]())
+    out = FNS[config]()
+    try:
+        # single-config runs carry the observatory rollups too, not
+        # just the full driver artifact
+        out["rule_stats"] = _rule_stats_rollup()
+        out["feed_starvation"] = _feed_starvation_rollup()
+    except Exception:  # noqa: BLE001
+        pass
+    emit(out)
     if want_phases:
         _emit_phase_split()
 

@@ -81,6 +81,10 @@ def add_parser(sub: argparse._SubParsersAction) -> None:
                    help="print a per-phase latency breakdown table "
                         "(encode/compile/dispatch/readback/host) after "
                         "the scan")
+    p.add_argument("--rule-stats", action="store_true",
+                   help="print per-rule analytics after the run: evals, "
+                        "pass/fail/error counts, never-fired rules, and "
+                        "device vs host placement (policy observatory)")
     p.add_argument("--xla-trace", default=None, metavar="DIR",
                    help="capture one jax.profiler trace of the validate "
                         "stage into DIR (XLA-level profiling)")
@@ -263,6 +267,11 @@ def run(args: argparse.Namespace) -> int:
         from ..observability.profiling import global_profiler
 
         global_profiler.reset()
+    if getattr(args, "rule_stats", False):
+        # scope the analytics to THIS apply run
+        from ..observability.analytics import global_rule_stats
+
+        global_rule_stats.reset()
     resource_docs, mutate_rows = _apply_mutations(policies, resource_docs)
     registry_client = None
     if getattr(args, "registry_fixture", None):
@@ -331,6 +340,12 @@ def run(args: argparse.Namespace) -> int:
 
         print(global_profiler.render_table(
             "per-phase latency breakdown (apply --profile)"),
+            file=sys.stderr)
+    if getattr(args, "rule_stats", False):
+        from ..observability.analytics import global_rule_stats
+
+        print(global_rule_stats.render_table(
+            title="per-rule analytics (apply --rule-stats)"),
             file=sys.stderr)
     if counts["error"]:
         return 3

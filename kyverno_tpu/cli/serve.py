@@ -87,6 +87,26 @@ def add_parser(sub: argparse._SubParsersAction) -> None:
                    help="encode-row LRU capacity in entries "
                         "(default $KYVERNO_TPU_ENCODE_CACHE or 8192; "
                         "0 disables)")
+    # policy observatory (observability/analytics.py): SLO targets for
+    # the kyverno_slo_* burn-rate gauges + /readyz state, and the
+    # cardinality bound on the per-policy kyverno_rule_* metrics
+    p.add_argument("--slo-admission-p99-ms", type=float, default=50.0,
+                   help="admission latency SLO target: requests slower "
+                        "than this burn the error budget")
+    p.add_argument("--slo-admission-budget", type=float, default=0.01,
+                   help="fraction of admissions allowed over the latency "
+                        "target (burn rate 1.0 = exactly this rate)")
+    p.add_argument("--slo-scan-freshness-s", type=float, default=300.0,
+                   help="background-scan freshness SLO target: seconds "
+                        "since the last completed scan tick")
+    p.add_argument("--slo-device-coverage-floor", type=float, default=0.9,
+                   help="minimum fraction of compiled rules expected on "
+                        "the device path")
+    p.add_argument("--rule-metrics-top-k", type=int, default=None,
+                   metavar="K",
+                   help="per-policy kyverno_rule_* metric series kept "
+                        "before collapsing into the _overflow bucket "
+                        "(default $KYVERNO_TPU_RULE_METRICS_TOPK or 20)")
     p.set_defaults(func=run)
 
 
@@ -276,6 +296,16 @@ def run(args: argparse.Namespace) -> int:
 
     configure_caches(verdict_capacity=args.verdict_cache_size,
                      encode_capacity=args.encode_cache_size)
+    # observatory targets before traffic: the SLO windows and the rule-
+    # metric cardinality bound are process-global like the caches
+    from ..observability.analytics import global_slo
+
+    global_slo.config.admission_p99_target_ms = args.slo_admission_p99_ms
+    global_slo.config.admission_error_budget = args.slo_admission_budget
+    global_slo.config.scan_freshness_target_s = args.slo_scan_freshness_s
+    global_slo.config.device_coverage_floor = args.slo_device_coverage_floor
+    if args.rule_metrics_top_k is not None:
+        global_registry.rule_stats.top_k = args.rule_metrics_top_k
     xla_dir = enable_xla_compile_cache(args.xla_cache_dir)
     if xla_dir:
         print(f"persistent XLA compile cache: {xla_dir}", file=sys.stderr)

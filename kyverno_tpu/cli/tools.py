@@ -15,6 +15,9 @@
   local OCI image-layout directory with the kyverno media types
   (internal/annotations.go: config v1+json, policy layer v1+yaml).
   Zero-egress: the layout directory stands in for a remote registry.
+- ``top``: TPU-native extra — a live terminal view of the policy
+  observatory (hot/never-fired rules, feed starvation, SLO burn) polled
+  from a running serve's metrics-port debug surface.
 """
 
 from __future__ import annotations
@@ -334,6 +337,104 @@ def run_oci(args: argparse.Namespace) -> int:
 
 
 # ---------------------------------------------------------------------------
+# top — live policy-observatory view against a running serve
+
+
+def _http_get_json(host: str, port: int, path: str, timeout: float = 10.0):
+    import http.client
+
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        body = resp.read()
+    finally:
+        conn.close()
+    if resp.status >= 400:
+        raise RuntimeError(f"GET {path} -> {resp.status}")
+    return json.loads(body)
+
+
+def _render_top(rules: Dict[str, Any], util: Dict[str, Any],
+                ready: Dict[str, Any], n: int) -> str:
+    lines: List[str] = []
+    starv = util.get("feed_starvation") or {}
+    pipe = util.get("pipeline") or {}
+    slo = util.get("slo") or {}
+    adm = (slo.get("admission") or {}).get("windows") or {}
+    fresh = slo.get("scan_freshness") or {}
+    cov = slo.get("device_coverage") or {}
+    ps = ready.get("policyset") or {}
+    lines.append(
+        f"kyverno-tpu top — revision {ps.get('active_revision', '?')}"
+        f"  rules tracked {rules.get('rules_tracked', 0)}"
+        f"  breaker {ready.get('breaker', '?')}")
+    lines.append(
+        f"feed starvation {starv.get('ratio', 0.0):.3f}"
+        f"  pipeline overlap {pipe.get('overlap_ratio', 0.0):.3f}"
+        f"  device coverage "
+        f"{cov.get('ratio') if cov.get('ratio') is not None else '-'}"
+        f" (floor {cov.get('floor', '-')})")
+    burn = "  ".join(
+        f"burn[{w}]={v.get('burn_rate', 0.0):.2f} "
+        f"p99={v.get('p99_ms', 0.0):.1f}ms" for w, v in sorted(adm.items()))
+    freshness = fresh.get("seconds_since_scan")
+    lines.append(
+        (burn or "no admission traffic")
+        + f"  scan freshness "
+          f"{freshness if freshness is not None else '-'}s")
+    breached = slo.get("breached") or []
+    if breached:
+        lines.append(f"SLO BURNING: {', '.join(breached)}")
+    lines.append("")
+    header = f"{'POLICY/RULE':<52}{'FIRED':>8}{'FAIL':>8}{'ERR':>6}" \
+             f"{'EVALS':>10}  WHERE"
+    lines.append(header)
+    for r in (rules.get("top") or [])[:n]:
+        name = f"{r['policy']}/{r['rule']}"
+        lines.append(f"{name[:51]:<52}{r['fired']:>8}{r['fail']:>8}"
+                     f"{r['error']:>6}{r['evals']:>10}  "
+                     f"{'device' if r.get('on_device') else 'host'}")
+    never = rules.get("never_fired") or []
+    if never:
+        names = ", ".join(f"{r['policy']}/{r['rule']}" for r in never[:8])
+        more = f" (+{len(never) - 8} more)" if len(never) > 8 else ""
+        lines.append("")
+        lines.append(f"never fired ({len(never)}): {names}{more}")
+    return "\n".join(lines)
+
+
+def run_top(args: argparse.Namespace) -> int:
+    """`kyverno-tpu top` — poll a running serve's metrics-port debug
+    surface (/debug/rules, /debug/utilization, /readyz) and render a
+    live terminal view of the policy observatory."""
+    import time as _time
+
+    iterations = args.iterations
+    i = 0
+    while True:
+        try:
+            rules = _http_get_json(args.host, args.port,
+                                   f"/debug/rules?top={args.top}")
+            util = _http_get_json(args.host, args.port, "/debug/utilization")
+            try:
+                ready = _http_get_json(args.host, args.port, "/readyz")
+            except Exception:
+                ready = {}  # 503 still renders; readiness is advisory
+        except Exception as e:
+            print(f"cannot reach serve metrics port "
+                  f"{args.host}:{args.port}: {e}", file=sys.stderr)
+            return 1
+        if not args.no_clear:
+            sys.stdout.write("\x1b[2J\x1b[H")
+        print(_render_top(rules, util, ready, args.top))
+        i += 1
+        if iterations and i >= iterations:
+            return 0
+        _time.sleep(args.interval)
+
+
+# ---------------------------------------------------------------------------
 # parser wiring
 
 
@@ -376,3 +477,20 @@ def add_parsers(sub) -> None:
     oci.add_argument("--output", "-o", default=".",
                      help="directory to pull policies into")
     oci.set_defaults(func=run_oci)
+
+    top = sub.add_parser(
+        "top", help="live policy-observatory view against a running serve")
+    top.add_argument("--host", default="127.0.0.1",
+                     help="serve metrics host")
+    top.add_argument("--port", type=int, default=8000,
+                     help="serve metrics port (the /debug surface)")
+    top.add_argument("--interval", type=float, default=2.0,
+                     help="seconds between refreshes")
+    top.add_argument("--top", type=int, default=20,
+                     help="hot rules shown")
+    top.add_argument("--iterations", type=int, default=0,
+                     help="stop after N refreshes (0 = run until ^C)")
+    top.add_argument("--no-clear", action="store_true",
+                     help="append frames instead of clearing the screen "
+                          "(log-friendly)")
+    top.set_defaults(func=run_top)

@@ -195,6 +195,13 @@ class BackgroundScanService:
             else:
                 self.stats["skipped_clean"] += 1
         if not todo:
+            # a clean tick is still a completed scan: freshness resets
+            try:
+                from ..observability.analytics import global_slo
+
+                global_slo.record_scan()
+            except Exception:
+                pass
             return 0
         import numpy as np
 
@@ -264,9 +271,16 @@ class BackgroundScanService:
                     hit_entries.append(entry)
                     hit_cols.append(col)
         if hit_entries:
-            report(hit_entries, ScanResult(
-                verdicts=np.stack(hit_cols, axis=1), rules=rules))
+            hit_table = np.stack(hit_cols, axis=1)
+            report(hit_entries, ScanResult(verdicts=hit_table, rules=rules))
             self.stats["verdict_cache_hits"] += len(hit_entries)
+            # cache-served verdicts still count: replay the hit columns
+            # into the rule analytics so a warm rescan reports the same
+            # per-rule stats as the cold scan that populated the cache
+            from ..observability.analytics import global_rule_stats
+
+            global_rule_stats.ingest_table(eng.rule_idents(), hit_table,
+                                           source="cached")
         if miss:
             chunks, chunk_keys = [], []
             for start in range(0, len(miss), self.batch_size):
@@ -317,7 +331,23 @@ class BackgroundScanService:
         total = len(todo)
         self.stats["scans"] += 1
         self.stats["resources_scanned"] += total
+        self._record_slo(eng)
         return total
+
+    def _record_slo(self, eng) -> None:
+        """Scan-freshness + device-coverage SLO inputs: every completed
+        scan tick stamps the freshness clock and republishes the active
+        compiled set's device coverage."""
+        try:
+            from ..observability.analytics import (global_slo,
+                                                   global_starvation)
+
+            dev, total_rules = eng.coverage()
+            global_slo.record_scan(
+                coverage=(dev / total_rules) if total_rules else 1.0)
+            self.stats["feed_starvation"] = global_starvation.ratio()
+        except Exception:
+            pass  # observability must never fail a scan tick
 
     def run(self, interval_s: float = 30.0, stop=None) -> None:
         """Blocking scan loop (the Run(ctx, workers) equivalent)."""

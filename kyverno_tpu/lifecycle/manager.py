@@ -500,6 +500,17 @@ class PolicySetLifecycleManager:
             self.metrics.policyset_swaps.inc()
         self.metrics.policyset_revision.set(snap.revision)
         self._publish_quarantine()
+        # SLO surface: the swapped-in set's device coverage is the
+        # coverage-floor SLO input (a quarantine-heavy or unloweable
+        # set burning the floor shows up before latency does)
+        try:
+            from ..observability.analytics import global_slo
+
+            dev, total = engine.coverage()
+            global_slo.set_device_coverage(
+                (dev / total) if total else 1.0)
+        except Exception:
+            pass
         global_tracer.record_span(
             "policyset.swap", now, time.monotonic(),
             from_revision=prior.revision if prior else None,

@@ -1,6 +1,11 @@
 """Observability: events, metrics, tracing, profiling (pkg/event,
-pkg/metrics, pkg/tracing equivalents + the SURVEY §5 phase split)."""
+pkg/metrics, pkg/tracing equivalents + the SURVEY §5 phase split) and
+the policy observatory (analytics: per-rule stats, feed starvation,
+SLO burn rates)."""
 
+from .analytics import (RuleIdent, RuleStatsAccumulator, SloTracker,
+                        StarvationTracker, global_rule_stats, global_slo,
+                        global_starvation)
 from .events import Event, EventGenerator
 from .metrics import MetricsRegistry, global_registry
 from .profiling import PhaseProfiler, global_profiler

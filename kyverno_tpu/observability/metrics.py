@@ -54,6 +54,12 @@ class Counter:
         with self._lock:
             return self._values.get(_labels_key(labels), 0.0)
 
+    def series(self) -> List[Tuple[Dict[str, str], float]]:
+        """Every labeled series as (labels dict, value) — the
+        programmatic enumeration /debug/utilization renders from."""
+        with self._lock:
+            return [(dict(k), v) for k, v in sorted(self._values.items())]
+
     def collect(self) -> List[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
         with self._lock:
@@ -189,6 +195,44 @@ class MetricsRegistry:
         self.pipeline_chunks = self.counter(
             "kyverno_tpu_pipeline_chunks_total",
             "pipelined scan chunks by how they resolved")
+        # policy observatory (observability/analytics.py): device feed
+        # starvation — the fraction of device-relevant wall time the
+        # accelerator sat idle waiting on host encode (rolling window;
+        # the headline metric for the encode-pool roadmap item) — plus
+        # continuously-incremented utilization attribution per phase
+        self.feed_starvation = self.gauge(
+            "kyverno_tpu_feed_starvation_ratio",
+            "fraction of device-relevant wall time the device was idle "
+            "waiting on host encode (rolling window, 0-1)")
+        self.utilization_seconds = self.counter(
+            "kyverno_tpu_utilization_seconds_total",
+            "scan-ladder wall seconds by phase "
+            "(encode_wait/device_busy/readback/host_assemble)")
+        self.serving_flusher_seconds = self.counter(
+            "kyverno_serving_flusher_seconds_total",
+            "admission flusher wall seconds by state "
+            "(wait_queue/evaluate/resolve/request_queue_wait)")
+        # SLO layer (observability/analytics.py SloTracker): rolling-
+        # window multi-rate burn-rate gauges; state also rides /readyz
+        self.slo_admission_p99 = self.gauge(
+            "kyverno_slo_admission_latency_p99_seconds",
+            "admission p99 latency over the rolling window, by window")
+        self.slo_admission_burn = self.gauge(
+            "kyverno_slo_admission_burn_rate",
+            "admission latency error-budget burn rate (1.0 = burning "
+            "exactly the budget), by window")
+        self.slo_scan_freshness = self.gauge(
+            "kyverno_slo_scan_freshness_seconds",
+            "seconds since the last completed background scan")
+        self.slo_scan_freshness_burn = self.gauge(
+            "kyverno_slo_scan_freshness_burn_rate",
+            "scan freshness / target (>1 = scans running stale)")
+        self.slo_device_coverage = self.gauge(
+            "kyverno_slo_device_coverage_ratio",
+            "fraction of compiled rules running on the device path")
+        self.slo_breached = self.gauge(
+            "kyverno_slo_breached",
+            "1 when the named SLO is currently burning past budget")
         # serving pipeline instruments (serving/batcher.py): queue
         # depth, batch occupancy, flush reasons, shed/expiry counters,
         # and submit-to-verdict latency (p50-p99 read from buckets)
@@ -263,6 +307,23 @@ class MetricsRegistry:
         self.events_dropped = self.counter(
             "kyverno_events_dropped_total",
             "policy events dropped on queue overflow")
+        # per-rule analytics exposition: a scrape-time pseudo-instrument
+        # rendering kyverno_rule_* / kyverno_policy_device_coverage with
+        # bounded label cardinality (top-K policies + one _overflow
+        # series). Lazy import: analytics must stay importable first.
+        from .analytics import RuleStatsCollector
+
+        self.rule_stats = RuleStatsCollector()
+        self._instruments["kyverno_rule_stats"] = self.rule_stats
+        # pre-collect hooks: window-decaying gauges (SLO burn rates,
+        # starvation ratio) refresh here so a scrape between records
+        # still sees live values
+        self._collect_hooks: List[Any] = []
+
+    def add_collect_hook(self, fn) -> None:
+        with self._lock:
+            if fn not in self._collect_hooks:
+                self._collect_hooks.append(fn)
 
     def counter(self, name: str, help_: str) -> Counter:
         with self._lock:
@@ -300,6 +361,12 @@ class MetricsRegistry:
         lines: List[str] = []
         with self._lock:
             insts = list(self._instruments.values())
+            hooks = list(getattr(self, "_collect_hooks", ()))
+        for hook in hooks:
+            try:
+                hook()
+            except Exception:
+                pass  # a broken hook must not break the scrape
         for inst in insts:
             lines.extend(inst.collect())  # type: ignore[attr-defined]
         return "\n".join(lines) + "\n"

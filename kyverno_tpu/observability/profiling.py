@@ -27,10 +27,14 @@ PHASE_COMPILE = "compile"
 PHASE_DISPATCH = "dispatch"
 PHASE_READBACK = "readback"
 PHASE_HOST_COMPLETE = "host_complete"
+# pipelined-scan consumer idle time: the main loop blocked on the
+# encode queue with nothing in flight — the device was starving
+# (observability/analytics.py StarvationTracker owns the windowed view)
+PHASE_ENCODE_WAIT = "encode_wait"
 
 # canonical print order; unknown phases sort after these
-PHASE_ORDER = (PHASE_ENCODE, PHASE_COMPILE, PHASE_DISPATCH, PHASE_READBACK,
-               PHASE_HOST_COMPLETE)
+PHASE_ORDER = (PHASE_ENCODE, PHASE_ENCODE_WAIT, PHASE_COMPILE,
+               PHASE_DISPATCH, PHASE_READBACK, PHASE_HOST_COMPLETE)
 
 
 class PhaseProfiler:

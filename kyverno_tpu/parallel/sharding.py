@@ -205,9 +205,20 @@ class ShardedScanner:
         except Exception:
             return eng.scan(resources, namespace_labels, operations)
         D = len(self.cps.device_programs)
-        table = eng.guarded_dispatch(
-            lambda: np.asarray(self._step(self.put(batch))[0])[:, :n],
-            (D, n))
+
+        def run():
+            from ..observability.analytics import class_counts
+
+            v, c = self._step(self.put(batch))
+            v = np.asarray(v)
+            # the step's cross-device reduction doubles as the rule-
+            # analytics source: drop the mesh-pad columns and stash for
+            # the assemble() below
+            eng.set_pending_counts(
+                np.asarray(c).astype(np.int64) - class_counts(v[:, n:]))
+            return v[:, :n].astype(np.int32)
+
+        table = eng.guarded_dispatch(run, (D, n))
         if table is None:
             table = np.full((D, len(resources)), HOST, dtype=np.int32)
         return eng.assemble(table, resources, namespace_labels, operations)
@@ -267,6 +278,8 @@ class ShardedScanner:
             "scan_stream", resources=n, tile=tile)
         scan_ctx = scan_span.context
 
+        from ..observability.analytics import global_starvation
+
         def drain():
             dv, sl, nv = pending.pop(0)
             t0 = time.perf_counter()
@@ -274,7 +287,9 @@ class ShardedScanner:
                     global_tracer.span("scan_device_wait", parent=scan_ctx,
                                        tile=nv):
                 table = np.asarray(dv)[:, :nv]  # blocks on the device
-            stats["device_s"] += time.perf_counter() - t0
+            dt = time.perf_counter() - t0
+            stats["device_s"] += dt
+            global_starvation.record(busy_s=dt)
             if eng is not None:
                 t0 = time.perf_counter()
                 with global_profiler.phase(PHASE_HOST_COMPLETE), \
@@ -305,7 +320,14 @@ class ShardedScanner:
                     if operations:
                         ops = list(operations[sl]) + [""] * (tile - nv)
                     batch, _ = self.encode(padded, namespace_labels, ops)
-                stats["encode_s"] += time.perf_counter() - t0
+                enc_dt = time.perf_counter() - t0
+                stats["encode_s"] += enc_dt
+                if not pending:
+                    # no tile in flight while this one encoded: the
+                    # device sat idle waiting on the host — feed
+                    # starvation (with tiles in flight the encode hides
+                    # behind device time and costs nothing)
+                    global_starvation.record(starved_s=enc_dt)
                 # async sharded put then dispatch: the H2D copy of tile
                 # k+1 overlaps the device compute of tiles k, k-1, ...
                 with global_profiler.phase(PHASE_DISPATCH), \

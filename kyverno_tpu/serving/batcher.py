@@ -129,8 +129,10 @@ class AdmissionPipeline:
                 with self._stats_lock:
                     self.stats["cache_hits"] = \
                         self.stats.get("cache_hits", 0) + 1
+                dt = time.monotonic() - t0
                 self.metrics.serving_request_latency.observe(
-                    time.monotonic() - t0, {"path": "cached"})
+                    dt, {"path": "cached"})
+                self._record_slo(dt)
                 return cached
         budget = (deadline_ms if deadline_ms is not None
                   else self.config.deadline_ms) / 1000.0
@@ -156,9 +158,10 @@ class AdmissionPipeline:
                                             parent=root.context,
                                             reason="shed"):
                         out = self._scalar(payload)
+                    dt = time.monotonic() - t0
                     self.metrics.serving_request_latency.observe(
-                        time.monotonic() - t0, {"path": "shed"},
-                        exemplar=exemplar)
+                        dt, {"path": "shed"}, exemplar=exemplar)
+                    self._record_slo(dt)
                     return out
                 self.metrics.serving_shed_total.inc({"outcome": "rejected"})
                 raise
@@ -175,11 +178,24 @@ class AdmissionPipeline:
                 if not req.event.wait(grace):
                     raise DeadlineExceededError(
                         "admission batch evaluation timed out")
+            dt = time.monotonic() - t0
             self.metrics.serving_request_latency.observe(
-                time.monotonic() - t0, {"path": "batched"}, exemplar=exemplar)
+                dt, {"path": "batched"}, exemplar=exemplar)
+            self._record_slo(dt)
             if isinstance(req.result, BaseException):
                 raise req.result
             return req.result
+
+    @staticmethod
+    def _record_slo(latency_s: float) -> None:
+        """Feed the admission-latency SLO window (every path a request
+        can resolve through: batched, cached, shed-to-scalar)."""
+        try:
+            from ..observability.analytics import global_slo
+
+            global_slo.record_admission(latency_s)
+        except Exception:
+            pass
 
     def stop(self) -> None:
         with self.queue.cv:
@@ -220,7 +236,10 @@ class AdmissionPipeline:
                         reason = "shutdown"
                         break
                     if oldest is None:
+                        t_w = time.monotonic()
                         self.queue.cv.wait()
+                        self.metrics.serving_flusher_seconds.inc(
+                            {"state": "wait_queue"}, time.monotonic() - t_w)
                         continue
                     now = time.monotonic()
                     # deadline-aware: flush when the timer matures OR —
@@ -234,7 +253,10 @@ class AdmissionPipeline:
                         reason = "timer" if timer_at <= deadline_at \
                             else "deadline"
                         break
+                    t_w = time.monotonic()
                     self.queue.cv.wait(flush_at - now)
+                    self.metrics.serving_flusher_seconds.inc(
+                        {"state": "wait_queue"}, time.monotonic() - t_w)
                 batch = self.queue.drain(cfg.max_batch_size)
                 drained_at = time.monotonic()
                 stopped = self._stopped
@@ -263,6 +285,12 @@ class AdmissionPipeline:
                     "admission.queue_wait", req.enqueued_at,
                     req.drained_at or now, parent=req.trace_ctx,
                     flush_reason=reason)
+        # queue-occupancy attribution: aggregate request-seconds spent
+        # queued, scrapeable next to the flusher's own state split
+        self.metrics.serving_flusher_seconds.inc(
+            {"state": "request_queue_wait"},
+            sum(max(0.0, (req.drained_at or now) - req.enqueued_at)
+                for req in batch))
         live: List[QueuedRequest] = []
         for req in batch:
             if req.deadline <= now:
@@ -323,6 +351,8 @@ class AdmissionPipeline:
                 raise RuntimeError("batch evaluator returned wrong arity")
         except BaseException as e:  # propagate to every waiter
             t_eval1 = time.monotonic()
+            self.metrics.serving_flusher_seconds.inc(
+                {"state": "evaluate"}, t_eval1 - t_eval0)
             for req in live:
                 req.resolve(e)
             self._record_flush_spans(live, reason, bucket, now, t_eval0,
@@ -330,10 +360,14 @@ class AdmissionPipeline:
                                      revision=pin_rev)
             return
         t_eval1 = time.monotonic()
+        self.metrics.serving_flusher_seconds.inc(
+            {"state": "evaluate"}, t_eval1 - t_eval0)
         t_resolve0 = time.monotonic()
         for req, result in zip(live, results):
             req.resolve(result)
         t_resolve1 = time.monotonic()
+        self.metrics.serving_flusher_seconds.inc(
+            {"state": "resolve"}, t_resolve1 - t_resolve0)
         # span recording (and any exporter I/O it triggers) happens
         # AFTER every waiter is woken: the spans carry explicit
         # timestamps, so ordering costs nothing — doing it first would

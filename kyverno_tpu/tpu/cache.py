@@ -196,6 +196,15 @@ class VerdictCache:
         """Count a scan that skipped the cache (ineligible set)."""
         self._registry().verdict_cache.inc({"outcome": "bypass"})
 
+    def hit_rate(self) -> float:
+        """Lifetime hit rate (hits / lookups) — the amortization signal
+        /debug/utilization and the bench rollup surface."""
+        m = self._registry()
+        hits = m.verdict_cache.value({"outcome": "hit"})
+        misses = m.verdict_cache.value({"outcome": "miss"})
+        total = hits + misses
+        return round(hits / total, 4) if total else 0.0
+
     def put(self, key: Any, column: np.ndarray) -> None:
         if not self._lru.enabled:
             return
@@ -258,6 +267,14 @@ class EncodeRowCache:
 
     def clear(self) -> None:
         self._lru.clear()
+
+    def hit_rate(self) -> float:
+        """Lifetime hit rate (hits / lookups)."""
+        m = self._registry()
+        hits = m.encode_cache.value({"outcome": "hit"})
+        misses = m.encode_cache.value({"outcome": "miss"})
+        total = hits + misses
+        return round(hits / total, 4) if total else 0.0
 
     @staticmethod
     def encode_key(encode_cfg, byte_paths, key_byte_paths) -> str:

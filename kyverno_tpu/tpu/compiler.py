@@ -68,6 +68,7 @@ class CompiledPolicySet:
     quarantined: Dict[int, str] = field(default_factory=dict)
     _fn: Optional[Callable] = field(default=None, repr=False)
     _cache_key: Optional[str] = field(default=None, repr=False)
+    _policy_spec_hashes: Optional[List[str]] = field(default=None, repr=False)
 
     @property
     def host_rule_policies(self) -> List[int]:
@@ -75,10 +76,13 @@ class CompiledPolicySet:
         return sorted({e.policy_idx for e in self.rules if e.device_row is None})
 
     def device_fn(self) -> Callable:
-        """The jitted batch program (compiled lazily, cached). Every
-        lookup is attributed on kyverno_tpu_compile_cache_total so the
-        hit/miss ratio — the recompilation-churn signal SURVEY §7 warns
-        about — is scrapeable, not inferred from latency spikes."""
+        """The jitted batch program (compiled lazily, cached),
+        returning (verdict table, per-rule verdict-class counts) — the
+        counts are the device-side rule-analytics reduction
+        (evaluator.build_program with_counts). Every lookup is
+        attributed on kyverno_tpu_compile_cache_total so the hit/miss
+        ratio — the recompilation-churn signal SURVEY §7 warns about —
+        is scrapeable, not inferred from latency spikes."""
         from ..observability.metrics import global_registry
         from ..observability.profiling import PHASE_COMPILE, global_profiler
         from ..observability.tracing import global_tracer
@@ -90,11 +94,23 @@ class CompiledPolicySet:
                                        programs=len(self.device_programs)):
                 self._fn = jax.jit(
                     build_program(self.device_programs,
-                                  self.encode_cfg.max_instances)
+                                  self.encode_cfg.max_instances,
+                                  with_counts=True)
                 )
         else:
             global_registry.compile_cache.inc({"outcome": "hit"})
         return self._fn
+
+    def policy_spec_hashes(self) -> List[str]:
+        """Per-policy analytics identity (spec-content hash), memoized
+        — RuleStatsAccumulator keys rule rows with these so stats
+        survive snapshot swaps and renames."""
+        if self._policy_spec_hashes is None:
+            from ..observability.analytics import policy_spec_hash
+
+            self._policy_spec_hashes = [policy_spec_hash(p)
+                                        for p in self.policies]
+        return self._policy_spec_hashes
 
     def coverage(self) -> Tuple[int, int]:
         dev = sum(1 for e in self.rules if e.device_row is not None)

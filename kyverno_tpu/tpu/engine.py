@@ -14,6 +14,8 @@ Verdict codes follow evaluator.py: 0 PASS, 1 SKIP, 2 FAIL,
 
 from __future__ import annotations
 
+import threading
+import time
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -25,6 +27,8 @@ from ..engine.engine import Engine as ScalarEngine
 from ..engine.match import RequestInfo
 from ..engine.policycontext import PolicyContext
 from ..engine.response import EngineResponse
+from ..observability.analytics import (NUM_CLASSES, RuleIdent, class_counts,
+                                       global_rule_stats, global_starvation)
 from ..observability.profiling import (PATH_DEVICE, PATH_SCALAR_FALLBACK,
                                        PHASE_DISPATCH, PHASE_ENCODE,
                                        PHASE_HOST_COMPLETE, PHASE_READBACK,
@@ -199,6 +203,16 @@ class TpuEngine:
         self._cache_ident: Optional[str] = None
         self._cache_eligible: Optional[bool] = None
         self._encode_cache_key: Optional[str] = None
+        # policy observatory: per-rule analytics identities + the
+        # thread-local slot the device-side verdict-count reduction
+        # rides from dispatch to assemble (thread-local because one
+        # engine may serve the flusher thread and a scan thread)
+        self._rule_idents: Optional[List[RuleIdent]] = None
+        self._tls = threading.local()
+        try:
+            global_rule_stats.register(self.rule_idents())
+        except Exception:
+            pass  # analytics must never block engine construction
 
     @classmethod
     def from_compiled(cls, cps: CompiledPolicySet) -> "TpuEngine":
@@ -452,6 +466,46 @@ class TpuEngine:
             b *= 2
         return b
 
+    # -- rule analytics (observability/analytics.py)
+
+    def rule_idents(self) -> List[RuleIdent]:
+        """Per-rule analytics identities aligned with cps.rules rows:
+        (policy spec hash, names, on-device placement). Exception-named
+        rules report as host — that is where their verdicts resolve."""
+        if self._rule_idents is None:
+            hashes = self.cps.policy_spec_hashes()
+            self._rule_idents = [
+                RuleIdent(policy_hash=hashes[e.policy_idx],
+                          policy_name=e.policy_name,
+                          rule_name=e.rule_name,
+                          on_device=(e.device_row is not None
+                                     and ri not in self._exception_rules))
+                for ri, e in enumerate(self.cps.rules)]
+        return self._rule_idents
+
+    def set_pending_counts(self, counts: Optional[np.ndarray]) -> None:
+        """Stash the device-side per-rule verdict-class reduction for
+        the assemble() that follows on this thread. With a corrupt-mode
+        fault armed at the dispatch site the post-readback table may be
+        altered behind the counts — drop them so analytics fall back to
+        counting the (corrupted) truth the verdict path actually
+        serves."""
+        if counts is not None:
+            spec = global_faults.armed().get(SITE_TPU_DISPATCH)
+            if spec is not None and spec.mode == "corrupt":
+                counts = None
+        self._tls.pending_counts = counts
+
+    def take_pending_counts(self) -> Optional[np.ndarray]:
+        counts = getattr(self._tls, "pending_counts", None)
+        self._tls.pending_counts = None
+        if counts is not None and (
+                not isinstance(counts, np.ndarray)
+                or counts.shape != (len(self.cps.device_programs),
+                                    NUM_CLASSES)):
+            return None
+        return counts
+
     # -- verdict-column caching (tpu/cache.py)
 
     @property
@@ -526,12 +580,19 @@ class TpuEngine:
         namespace_labels: Optional[Dict[str, Dict[str, str]]] = None,
         operations: Optional[Sequence[str]] = None,
         admission_infos: Optional[Sequence[Optional[RequestInfo]]] = None,
+        live_n: Optional[int] = None,
     ) -> ScanResult:
         """Cached scan: verdict columns for content-identical
         (resource, request) pairs restore from the LRU; only the misses
         pay encode + dispatch (via the full uncached ladder). Columns
         are per-resource independent in the device program, so a
-        miss-only sub-batch is bit-identical to scanning everything."""
+        miss-only sub-batch is bit-identical to scanning everything.
+
+        ``live_n`` marks the first N resources as real for the rule
+        analytics (the serving pipeline pads its batches with empty
+        resources — those must not inflate not-matched counts);
+        verdicts are computed and returned for every column either
+        way."""
         from .cache import global_verdict_cache as vc
 
         keys = (self.verdict_cache_keys(resources, namespace_labels,
@@ -541,27 +602,44 @@ class TpuEngine:
             if vc.enabled:
                 vc.bypass()
             return self._scan_uncached(resources, namespace_labels,
-                                       operations, admission_infos)
+                                       operations, admission_infos,
+                                       live_n=live_n)
         n = len(resources)
         rules = [(e.policy_name, e.rule_name) for e in self.cps.rules]
         total = np.full((len(rules), n), NOT_MATCHED, dtype=np.int32)
         miss: List[int] = []
+        hits: List[int] = []
         for i, key in enumerate(keys):
             col = vc.get(key) if key is not None else None
             if col is None:
                 miss.append(i)
             else:
+                hits.append(i)
                 total[:, i] = col
         if miss:
+            # miss indices ascend, and pad resources are a suffix of the
+            # batch — so the sub-batch's live prefix is just a count
+            sub_live = (sum(1 for i in miss if i < live_n)
+                        if live_n is not None else None)
             sub = self._scan_uncached(
                 [resources[i] for i in miss], namespace_labels,
                 [operations[i] for i in miss] if operations else None,
                 [admission_infos[i] for i in miss] if admission_infos
-                else None)
+                else None, live_n=sub_live)
             for j, i in enumerate(miss):
                 total[:, i] = sub.verdicts[:, j]
                 if keys[i] is not None:
                     vc.put(keys[i], sub.verdicts[:, j])
+        if hits and global_rule_stats.enabled:
+            # cache-served verdicts still count: replay the hit columns
+            # into the accumulator so a warm rescan reports the same
+            # rule stats as a cold one
+            live_hits = ([i for i in hits if i < live_n]
+                         if live_n is not None else hits)
+            if live_hits:
+                global_rule_stats.ingest_table(
+                    self.rule_idents(), total[:, live_hits],
+                    source="cached")
         return ScanResult(verdicts=total, rules=rules)
 
     def _scan_uncached(
@@ -570,6 +648,7 @@ class TpuEngine:
         namespace_labels: Optional[Dict[str, Dict[str, str]]] = None,
         operations: Optional[Sequence[str]] = None,
         admission_infos: Optional[Sequence[Optional[RequestInfo]]] = None,
+        live_n: Optional[int] = None,
     ) -> ScanResult:
         n = len(resources)
         padded_n = self.bucket_size(max(n, 1))
@@ -577,6 +656,7 @@ class TpuEngine:
         ops = (list(operations) + [""] * (padded_n - n)) if operations else None
         infos = (list(admission_infos) + [None] * (padded_n - n)) \
             if admission_infos else None
+        t_enc0 = time.perf_counter()
         try:
             with global_profiler.phase(PHASE_ENCODE), \
                     global_tracer.span("tpu.encode", resources=n,
@@ -588,15 +668,32 @@ class TpuEngine:
             # the rest of the batch still evaluates (device or scalar),
             # and the bad resource degrades to scalar / per-rule ERROR
             return self._scan_quarantining(
-                resources, namespace_labels, operations, admission_infos)
-        device_table = self._dispatch(batch, padded_n)[:, :n]  # (D, N)
+                resources, namespace_labels, operations, admission_infos,
+                live_n=live_n)
+        t_enc = time.perf_counter() - t_enc0
+        t_disp0 = time.perf_counter()
+        device_table = self._dispatch(batch, padded_n, n)[:, :n]  # (D, N)
+        # feed accounting: while the host encoded, the device sat idle
+        # (the serial ladder has no overlap); dispatch + readback is
+        # device-busy time. Only when the device actually ran: with the
+        # breaker open / dispatch failed there is no device to starve,
+        # and counting encode time would pin the gauge at 1.0 during an
+        # outage — pointing operators at the encoder instead of the
+        # device
+        from ..observability.profiling import last_dispatch_path
+
+        if last_dispatch_path() == PATH_DEVICE:
+            global_starvation.record(busy_s=time.perf_counter() - t_disp0,
+                                     starved_s=t_enc)
         return self.assemble(
-            device_table, resources, namespace_labels, operations, admission_infos
+            device_table, resources, namespace_labels, operations,
+            admission_infos, live_n=live_n
         )
 
     def _breaker_open_fallback(self) -> None:
         from ..observability.metrics import global_registry
 
+        self._tls.pending_counts = None  # no device truth this batch
         set_dispatch_path(PATH_SCALAR_FALLBACK)
         global_registry.breaker_fallback.inc({"reason": "open"})
         global_tracer.add_event("breaker_fallback", reason="open",
@@ -605,6 +702,9 @@ class TpuEngine:
     def _record_dispatch_failure(self, e: Exception) -> None:
         from ..observability.metrics import global_registry
 
+        # a stash from a dispatch that then failed validation must not
+        # masquerade as truth for the all-HOST fallback table
+        self._tls.pending_counts = None
         self.breaker.record_failure()
         set_dispatch_path(PATH_SCALAR_FALLBACK)
         global_registry.breaker_fallback.inc({"reason": "error"})
@@ -622,6 +722,7 @@ class TpuEngine:
         back to scalar completion (all-HOST). The pipelined scan uses
         the same ladder split in two (guarded_launch/guarded_complete)
         so the device can run chunk k while the host touches k±1."""
+        self._tls.pending_counts = None
         if not self.breaker.allow():
             self._breaker_open_fallback()
             return None
@@ -656,6 +757,7 @@ class TpuEngine:
         for guarded_complete, or None when the breaker is open or the
         launch itself raised — same fallback semantics as
         guarded_dispatch."""
+        self._tls.pending_counts = None
         if not self.breaker.allow():
             self._breaker_open_fallback()
             return None
@@ -681,11 +783,16 @@ class TpuEngine:
             self._record_dispatch_failure(e)
             return None
 
-    def _dispatch(self, batch, padded_n: int) -> np.ndarray:
+    def _dispatch(self, batch, padded_n: int,
+                  n_live: Optional[int] = None) -> np.ndarray:
         """One device dispatch through the guarded ladder. Any failure
         returns an all-HOST table, which routes the WHOLE batch through
         the scalar oracle in assemble(): verdicts stay bit-identical,
-        only latency degrades."""
+        only latency degrades. The device program also returns the
+        per-rule verdict-class reduction; it is stashed (pad columns
+        subtracted) for the assemble() that follows this dispatch."""
+        if n_live is None:
+            n_live = padded_n
 
         def run():
             import jax
@@ -698,7 +805,19 @@ class TpuEngine:
                 with global_profiler.phase(PHASE_DISPATCH):
                     out = self.cps.device_fn()(jax.device_put(batch))
                 with global_profiler.phase(PHASE_READBACK):
-                    return np.asarray(out)
+                    # tolerate monkeypatched device_fns that still
+                    # return a bare verdict table
+                    if isinstance(out, tuple):
+                        table, counts = np.asarray(out[0]), np.asarray(out[1])
+                    else:
+                        table, counts = np.asarray(out), None
+            if counts is not None and table.ndim == 2:
+                # bucket-pad columns are encoded empties, not workload:
+                # their contribution leaves the analytics counts here
+                counts = counts.astype(np.int64) - class_counts(
+                    table[:, n_live:])
+            self.set_pending_counts(counts)
+            return table
 
         D = len(self.cps.device_programs)
         table = self.guarded_dispatch(run, (D, padded_n))
@@ -712,6 +831,7 @@ class TpuEngine:
         namespace_labels: Optional[Dict[str, Dict[str, str]]] = None,
         operations: Optional[Sequence[str]] = None,
         admission_infos: Optional[Sequence[Optional[RequestInfo]]] = None,
+        live_n: Optional[int] = None,
     ) -> ScanResult:
         """Batch encode failed: split the batch into resources that
         encode alone (re-scanned as a clean sub-batch) and hostile ones,
@@ -747,7 +867,9 @@ class TpuEngine:
             sub = self.scan(
                 [resources[i] for i in good], namespace_labels,
                 [operations[i] for i in good] if operations else None,
-                [admission_infos[i] for i in good] if admission_infos else None)
+                [admission_infos[i] for i in good] if admission_infos else None,
+                live_n=(sum(1 for i in good if i < live_n)
+                        if live_n is not None else None))
             total[:, good] = sub.verdicts
         ns_labels = namespace_labels or {}
         for ci in bad:
@@ -773,6 +895,13 @@ class TpuEngine:
                         continue
                     total[ri, ci] = ERROR if verdicts is None \
                         else verdicts.get(entry.rule_name, NOT_MATCHED)
+        # analytics: the good sub-batch ingested inside self.scan();
+        # only the quarantined columns are counted here
+        live_bad = [ci for ci in bad if live_n is None or ci < live_n]
+        if live_bad and global_rule_stats.enabled:
+            global_rule_stats.ingest_table(self.rule_idents(),
+                                           total[:, live_bad],
+                                           source="quarantine")
         return ScanResult(
             verdicts=total,
             rules=[(e.policy_name, e.rule_name) for e in self.cps.rules],
@@ -785,9 +914,12 @@ class TpuEngine:
         namespace_labels: Optional[Dict[str, Dict[str, str]]] = None,
         operations: Optional[Sequence[str]] = None,
         admission_infos: Optional[Sequence[Optional[RequestInfo]]] = None,
+        live_n: Optional[int] = None,
     ) -> ScanResult:
         """Merge device verdicts with host completions (host rules +
-        HOST-flagged resources)."""
+        HOST-flagged resources), then fold the batch into the rule
+        analytics: device-reduced counts for untouched device rows,
+        per-cell corrections for everything the host completed."""
         n = len(resources)
         total = np.full((len(self.cps.rules), n), NOT_MATCHED, dtype=np.int32)
         ns_labels = namespace_labels or {}
@@ -860,6 +992,10 @@ class TpuEngine:
         by_policy: Dict[int, List[Tuple[int, Optional[Dict[str, int]]]]] = {}
         for (pi, ci), verdicts in cache.items():
             by_policy.setdefault(pi, []).append((ci, verdicts))
+        # cells whose device verdict the host replaced, per device rule
+        # — the analytics correction set (device counts already include
+        # the device's original code for these cells)
+        replaced: Dict[int, List[int]] = {}
         for ri, entry in enumerate(self.cps.rules):
             cells = by_policy.get(entry.policy_idx)
             if not cells:
@@ -872,11 +1008,55 @@ class TpuEngine:
                     # whole policy was unmatched (HOST must not escape)
                     total[ri, ci] = ERROR if verdicts is None \
                         else verdicts.get(entry.rule_name, NOT_MATCHED)
+                    if not host_rule:
+                        replaced.setdefault(ri, []).append(ci)
 
+        self._ingest_assembled(total, device_table, replaced, live_n)
         return ScanResult(
             verdicts=total,
             rules=[(e.policy_name, e.rule_name) for e in self.cps.rules],
         )
+
+    def _ingest_assembled(self, total: np.ndarray, device_table: np.ndarray,
+                          replaced: Dict[int, List[int]],
+                          live_n: Optional[int]) -> None:
+        """Exact per-rule verdict counts for one assembled batch.
+
+        With the device-side reduction stashed by the dispatch, a
+        device rule's counts are the O(1)-per-rule device totals plus a
+        correction per host-completed cell (subtract the device's code,
+        add the final one) — the correction set is exactly the cell set
+        the host already paid scalar work for. Without a stash (breaker
+        fallback, scalar completion, external tables) the counts come
+        from one vectorized host reduction over the final table; either
+        way the ingested numbers describe the verdicts actually
+        served."""
+        if not global_rule_stats.enabled or total.shape[0] == 0:
+            return
+        rules_n, n = total.shape
+        dev_counts = self.take_pending_counts()
+        if dev_counts is None:
+            counts = class_counts(total)
+            source = "host"
+        else:
+            counts = np.zeros((rules_n, NUM_CLASSES), dtype=np.int64)
+            host_rows: List[int] = []
+            for ri, entry in enumerate(self.cps.rules):
+                if entry.device_row is None or ri in self._exception_rules:
+                    host_rows.append(ri)
+                    continue
+                c = dev_counts[entry.device_row].astype(np.int64).copy()
+                for ci in replaced.get(ri, ()):
+                    c[int(device_table[entry.device_row, ci])] -= 1
+                    c[int(total[ri, ci])] += 1
+                counts[ri] = c
+            if host_rows:
+                counts[host_rows] = class_counts(total[host_rows])
+            source = "device"
+        if live_n is not None and live_n < n:
+            counts = counts - class_counts(total[:, live_n:])
+        global_rule_stats.ingest_counts(self.rule_idents(), counts,
+                                        source=source)
 
     # -- introspection
 

@@ -79,6 +79,7 @@ from .ir import (
 from .metadata import MetaBatch, OP_CODES
 
 PASS, SKIP, FAIL, NOT_MATCHED, ERROR, HOST = 0, 1, 2, 3, 4, 5
+NUM_VERDICT_CLASSES = 6
 
 
 # ---------------------------------------------------------------------------
@@ -1727,14 +1728,28 @@ def eval_rule(ctx: Ctx, prog: RuleProgram) -> jnp.ndarray:
     return jnp.where(fallback, HOST, verdict)
 
 
-def build_program(programs: Sequence[RuleProgram], max_instances: int) -> Callable:
-    """Returns a jittable fn(batch dict) -> (num_rules, N) int32."""
+def build_program(programs: Sequence[RuleProgram], max_instances: int,
+                  with_counts: bool = False) -> Callable:
+    """Returns a jittable fn(batch dict) -> (num_rules, N) int32, or —
+    with ``with_counts`` — (table, (num_rules, NUM_VERDICT_CLASSES)
+    int32): the per-rule verdict reduction folded into the compiled
+    program, so rule analytics ride the dispatch as an O(rules)
+    readback instead of an O(rules x resources) host walk (the
+    reduction over the batch axis is a handful of fused compares —
+    noise next to rule evaluation itself)."""
 
-    def run(batch: Dict[str, jnp.ndarray]) -> jnp.ndarray:
+    def run(batch: Dict[str, jnp.ndarray]):
         ctx = Ctx(densify(batch), max_instances)
         outs = [eval_rule(ctx, p) for p in programs]
         if not outs:
-            return jnp.zeros((0, ctx.N), dtype=jnp.int32)
-        return jnp.stack(outs, axis=0).astype(jnp.int32)
+            table = jnp.zeros((0, ctx.N), dtype=jnp.int32)
+        else:
+            table = jnp.stack(outs, axis=0).astype(jnp.int32)
+        if not with_counts:
+            return table
+        counts = jnp.stack(
+            [(table == c).sum(axis=1) for c in range(NUM_VERDICT_CLASSES)],
+            axis=-1).astype(jnp.int32)
+        return table, counts
 
     return run

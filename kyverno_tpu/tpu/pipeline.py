@@ -38,8 +38,10 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..observability.analytics import (class_counts, global_starvation)
 from ..observability.metrics import global_registry
 from ..observability.profiling import (PHASE_DISPATCH, PHASE_ENCODE,
+                                       PHASE_ENCODE_WAIT,
                                        PHASE_HOST_COMPLETE, PHASE_READBACK,
                                        global_profiler)
 from ..observability.tracing import global_tracer
@@ -75,8 +77,13 @@ class PipelinedScanner:
         strictly serial)."""
         stats: Dict[str, Any] = {
             "encode_s": 0.0, "device_s": 0.0, "host_s": 0.0,
+            "encode_wait_s": 0.0, "starved_s": 0.0,
             "chunks": len(chunks), "resources": sum(len(c) for c in chunks),
             "encode_fallback_chunks": 0, "overlap_ratio": 0.0,
+            # per-chunk timeline: encode / encode-wait / device /
+            # host-assemble seconds and resolution path per chunk, in
+            # completion order (bench + /debug introspection)
+            "timeline": [],
         }
         if not chunks:
             return stats
@@ -88,6 +95,8 @@ class PipelinedScanner:
         enc_q: "queue.Queue[Tuple[int, Optional[Any]]]" = queue.Queue(
             maxsize=self.depth)
         stop = threading.Event()
+
+        chunk_encode_s: Dict[int, float] = {}
 
         def encode_worker() -> None:
             # encode chunk k+1 while the device executes chunk k; the
@@ -108,7 +117,9 @@ class PipelinedScanner:
                     payload: Optional[Any] = (batch, n)
                 except Exception:
                     payload = None  # serial quarantining fallback
-                stats["encode_s"] += time.perf_counter() - t0
+                dt = time.perf_counter() - t0
+                stats["encode_s"] += dt
+                chunk_encode_s[idx] = dt
                 while not stop.is_set():
                     try:
                         enc_q.put((idx, payload), timeout=0.1)
@@ -123,6 +134,29 @@ class PipelinedScanner:
         D = len(eng.cps.device_programs)
         inflight: List[Tuple[int, Optional[Tuple[Any]], int]] = []
 
+        def publish_live_ratios() -> None:
+            # satellite contract: /metrics mid-scan must see LIVE
+            # pipeline numbers — the overlap gauge updates per chunk,
+            # and the starvation tracker got its per-chunk samples as
+            # they happened (its gauge rides along)
+            wall = time.perf_counter() - t_wall0
+            busy = stats["encode_s"] + stats["device_s"] + stats["host_s"]
+            if wall > 0:
+                global_registry.pipeline_overlap.set(
+                    round(max(0.0, busy - wall) / wall, 4))
+
+        def readback(fut, n):
+            # the launched handle is the jitted (verdicts, counts)
+            # pair: counts are the device-side rule-analytics
+            # reduction; pad columns leave them before the stash
+            if isinstance(fut, tuple):
+                v, c = np.asarray(fut[0]), np.asarray(fut[1])
+                c = c.astype(np.int64) - class_counts(v[:, n:])
+            else:
+                v, c = np.asarray(fut), None
+            eng.set_pending_counts(c)
+            return v[:, :n].astype(np.int32)
+
         def drain() -> None:
             idx, handle, n = inflight.pop(0)
             chunk = chunks[idx]
@@ -132,18 +166,24 @@ class PipelinedScanner:
                     global_tracer.span("scan_device_wait", parent=scan_ctx,
                                        tile=n):
                 table = eng.guarded_complete(
-                    handle, lambda fut: np.asarray(fut)[:, :n], (D, n))
-            stats["device_s"] += time.perf_counter() - t0
+                    handle, lambda fut: readback(fut, n), (D, n))
+            device_s = time.perf_counter() - t0
+            stats["device_s"] += device_s
             global_registry.device_dispatch.observe(
-                time.perf_counter() - t0, {"engine": "scan"})
+                device_s, {"engine": "scan"})
+            global_registry.utilization_seconds.inc(
+                {"phase": "readback"}, device_s)
             if table is None:
                 # breaker open / launch or readback failed: the WHOLE
                 # chunk scalar-completes, bit-identical to the serial
                 # ladder's all-HOST fallback
+                eng.set_pending_counts(None)
                 table = np.full((D, n), HOST, dtype=np.int32)
                 global_registry.pipeline_chunks.inc({"path": "fallback"})
+                path = "fallback"
             else:
                 global_registry.pipeline_chunks.inc({"path": "device"})
+                path = "device"
             t0 = time.perf_counter()
             with global_profiler.phase(PHASE_HOST_COMPLETE), \
                     global_tracer.span("scan_host_complete",
@@ -151,7 +191,21 @@ class PipelinedScanner:
                 result = eng.assemble(table, chunk, namespace_labels, ops)
             if on_result is not None:
                 on_result(idx, result)
-            stats["host_s"] += time.perf_counter() - t0
+            host_s = time.perf_counter() - t0
+            stats["host_s"] += host_s
+            global_registry.utilization_seconds.inc(
+                {"phase": "host_assemble"}, host_s)
+            # fallback chunks never ran on device: no busy sample, or a
+            # breaker-open scan would read as ~100% feed starvation
+            if path == "device":
+                global_starvation.record(busy_s=device_s, assemble_s=host_s)
+            stats["timeline"].append({
+                "chunk": idx, "path": path, "resources": n,
+                "encode_s": round(chunk_encode_s.get(idx, 0.0), 6),
+                "device_s": round(device_s, 6),
+                "host_s": round(host_s, 6),
+            })
+            publish_live_ratios()
 
         def serial_chunk(idx: int) -> None:
             """Encode failed for this chunk: the engine's quarantining
@@ -173,15 +227,38 @@ class PipelinedScanner:
                     rules=rules)
                 # infrastructure failure, not content truth: callers
                 # (cluster/scanner.py) must not verdict-cache these rows
+                # — and the rule analytics skip them for the same reason
                 result.infra_error = True
             if on_result is not None:
                 on_result(idx, result)
-            stats["host_s"] += time.perf_counter() - t0
+            host_s = time.perf_counter() - t0
+            stats["host_s"] += host_s
+            stats["timeline"].append({
+                "chunk": idx, "path": "encode_fallback",
+                "resources": len(chunk),
+                "encode_s": round(chunk_encode_s.get(idx, 0.0), 6),
+                "device_s": 0.0, "host_s": round(host_s, 6),
+            })
+            publish_live_ratios()
 
         try:
             done = 0
             while done < len(chunks):
+                t_wait0 = time.perf_counter()
                 idx, payload = enc_q.get()
+                waited = time.perf_counter() - t_wait0
+                stats["encode_wait_s"] += waited
+                global_profiler.add(PHASE_ENCODE_WAIT, waited)
+                global_registry.utilization_seconds.inc(
+                    {"phase": "encode_wait"}, waited)
+                if not inflight and eng.breaker.state != "open":
+                    # nothing on the device while we waited for the
+                    # encoder: that wait is pure feed starvation — the
+                    # gauge the encode-pool work will be judged against
+                    # (an OPEN breaker means there is no device to
+                    # starve; those waits are outage time, not feed)
+                    stats["starved_s"] += waited
+                    global_starvation.record(starved_s=waited)
                 done += 1
                 if payload is None:
                     # keep result ordering: everything in flight lands
@@ -197,7 +274,7 @@ class PipelinedScanner:
                                            parent=scan_ctx, tile=n):
                     handle = eng.guarded_launch(
                         lambda: self.scanner._step(
-                            self.scanner.put(batch))[0])
+                            self.scanner.put(batch)))
                 stats["device_s"] += time.perf_counter() - t0
                 inflight.append((idx, handle, n))
                 # double buffer: with chunk k launched, the readback +

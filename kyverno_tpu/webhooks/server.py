@@ -148,7 +148,10 @@ class Handlers:
         if (lifecycle is not None and lifecycle.worker_running
                 and not lifecycle.probing and cps.device_programs):
             try:
-                eng.scan([{}])
+                # live_n=0: a synthetic warm resource must not count in
+                # the rule analytics (the accumulator is exact over the
+                # REAL workload)
+                eng.scan([{}], live_n=0)
             except Exception:
                 pass  # warmup is best-effort; dispatch has its own ladder
         return eng
@@ -188,6 +191,15 @@ class Handlers:
         col = global_verdict_cache.get(keys[0])
         if col is None:
             return None
+        # submit-time cache hits never reach the engine: replay the
+        # column into the rule analytics so cached admissions count
+        try:
+            from ..observability.analytics import global_rule_stats
+
+            global_rule_stats.ingest_column(eng.rule_idents(), col,
+                                            source="cached")
+        except Exception:
+            pass
         return VerdictRows(
             [((e.policy_name, e.rule_name), int(col[row]))
              for row, e in enumerate(eng.cps.rules)],
@@ -247,6 +259,14 @@ class Handlers:
             rows.append(((entry.policy_name, entry.rule_name),
                          ERROR if verdicts is None
                          else verdicts.get(entry.rule_name, NOT_MATCHED)))
+        try:
+            from ..observability.analytics import global_rule_stats
+
+            global_rule_stats.ingest_column(
+                eng.rule_idents(), [code for _, code in rows],
+                source="scalar")
+        except Exception:
+            pass
         return VerdictRows(rows, version=version)
 
     def _pure_scalar_rows(self, payload: AdmissionPayload):
@@ -272,6 +292,24 @@ class Handlers:
                 rows.append(((policy.name, rule.name),
                              ERROR if verdicts is None
                              else verdicts.get(rule.name, NOT_MATCHED)))
+        try:
+            # no compiled artifact: build the analytics identities
+            # straight from the live cache policies (all host-resolved)
+            from ..observability.analytics import (RuleIdent,
+                                                   global_rule_stats,
+                                                   policy_spec_hash)
+
+            idents = []
+            for policy in policies:
+                ph = policy_spec_hash(policy)
+                for rule in policy.get_rules():
+                    if rule.has_validate():
+                        idents.append(RuleIdent(ph, policy.name, rule.name,
+                                                False))
+            global_rule_stats.ingest_column(
+                idents, [code for _, code in rows], source="scalar")
+        except Exception:
+            pass
         return VerdictRows(rows, revision=rev)
 
     def _evaluate_batch(self, payloads: List[AdmissionPayload]):
@@ -334,6 +372,10 @@ class Handlers:
             ns_labels,
             operations=[p.operation for p in filled],
             admission_infos=[p.info for p in filled],
+            # pad slots are empty resources: verdicts are computed for
+            # them (shape bucketing) but they must not pollute the rule
+            # analytics
+            live_n=real_n,
         )
         self.metrics.device_dispatch.observe(time.perf_counter() - t0,
                                              {"engine": "tpu"})
@@ -375,6 +417,16 @@ class Handlers:
             "quarantined": [q["policy"] for q in ls["quarantined"]],
             "compile_breaker": ls["compile_breaker"],
         }
+        # SLO surface: burn-rate state rides readiness so a rollout
+        # gate (or an operator) sees budget burn next to the ladder
+        # state. Burning an SLO does not flip readiness — verdicts are
+        # still correct — it is the early-warning channel.
+        try:
+            from ..observability.analytics import global_slo
+
+            detail["slo"] = global_slo.state()
+        except Exception:
+            pass
         ok = compiled and breaker.state != "open"
         detail["ready"] = ok
         return ok, detail
@@ -823,6 +875,47 @@ def handle_debug_path(path: str, handlers: Optional[Handlers] = None
     if route == "/debug/state":
         state = handlers.debug_state() if handlers is not None else {}
         return 200, (json.dumps(state) + "\n").encode(), "application/json"
+    if route == "/debug/rules":
+        # the policy observatory: top-N hot rules, never-fired rules
+        # with age, per-policy device coverage — the runtime half of
+        # policy anomaly detection (a never-fired rule is a shadowing /
+        # dead-rule candidate for `analyze` to confirm statically)
+        from ..observability.analytics import global_rule_stats
+
+        try:
+            top = int(query.get("top", ["20"])[0])
+        except ValueError:
+            return 400, b'{"error": "top must be an integer"}\n', \
+                "application/json"
+        doc = global_rule_stats.report(top=top)
+        return 200, (json.dumps(doc) + "\n").encode(), "application/json"
+    if route == "/debug/utilization":
+        from ..observability.analytics import global_slo, global_starvation
+        from ..observability.metrics import global_registry as _reg
+        from ..observability.profiling import global_profiler
+        from ..tpu.cache import global_encode_cache, global_verdict_cache
+
+        doc = {
+            "feed_starvation": global_starvation.state(),
+            "pipeline": {
+                "overlap_ratio": _reg.pipeline_overlap.value(),
+                "chunks": {labels.get("path", ""): v for labels, v
+                           in _reg.pipeline_chunks.series()},
+            },
+            "utilization_seconds": {
+                labels.get("phase", ""): round(v, 6) for labels, v
+                in _reg.utilization_seconds.series()},
+            "flusher_seconds": {
+                labels.get("state", ""): round(v, 6) for labels, v
+                in _reg.serving_flusher_seconds.series()},
+            "perf_caches": {"verdict_hit_rate": global_verdict_cache.hit_rate(),
+                            "encode_hit_rate": global_encode_cache.hit_rate()},
+            "slo": global_slo.state(),
+            "phase_breakdown": global_profiler.breakdown(),
+        }
+        if handlers is not None and handlers.pipeline is not None:
+            doc["serving"] = handlers.pipeline.state()
+        return 200, (json.dumps(doc) + "\n").encode(), "application/json"
     if route == "/debug/spans":
         lines = []
         for s in global_tracer.finished()[-200:]:
@@ -973,6 +1066,12 @@ class AdmissionServer:
                                   total trace duration
         /debug/state              queue/breaker/compile-cache/faults/
                                   phase-split snapshot as JSON
+        /debug/rules[?top=N]      policy observatory: top-N hot rules,
+                                  never-fired rules with age, per-policy
+                                  device coverage
+        /debug/utilization        feed-starvation ratio, pipeline
+                                  overlap, flusher state split, SLO
+                                  burn state
         /debug/spans              recent spans, one line each (legacy)
         /debug/xla/start?dir=D    start the JAX/XLA profiler trace
         /debug/xla/stop           stop it (trace lands in the dir)

@@ -11,12 +11,12 @@ set -o pipefail
 cd "$(dirname "$0")"
 rc=0
 
-echo "=== leg 1/2: tier-1 (faults disarmed) ==="
+echo "=== leg 1/3: tier-1 (faults disarmed) ==="
 KYVERNO_TPU_FAULTS= JAX_PLATFORMS=cpu timeout -k 10 870 \
   python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors \
   -p no:cacheprovider -p no:xdist -p no:randomly || rc=1
 
-echo "=== leg 2/2: /metrics + /debug/* smoke under load, tpu.dispatch armed ==="
+echo "=== leg 2/3: /metrics + /debug/* smoke under load, tpu.dispatch armed ==="
 KYVERNO_TPU_FAULTS="tpu.dispatch:raise:p=1.0" JAX_PLATFORMS=cpu \
   timeout -k 10 300 python - <<'EOF' || rc=1
 import http.client
@@ -148,6 +148,115 @@ try:
           f"{len(fallback_spans)} fallback spans, "
           f"breaker={state['breaker']['state']}, "
           f"verdict_cache={perf['verdict']}")
+finally:
+    cp.stop()
+EOF
+
+echo "=== leg 3/3: policy observatory (rule analytics + starvation + SLO) ==="
+KYVERNO_TPU_FAULTS= JAX_PLATFORMS=cpu timeout -k 10 300 python - <<'EOF' || rc=1
+import http.client
+import json
+
+from kyverno_tpu.api.policy import ClusterPolicy
+from kyverno_tpu.cli.serve import ControlPlane
+
+# one rule that fires on the workload, one that can never fire (no
+# Gateway in the snapshot) — the never-fired report is the on-ramp to
+# shadow/dead-rule analysis
+POLICY = ClusterPolicy.from_dict({
+    "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+    "metadata": {"name": "observatory"},
+    "spec": {"validationFailureAction": "Enforce", "rules": [
+        {"name": "hot",
+         "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+         "validate": {"message": "m",
+                      "pattern": {"metadata": {"name": "?*"}}}},
+        {"name": "cold",
+         "match": {"any": [{"resources": {"kinds": ["Gateway"]}}]},
+         "validate": {"message": "m",
+                      "pattern": {"metadata": {"name": "?*"}}}},
+    ]}})
+
+
+def get(port, path):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    conn.request("GET", path)
+    resp = conn.getresponse()
+    body = resp.read()
+    conn.close()
+    return resp.status, body
+
+
+def post(port, path, body):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    conn.request("POST", path, body, {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    out = resp.read()
+    conn.close()
+    return resp.status, out
+
+
+def review(i):
+    return json.dumps({
+        "apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview",
+        "request": {"uid": f"u{i}", "operation": "CREATE",
+                    "object": {"apiVersion": "v1", "kind": "Pod",
+                               "metadata": {"name": f"p{i}",
+                                            "namespace": "d"},
+                               "spec": {"containers": [
+                                   {"name": "c", "image": "nginx"}]}}}})
+
+
+cp = ControlPlane([POLICY], port=0, metrics_port=0, batching=True)
+cp.start(scan_interval=3600.0)
+adm, met = cp.admission.port, cp.metrics_server.server_address[1]
+try:
+    # drive admissions + a full background scan
+    for i in range(12):
+        status, out = post(adm, "/validate", review(i))
+        assert status == 200, status
+    for i in range(6):
+        pod = json.loads(review(i))["request"]["object"]
+        assert post(met, "/snapshot/upsert", json.dumps(pod))[0] == 200
+    assert post(met, "/scan", json.dumps({"full": True}))[0] == 200
+
+    # /debug/rules: the known-hot rule ranks, the known-never-fired
+    # rule is reported with an age
+    status, body = get(met, "/debug/rules?top=10")
+    assert status == 200, status
+    doc = json.loads(body)
+    hot = {(r["policy"], r["rule"]) for r in doc["top"]}
+    never = {(r["policy"], r["rule"]): r for r in doc["never_fired"]}
+    assert ("observatory", "hot") in hot, doc["top"]
+    assert ("observatory", "cold") in never, doc["never_fired"]
+    assert never[("observatory", "cold")]["age_s"] >= 0
+
+    # starvation gauge present and in [0,1]; SLO gauges on /metrics
+    text = get(met, "/metrics")[1].decode()
+    line = [l for l in text.splitlines()
+            if l.startswith("kyverno_tpu_feed_starvation_ratio")]
+    assert line, "starvation gauge missing"
+    ratio = float(line[0].rsplit(" ", 1)[1])
+    assert 0.0 <= ratio <= 1.0, ratio
+    for fam in ("kyverno_slo_admission_burn_rate",
+                "kyverno_slo_scan_freshness_seconds",
+                "kyverno_slo_device_coverage_ratio",
+                "kyverno_rule_evals_total"):
+        assert fam in text, f"{fam} missing from /metrics"
+
+    # /debug/utilization answers with the starvation + SLO state
+    status, body = get(met, "/debug/utilization")
+    assert status == 200
+    util = json.loads(body)
+    assert 0.0 <= util["feed_starvation"]["ratio"] <= 1.0
+    assert "windows" in util["slo"]["admission"]
+
+    # /readyz carries the SLO block
+    ready = json.loads(get(met, "/readyz")[1])
+    assert "slo" in ready, ready
+    print(f"OBSERVATORY OK: starvation={ratio}, "
+          f"hot={len(doc['top'])}, never_fired={len(doc['never_fired'])}, "
+          f"slo_breached={util['slo']['breached']}")
 finally:
     cp.stop()
 EOF

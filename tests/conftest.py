@@ -34,6 +34,21 @@ def _fresh_perf_caches():
     yield
 
 
+# the policy observatory (observability/analytics.py) accumulates
+# process-wide; parity tests assert EXACT per-rule counts, so every
+# test starts from an empty accumulator and fresh SLO/starvation state
+@pytest.fixture(autouse=True)
+def _fresh_observatory():
+    from kyverno_tpu.observability.analytics import (global_rule_stats,
+                                                     global_slo,
+                                                     global_starvation)
+
+    global_rule_stats.reset()
+    global_starvation.reset()
+    global_slo.reset()
+    yield
+
+
 @pytest.fixture
 def no_verdict_cache():
     """Opt-out for tests that count device dispatches on repeat scans

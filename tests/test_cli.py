@@ -78,7 +78,28 @@ def test_serve_batching_help(capsys):
     out = capsys.readouterr().out
     for flag in ("--batching", "--max-batch-size", "--max-wait-ms",
                  "--deadline-ms", "--queue-high-water", "--shed-mode",
-                 "--policy-watch", "--reload-interval"):
+                 "--policy-watch", "--reload-interval",
+                 "--slo-admission-p99-ms", "--slo-admission-budget",
+                 "--slo-scan-freshness-s", "--slo-device-coverage-floor",
+                 "--rule-metrics-top-k"):
+        assert flag in out
+
+
+def test_apply_help_covers_observatory_flags(capsys):
+    with pytest.raises(SystemExit) as exc:
+        main(["apply", "--help"])
+    assert exc.value.code == 0
+    out = capsys.readouterr().out
+    assert "--rule-stats" in out and "--profile" in out
+
+
+def test_top_help(capsys):
+    with pytest.raises(SystemExit) as exc:
+        main(["top", "--help"])
+    assert exc.value.code == 0
+    out = capsys.readouterr().out
+    for flag in ("--port", "--interval", "--iterations", "--no-clear",
+                 "--top"):
         assert flag in out
 
 

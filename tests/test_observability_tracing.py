@@ -190,6 +190,12 @@ def test_exposition_format_is_scrapeable():
     escaping, histogram bucket monotonicity, +Inf == _count agreement,
     exemplar syntax. New instruments that emit unparseable text fail
     here, not in a scrape loop at 3am."""
+    import numpy as np
+
+    from kyverno_tpu.observability.analytics import (RuleIdent,
+                                                     RuleStatsAccumulator,
+                                                     SloTracker)
+
     reg = MetricsRegistry()
     # exercise the interesting encodings, including label escaping
     reg.policy_results.inc({"policy": 'we"ird\\pol\nicy', "status": "fail"})
@@ -199,8 +205,29 @@ def test_exposition_format_is_scrapeable():
     reg.serving_request_latency.observe(
         99.0, exemplar={"trace_id": "cd" * 16})  # +Inf bucket exemplar
     reg.serving_queue_depth.set(7)
+    # the observatory families: rule analytics (scrape-time collector,
+    # label-escaping policy names included) + SLO/starvation gauges
+    acc = RuleStatsAccumulator(clock=lambda: 0.0)
+    acc.ingest_counts([RuleIdent("h1", 'po"l\\one', "r1", True),
+                       RuleIdent("h2", "pol-two", "r2", False)],
+                      np.array([[3, 0, 1, 2, 0, 0], [0, 0, 0, 4, 0, 0]]))
+    reg.rule_stats.accumulator = acc
+    slo = SloTracker(metrics=reg)
+    slo.record_admission(0.004)
+    slo.record_scan(coverage=0.97)
+    reg.feed_starvation.set(0.25)
 
     text = reg.exposition()
+    # every new family is present (cardinality guard has its own test)
+    for fam in ("kyverno_rule_evals_total", "kyverno_rule_fired_total",
+                "kyverno_rule_fail_total", "kyverno_rule_never_fired",
+                "kyverno_policy_device_coverage",
+                "kyverno_slo_admission_latency_p99_seconds",
+                "kyverno_slo_admission_burn_rate",
+                "kyverno_slo_scan_freshness_seconds",
+                "kyverno_slo_device_coverage_ratio", "kyverno_slo_breached",
+                "kyverno_tpu_feed_starvation_ratio"):
+        assert f"# TYPE {fam} " in text, fam
     assert text.endswith("\n")
     helped, typed = set(), {}
     hist_series = {}

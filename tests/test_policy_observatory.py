@@ -1,0 +1,630 @@
+"""Policy observatory: device-side rule analytics, feed-starvation
+accounting, SLO burn rates, and their surfaces (/debug/rules,
+/debug/utilization, kyverno_rule_* / kyverno_slo_* metrics,
+`apply --rule-stats`, `kyverno-tpu top`)."""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from kyverno_tpu.api.policy import ClusterPolicy
+from kyverno_tpu.observability.analytics import (
+    RuleIdent, RuleStatsAccumulator, RuleStatsCollector, SloConfig,
+    SloTracker, StarvationTracker, class_counts, global_rule_stats,
+    policy_spec_hash)
+from kyverno_tpu.tpu.engine import TpuEngine
+
+
+def make_policy(name, rules):
+    return ClusterPolicy.from_dict({
+        "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+        "metadata": {"name": name},
+        "spec": {"validationFailureAction": "Enforce", "rules": rules}})
+
+
+NAME_RULE = {
+    "name": "named",
+    "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+    "validate": {"message": "m",
+                 "pattern": {"metadata": {"name": "p?*"}}},
+}
+# matches a kind the workload never contains -> never fires (the
+# runtime half of shadow/dead-rule detection)
+SHADOWED_RULE = {
+    "name": "shadowed",
+    "match": {"any": [{"resources": {"kinds": ["Gateway"]}}]},
+    "validate": {"message": "m",
+                 "pattern": {"metadata": {"name": "?*"}}},
+}
+# CEL validate lowers to a host-fallback rule (fallback_reason set):
+# exercises the host-row branch of the device-count merge
+CEL_RULE = {
+    "name": "cel-host",
+    "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+    "validate": {"cel": {"expressions": [
+        {"expression": "object.metadata.name != 'x'"}]}},
+}
+
+
+def workload(n=7):
+    # mixed outcomes, unique names (snapshot upserts key on
+    # kind/ns/name): odd names pass the p?* pattern, even ones fail it
+    return [{"apiVersion": "v1", "kind": "Pod",
+             "metadata": {"name": (f"p{i}" if i % 2 else f"x{i}"),
+                          "namespace": "d"},
+             "spec": {"containers": [{"name": "c", "image": "nginx"}]}}
+            for i in range(n)]
+
+
+def counts_snapshot():
+    return sorted(
+        (r["policy"], r["rule"], r["pass"], r["skip"], r["fail"],
+         r["not_matched"], r["error"])
+        for r in global_rule_stats.rule_rows())
+
+
+# ---------------------------------------------------------------------------
+# primitives
+
+
+def test_verdict_class_constants_mirror_evaluator():
+    """analytics.py must stay importable without jax, so it mirrors the
+    evaluator's verdict codes — this is the drift tripwire."""
+    from kyverno_tpu.observability import analytics
+    from kyverno_tpu.tpu import evaluator
+
+    assert (analytics.PASS, analytics.SKIP, analytics.FAIL,
+            analytics.NOT_MATCHED, analytics.ERROR, analytics.HOST) == (
+        evaluator.PASS, evaluator.SKIP, evaluator.FAIL,
+        evaluator.NOT_MATCHED, evaluator.ERROR, evaluator.HOST)
+    assert analytics.NUM_CLASSES == evaluator.NUM_VERDICT_CLASSES
+
+
+def test_class_counts_matches_naive_loop():
+    rng = np.random.default_rng(7)
+    table = rng.integers(0, 6, size=(11, 37)).astype(np.int32)
+    got = class_counts(table)
+    for ri in range(11):
+        for c in range(6):
+            assert got[ri, c] == int((table[ri] == c).sum())
+    assert class_counts(np.zeros((0, 5), np.int32)).shape == (0, 6)
+    # 1-D column input
+    col = np.array([0, 2, 2, 4], np.int32)
+    got = class_counts(col)
+    assert got[1, 2] == 1 and got[2, 2] == 1 and got[3, 4] == 1
+
+
+def test_policy_spec_hash_survives_rename_and_tracks_content():
+    p1 = make_policy("alpha", [NAME_RULE])
+    p2 = make_policy("beta", [NAME_RULE])          # renamed, same spec
+    p3 = make_policy("alpha", [NAME_RULE, SHADOWED_RULE])  # content moved
+    assert policy_spec_hash(p1) == policy_spec_hash(p2)
+    assert policy_spec_hash(p1) != policy_spec_hash(p3)
+
+
+def test_accumulator_register_and_fired_tracking():
+    clock = [100.0]
+    acc = RuleStatsAccumulator(clock=lambda: clock[0])
+    idents = [RuleIdent("h1", "p", "r1", True),
+              RuleIdent("h1", "p", "r2", True)]
+    acc.register(idents)
+    clock[0] = 160.0
+    # r1 fires (2 pass), r2 only not-matched
+    acc.ingest_counts(idents, np.array([[2, 0, 0, 1, 0, 0],
+                                        [0, 0, 0, 3, 0, 0]]),
+                      source="device")
+    rep = acc.report(now=160.0)
+    assert rep["rules_tracked"] == 2
+    assert [r["rule"] for r in rep["top"]] == ["r1"]
+    assert rep["top"][0]["by_source"] == {"device": 3}
+    never = rep["never_fired"]
+    assert [r["rule"] for r in never] == ["r2"]
+    assert never[0]["age_s"] == 60.0  # age since registration, not ingest
+
+
+# ---------------------------------------------------------------------------
+# satellite: device vs scalar vs breaker-OPEN vs pipelined parity
+
+
+class _OpenBreaker:
+    name = "test-open"
+    state = "open"
+
+    def allow(self):
+        return False
+
+    def record_failure(self):
+        pass
+
+    def record_success(self):
+        pass
+
+
+def test_rule_stats_parity_across_dispatch_ladder(no_verdict_cache):
+    """The acceptance bar: identical per-rule counts for the same
+    workload through the device path, the breaker-OPEN scalar
+    fallback, and the pipelined scan — with a host-fallback CEL rule in
+    the set so host-row merging is exercised too."""
+    policies = [make_policy("obs-pol", [NAME_RULE, SHADOWED_RULE]),
+                make_policy("cel-pol", [CEL_RULE])]
+    res = workload()
+
+    eng = TpuEngine(policies)
+    dev_rules, total_rules = eng.coverage()
+    assert dev_rules == 2 and total_rules == 3  # CEL rule is host
+    r_dev = eng.scan(res)
+    device = counts_snapshot()
+    # sanity: the device path really counted the workload
+    fail_row = [c for c in device if c[1] == "named"][0]
+    assert fail_row[2] + fail_row[4] == 7  # 7 pods matched: pass+fail
+
+    global_rule_stats.reset()
+    eng_open = TpuEngine(policies, breaker=_OpenBreaker())
+    r_fb = eng_open.scan(res)
+    assert np.array_equal(r_fb.verdicts, r_dev.verdicts)
+    assert counts_snapshot() == device
+
+    global_rule_stats.reset()
+    from kyverno_tpu.parallel.sharding import ShardedScanner, make_mesh
+    from kyverno_tpu.tpu.pipeline import PipelinedScanner
+
+    pipe = PipelinedScanner(ShardedScanner(policies, mesh=make_mesh()))
+    seen = {}
+    pipe.scan_chunks([res[:4], res[4:]],
+                     on_result=lambda i, r: seen.setdefault(i, r))
+    assert counts_snapshot() == device
+    piped = np.concatenate([seen[0].verdicts, seen[1].verdicts], axis=1)
+    assert np.array_equal(piped, r_dev.verdicts)
+
+
+def test_rule_stats_exclude_serving_pad_slots(no_verdict_cache):
+    """live_n: pad resources ride the shape bucket but must not inflate
+    not-matched counts."""
+    eng = TpuEngine([make_policy("p", [NAME_RULE])])
+    res = workload(5)
+    eng.scan(res)
+    base = counts_snapshot()
+    global_rule_stats.reset()
+    eng.scan(res + [{}] * 6, live_n=5)
+    assert counts_snapshot() == base
+
+
+def test_rule_stats_quarantining_scan_counts_bad_columns(no_verdict_cache):
+    """A hostile resource that breaks batch encode degrades through the
+    quarantining scan; its per-rule verdicts still count exactly once."""
+    eng = TpuEngine([make_policy("p", [NAME_RULE])])
+    hostile = {"kind": b"bytes-break-encoding", "metadata": {"name": "h"}}
+    res = workload(3) + [hostile]
+    result = eng.scan(res)
+    assert result.verdicts.shape[1] == 4
+    rows = global_rule_stats.rule_rows()
+    assert len(rows) == 1
+    assert rows[0]["evals"] == 4  # 3 good + 1 quarantined column
+    assert "quarantine" in rows[0]["by_source"] or \
+        "host" in rows[0]["by_source"]
+
+
+# ---------------------------------------------------------------------------
+# satellite: cache-served verdicts count (engine + scan_once replay)
+
+
+def test_cached_rescan_reports_identical_rule_stats():
+    from kyverno_tpu.tpu.cache import global_verdict_cache
+
+    assert global_verdict_cache.enabled
+    eng = TpuEngine([make_policy("p", [NAME_RULE, SHADOWED_RULE])])
+    res = workload(6)
+    eng.scan(res)
+    cold = counts_snapshot()
+    global_rule_stats.reset()
+    eng.scan(res)  # fully cache-served now
+    assert counts_snapshot() == cold
+    rows = global_rule_stats.rule_rows()
+    assert all(set(r["by_source"]) == {"cached"} for r in rows
+               if r["evals"])
+
+
+def test_scan_once_cache_hit_partition_replays_into_accumulator():
+    """BackgroundScanService full rescan of an unchanged snapshot is
+    ≥90% cache-served — the replayed columns must reproduce the same
+    rule stats as the cold scan."""
+    from kyverno_tpu.cluster import (BackgroundScanService, ClusterSnapshot,
+                                     PolicyCache)
+
+    cache = PolicyCache()
+    cache.set(make_policy("p", [NAME_RULE, SHADOWED_RULE]))
+    snapshot = ClusterSnapshot()
+    for r in workload(8):
+        snapshot.upsert(r)
+    svc = BackgroundScanService(snapshot, cache, batch_size=4)
+    assert svc.scan_once(full=True) == 8
+    cold = counts_snapshot()
+    assert any(c[2] or c[4] for c in cold)  # something fired
+    global_rule_stats.reset()
+    assert svc.scan_once(full=True) == 8
+    assert svc.stats["verdict_cache_hits"] >= 7
+    assert counts_snapshot() == cold
+
+
+# ---------------------------------------------------------------------------
+# acceptance: shadowed rule reported never-fired after a full scan
+
+
+def test_debug_rules_reports_shadowed_rule_never_fired():
+    from kyverno_tpu.cluster import (BackgroundScanService, ClusterSnapshot,
+                                     PolicyCache)
+    from kyverno_tpu.webhooks.server import handle_debug_path
+
+    cache = PolicyCache()
+    cache.set(make_policy("obs", [NAME_RULE, SHADOWED_RULE]))
+    snapshot = ClusterSnapshot()
+    for r in workload(6):
+        snapshot.upsert(r)
+    svc = BackgroundScanService(snapshot, cache)
+    assert svc.scan_once(full=True) == 6
+
+    code, body, ctype = handle_debug_path("/debug/rules?top=5")
+    assert code == 200 and ctype == "application/json"
+    doc = json.loads(body)
+    hot = {(r["policy"], r["rule"]) for r in doc["top"]}
+    never = {(r["policy"], r["rule"]) for r in doc["never_fired"]}
+    assert ("obs", "named") in hot
+    assert ("obs", "shadowed") in never
+    assert all(r["age_s"] >= 0 for r in doc["never_fired"])
+    pol = [p for p in doc["policies"] if p["policy"] == "obs"][0]
+    assert pol["device_coverage"] == 1.0
+    # the shadowed rule plus whatever autogen expansion added (those
+    # siblings match kinds absent from this workload too)
+    assert pol["never_fired"] >= 1
+    # bad query param is a 400, not a traceback
+    assert handle_debug_path("/debug/rules?top=x")[0] == 400
+
+
+def test_debug_utilization_surface():
+    from kyverno_tpu.webhooks.server import handle_debug_path
+
+    # drive one scan so starvation/utilization have samples
+    eng = TpuEngine([make_policy("p", [NAME_RULE])])
+    eng.scan(workload(4))
+    code, body, _ = handle_debug_path("/debug/utilization")
+    assert code == 200
+    doc = json.loads(body)
+    ratio = doc["feed_starvation"]["ratio"]
+    assert 0.0 <= ratio <= 1.0
+    assert "encode_wait" in doc["feed_starvation"]["seconds_total"]
+    assert "slo" in doc and "pipeline" in doc
+    assert "verdict_hit_rate" in doc["perf_caches"]
+
+
+# ---------------------------------------------------------------------------
+# starvation tracker + pipeline gauge liveness (satellite 1)
+
+
+def test_starvation_tracker_windows_and_bounds():
+    clock = [0.0]
+    tr = StarvationTracker(window_s=10.0, clock=lambda: clock[0])
+    assert tr.ratio() == 0.0
+    tr.record(busy_s=1.0, starved_s=3.0)
+    assert tr.ratio() == 0.75
+    tr.record(busy_s=1.0, starved_s=0.0)
+    assert tr.ratio() == 0.6
+    clock[0] = 60.0  # both events age out of the window
+    assert tr.ratio() == 0.0
+    assert tr.state()["seconds_total"]["device_busy"] == 2.0
+
+
+def test_pipeline_overlap_gauge_updates_per_chunk(no_verdict_cache):
+    """Satellite: mid-scan scrapes must see live overlap values — the
+    gauge is set from drain(), once per chunk, not once at scan end."""
+    from kyverno_tpu.observability.metrics import global_registry
+    from kyverno_tpu.parallel.sharding import ShardedScanner, make_mesh
+    from kyverno_tpu.tpu.pipeline import PipelinedScanner
+
+    pipe = PipelinedScanner(
+        ShardedScanner([make_policy("p", [NAME_RULE])], mesh=make_mesh()))
+    updates = []
+    orig_set = global_registry.pipeline_overlap.set
+
+    def spy(value, labels=None):
+        updates.append(value)
+        orig_set(value, labels)
+
+    global_registry.pipeline_overlap.set = spy
+    try:
+        res = workload(9)
+        stats = pipe.scan_chunks([res[:3], res[3:6], res[6:]])
+    finally:
+        global_registry.pipeline_overlap.set = orig_set
+    # one live update per chunk + the final one from the finally block
+    assert len(updates) >= 4
+    assert len(stats["timeline"]) == 3
+    assert {t["chunk"] for t in stats["timeline"]} == {0, 1, 2}
+    assert all(t["path"] == "device" for t in stats["timeline"])
+    assert 0.0 <= stats["overlap_ratio"]
+    starv = global_registry.feed_starvation.value()
+    assert 0.0 <= starv <= 1.0
+
+
+# ---------------------------------------------------------------------------
+# SLO layer
+
+
+def test_slo_burn_rates_multi_window():
+    clock = [1000.0]
+    slo = SloTracker(
+        config=SloConfig(admission_p99_target_ms=10.0,
+                         admission_error_budget=0.1,
+                         windows={"short": 60.0, "long": 600.0}),
+        metrics=object(),  # no gauge surface: state() is the API here
+        clock=lambda: clock[0])
+    # 8 fast + 2 slow in the short window -> 20% violations / 10%
+    # budget = burn 2.0
+    for _ in range(8):
+        slo.record_admission(0.001)
+    for _ in range(2):
+        slo.record_admission(0.5)
+    st = slo.state(now=clock[0])
+    assert st["admission"]["windows"]["short"]["burn_rate"] == 2.0
+    assert st["admission"]["windows"]["long"]["burn_rate"] == 2.0
+    assert "admission_latency" in st["breached"]
+    # the short window forgets, the long window remembers
+    clock[0] += 120.0
+    for _ in range(40):
+        slo.record_admission(0.001)
+    st = slo.state(now=clock[0])
+    assert st["admission"]["windows"]["short"]["burn_rate"] == 0.0
+    assert st["admission"]["windows"]["long"]["burn_rate"] == \
+        pytest.approx((2 / 50) / 0.1)
+    # scan freshness burns as the clock runs without scans
+    slo.record_scan(coverage=0.95)
+    st = slo.state(now=clock[0] + 30.0)
+    assert st["scan_freshness"]["seconds_since_scan"] == 30.0
+    assert st["scan_freshness"]["burn_rate"] < 1.0
+    st = slo.state(now=clock[0] + 900.0)
+    assert "scan_freshness" in st["breached"]
+    # coverage floor
+    slo.set_device_coverage(0.5)
+    st = slo.state(now=clock[0] + 30.0)
+    assert "device_coverage" in st["breached"]
+
+
+def test_slo_gauges_on_metrics_and_readyz_state():
+    from kyverno_tpu.cluster import PolicyCache
+    from kyverno_tpu.observability.analytics import global_slo
+    from kyverno_tpu.observability.metrics import global_registry
+    from kyverno_tpu.webhooks import build_handlers
+
+    global_slo.record_admission(0.002)
+    global_slo.record_scan(coverage=1.0)
+    text = global_registry.exposition()
+    assert "kyverno_slo_admission_burn_rate" in text
+    assert "kyverno_slo_scan_freshness_seconds" in text
+    assert "kyverno_slo_device_coverage_ratio" in text
+    cache = PolicyCache()
+    cache.set(make_policy("p", [NAME_RULE]))
+    handlers = build_handlers(cache)
+    ok, detail = handlers.ready()
+    assert "slo" in detail
+    assert detail["slo"]["device_coverage"]["ratio"] == 1.0
+    assert "windows" in detail["slo"]["admission"]
+
+
+def test_admission_pipeline_feeds_slo_window():
+    from kyverno_tpu.observability.analytics import global_slo
+    from kyverno_tpu.serving import AdmissionPipeline, BatchConfig
+
+    pipe = AdmissionPipeline(
+        lambda padded: ["ok" for p in padded if p is not None],
+        config=BatchConfig(max_batch_size=4, max_wait_ms=1.0))
+    try:
+        for _ in range(5):
+            assert pipe.submit("x") == "ok"
+    finally:
+        pipe.stop()
+    st = global_slo.state()
+    windows = st["admission"]["windows"]
+    assert any(w["requests"] >= 5 for w in windows.values())
+
+
+# ---------------------------------------------------------------------------
+# cardinality-bounded exposition (satellite 4 lives with the validator
+# test too; this is the dedicated guard)
+
+
+def _parse_policy_labels(text, family):
+    import re
+
+    out = []
+    for line in text.splitlines():
+        m = re.match(rf'{family}\{{policy="([^"]+)"\}} ([0-9.eE+-]+)$', line)
+        if m:
+            out.append((m.group(1), float(m.group(2))))
+    return out
+
+
+def test_rule_metric_cardinality_collapses_into_overflow():
+    acc = RuleStatsAccumulator(clock=lambda: 0.0)
+    k = 5
+    n_policies = k + 7
+    total_evals = 0
+    for i in range(n_policies):
+        ident = RuleIdent(f"hash{i}", f"pol-{i:02d}", "r", True)
+        evals = 10 * (i + 1)
+        total_evals += evals
+        acc.ingest_counts([ident], np.array([[evals, 0, 0, 0, 0, 0]]))
+    coll = RuleStatsCollector(accumulator=acc, top_k=k)
+    text = "\n".join(coll.collect())
+    series = _parse_policy_labels(text, "kyverno_rule_evals_total")
+    labels = {s[0] for s in series}
+    # bounded: exactly top-K named policies + ONE overflow bucket
+    assert len(series) == k + 1
+    assert "_overflow" in labels
+    # top-K by eval volume keep their own label
+    expect_named = {f"pol-{i:02d}" for i in range(n_policies - k, n_policies)}
+    assert labels - {"_overflow"} == expect_named
+    # nothing lost: the overflow bucket carries the remainder
+    assert sum(v for _, v in series) == total_evals
+    # per-family coverage: every family stays bounded
+    for fam in ("kyverno_rule_fired_total", "kyverno_rule_fail_total",
+                "kyverno_rule_never_fired", "kyverno_policy_device_coverage"):
+        assert len(_parse_policy_labels(text, fam)) == k + 1
+
+
+def test_rule_metrics_on_global_registry_exposition():
+    from kyverno_tpu.observability.metrics import global_registry
+
+    eng = TpuEngine([make_policy("expo", [NAME_RULE, SHADOWED_RULE])])
+    eng.scan(workload(4))
+    text = global_registry.exposition()
+    assert 'kyverno_rule_evals_total{policy="expo"}' in text
+    assert 'kyverno_rule_never_fired{policy="expo"} 1.0' in text
+    assert 'kyverno_policy_device_coverage{policy="expo"} 1.0' in text
+
+
+# ---------------------------------------------------------------------------
+# admission paths: device vs scalar toggle vs submit-cache parity
+
+
+def _mk_handlers(**kw):
+    from kyverno_tpu.cluster import PolicyCache
+    from kyverno_tpu.webhooks import build_handlers
+
+    cache = PolicyCache()
+    cache.set(make_policy("adm", [NAME_RULE, SHADOWED_RULE]))
+    return build_handlers(cache, **kw)
+
+
+def _payloads(n=5):
+    from kyverno_tpu.engine.match import RequestInfo
+    from kyverno_tpu.webhooks.server import AdmissionPayload
+
+    return [AdmissionPayload(r, "CREATE", RequestInfo(), "d")
+            for r in workload(n)]
+
+
+def test_admission_device_vs_scalar_toggle_rule_stats_parity(
+        no_verdict_cache):
+    from kyverno_tpu.config import Toggles
+
+    handlers = _mk_handlers()
+    pads = _payloads() + [None] * 3
+    handlers._evaluate_padded(list(pads))
+    device = counts_snapshot()
+    assert any(c[2] or c[4] for c in device)
+
+    global_rule_stats.reset()
+    handlers_scalar = _mk_handlers(toggles=Toggles(engine="scalar"))
+    handlers_scalar._evaluate_padded(list(pads))
+    assert counts_snapshot() == device
+
+
+def test_submit_time_cache_hit_replays_column():
+    """A repeat admission served at submit() (before the queue) still
+    lands in the accumulator, tagged as cached."""
+    handlers = _mk_handlers()
+    payload = _payloads(1)[0]
+    handlers._evaluate_padded([payload])  # populates the verdict cache
+    base = counts_snapshot()
+    global_rule_stats.reset()
+    rows = handlers._cached_verdict_rows(payload)
+    assert rows is not None
+    assert counts_snapshot() == base
+    tracked = global_rule_stats.rule_rows()
+    assert all(set(r["by_source"]) == {"cached"} for r in tracked
+               if r["evals"])
+
+
+# ---------------------------------------------------------------------------
+# CLI: apply --rule-stats and kyverno-tpu top
+
+
+def test_apply_rule_stats_flag(tmp_path, capsys):
+    import yaml
+
+    from kyverno_tpu.cli.__main__ import main
+
+    pol = {"apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+           "metadata": {"name": "cli-pol"},
+           "spec": {"rules": [NAME_RULE, SHADOWED_RULE]}}
+    pf = tmp_path / "pol.yaml"
+    pf.write_text(yaml.safe_dump(pol))
+    rf = tmp_path / "res.yaml"
+    rf.write_text(yaml.safe_dump_all(workload(3)))
+    rc = main(["apply", str(pf), "-r", str(rf), "--rule-stats"])
+    assert rc in (0, 1)
+    err = capsys.readouterr().err
+    assert "per-rule analytics" in err
+    assert "cli-pol/named" in err
+    assert "never fired" in err and "cli-pol/shadowed" in err
+
+
+def test_top_command_renders_against_live_serve(capsys):
+    from kyverno_tpu.cli.serve import ControlPlane
+    from kyverno_tpu.cli.__main__ import main
+
+    cp = ControlPlane([make_policy("top-pol", [NAME_RULE, SHADOWED_RULE])],
+                      port=0, metrics_port=0)
+    cp.start(scan_interval=3600.0)
+    try:
+        for r in workload(4):
+            _post_json(cp, "/snapshot/upsert", r)
+        _post_json(cp, "/scan", {"full": True})
+        port = cp.metrics_server.server_address[1]
+        rc = main(["top", "--port", str(port), "--iterations", "1",
+                   "--no-clear"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "kyverno-tpu top" in out
+        assert "top-pol/named" in out
+        # autogen expansion adds sibling rules; the shadowed one must be
+        # listed among the never-fired set either way
+        assert "never fired (" in out and "top-pol/shadowed" in out
+        assert "feed starvation" in out
+    finally:
+        cp.stop()
+
+
+def _post_json(cp, path, doc):
+    import http.client
+
+    port = cp.metrics_server.server_address[1]
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    try:
+        conn.request("POST", path, json.dumps(doc),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        body = resp.read()
+        assert resp.status == 200, body
+        return json.loads(body)
+    finally:
+        conn.close()
+
+
+# ---------------------------------------------------------------------------
+# device-count merge corner: stale stashes must never leak
+
+
+def test_pending_counts_cleared_on_dispatch_failure(no_verdict_cache):
+    """A dispatch that fails AFTER the device returned (shape
+    validation) must not leave its counts behind for the all-HOST
+    fallback assemble — counts then come from the final table."""
+    from kyverno_tpu.resilience.faults import global_faults
+
+    eng = TpuEngine([make_policy("p", [NAME_RULE])])
+    res = workload(4)
+    expected = eng.scan(res)
+    base = counts_snapshot()
+    global_rule_stats.reset()
+    global_faults.arm("tpu.dispatch", mode="raise", p=1.0)
+    try:
+        r = eng.scan(res)
+    finally:
+        global_faults.disarm()
+        eng.breaker.reset()
+    assert np.array_equal(r.verdicts, expected.verdicts)
+    assert counts_snapshot() == base
+    rows = global_rule_stats.rule_rows()
+    assert all(set(r["by_source"]) == {"host"} for r in rows if r["evals"])
