@@ -31,6 +31,11 @@ Other configs (run `python bench.py <name>`):
              same snapshot scanned uncached, cache-cold (inserting),
              and cache-warm (serving columns from the LRU); records
              the hit rate and speedup (BENCH_CACHED_RESOURCES)
+  encode_scaling  supervised encoder-pool throughput at 1/2/4 worker
+             processes + pipelined-scan feed-starvation with the pool
+             on vs off (BENCH_ENCODE_RESOURCES / _CHUNK /
+             _WORKERS_LIST); the encode-bottleneck roadmap item's
+             measured leg
 
 The driver also measures the persistent XLA compilation cache
 (tpu/cache.py enable_xla_compile_cache): a cold-vs-warm compile of the
@@ -723,6 +728,107 @@ def bench_cached(n_resources=None, tile=1024):
 
 
 # ---------------------------------------------------------------------------
+# encoder-pool scaling: the device feed must scale with worker
+# processes (ROADMAP item 1: one Python encoder caps the whole scan).
+# Measures raw encode throughput at 1/2/4 workers through the
+# supervised pool, then a pipelined scan's feed-starvation ratio with
+# the pool on vs off. Honest numbers: on a core-starved box the pool
+# cannot beat the core count — host_cpus rides the artifact.
+
+
+def bench_encode_scaling():
+    from kyverno_tpu.encode import KIND_VOCAB, EncoderPool
+    from kyverno_tpu.observability.analytics import global_starvation
+    from kyverno_tpu.parallel import ShardedScanner
+    from kyverno_tpu.policies import load_pss_policies
+    from kyverno_tpu.policy.autogen import expand_policy
+    from kyverno_tpu.tpu.pipeline import (PipelinedScanner,
+                                          scanner_encode_profile)
+
+    n = int(os.environ.get("BENCH_ENCODE_RESOURCES", "6000"))
+    chunk = int(os.environ.get("BENCH_ENCODE_CHUNK", "512"))
+    worker_counts = [int(w) for w in os.environ.get(
+        "BENCH_ENCODE_WORKERS_LIST", "1,2,4").split(",") if w]
+    snapshot = make_snapshot(n, seed=31)
+    chunks = [snapshot[i:i + chunk] for i in range(0, n, chunk)]
+    policies = [expand_policy(p) for p in load_pss_policies()]
+    scanner = ShardedScanner(policies)
+    profile = scanner_encode_profile(scanner)
+    out = {"metric": "encode_pool_scaling_4v1", "value": 0.0, "unit": "x",
+           "vs_baseline": 0.0, "resources": n, "chunk": chunk,
+           "host_cpus": os.cpu_count(), "workers": {}}
+
+    def encode_all(pool, pid):
+        buckets = (scanner._vbucket, scanner._sbucket, scanner._rbucket)
+        handles = [pool.submit(pid, KIND_VOCAB,
+                               {"resources": list(c), "buckets": buckets})
+                   for c in chunks]
+        for h in handles:
+            pool.await_result(h)
+
+    base = None
+    for w in worker_counts:
+        pool = EncoderPool(w).start()
+        try:
+            pool.wait_ready(60)
+            pid = pool.register_profile(profile)
+            # warm one chunk per worker (interpreter + memo warmup is
+            # startup cost, not steady-state throughput) — submitted
+            # CONCURRENTLY so each idle worker takes one; sequential
+            # blocking calls would all land on worker 0
+            warm = [pool.submit(pid, KIND_VOCAB,
+                                {"resources": list(chunks[0]),
+                                 "buckets": (scanner._vbucket,
+                                             scanner._sbucket,
+                                             scanner._rbucket)})
+                    for _ in range(w)]
+            for h in warm:
+                pool.await_result(h)
+            t0 = time.perf_counter()
+            encode_all(pool, pid)
+            dt = time.perf_counter() - t0
+        finally:
+            pool.stop()
+        rate = round(n / max(dt, 1e-9), 1)
+        out["workers"][str(w)] = {"encode_res_per_sec": rate,
+                                  "seconds": round(dt, 3),
+                                  "restarts": pool.restarts}
+        if base is None:
+            base = rate
+        emit(out)
+    top = max(worker_counts)
+    out["value"] = round(
+        out["workers"][str(top)]["encode_res_per_sec"] / max(base, 1e-9), 2)
+    out["vs_baseline"] = out["value"]
+
+    # feed starvation: pipelined scan with 1 worker vs the widest pool
+    # (the gauge the encode pool exists to push down). One full
+    # in-process pass FIRST, untimed, so every XLA shape the chunks
+    # produce is compiled — otherwise the first leg's wall is XLA
+    # build, not feed behavior, and its starvation ratio is noise
+    PipelinedScanner(scanner).scan_chunks(chunks)
+    starvation = {}
+    for label, w in (("workers_1", 1), (f"workers_{top}", top)):
+        pool = EncoderPool(w).start()
+        try:
+            pool.wait_ready(60)
+            global_starvation.reset()
+            pipe = PipelinedScanner(scanner, encode_pool=pool)
+            pstats = pipe.scan_chunks(chunks)
+            starvation[label] = {
+                "feed_starvation_ratio": global_starvation.ratio(),
+                "overlap_ratio": pstats["overlap_ratio"],
+                "wall_s": round(pstats["wall_s"], 3),
+                "e2e_res_per_sec": round(
+                    n / max(pstats["wall_s"], 1e-9), 1),
+            }
+        finally:
+            pool.stop()
+    out["feed_starvation_by_workers"] = starvation
+    return out
+
+
+# ---------------------------------------------------------------------------
 # forced host-fallback: a host-only rule over a mixed snapshot must cost
 # O(matched cells), not O(policies x resources) — the scalar completion
 # pre-screens with the matcher before building contexts
@@ -853,6 +959,7 @@ FNS = {
     "fallback": lambda: bench_fallback(),
     "churn": lambda: bench_churn(),
     "cached": lambda: bench_cached(),
+    "encode_scaling": lambda: bench_encode_scaling(),
 }
 
 
@@ -1057,7 +1164,7 @@ def run_all():
         out["mixed_corpus_coverage"] = {"error": repr(e)[:300]}
     emit(out)
     for name in ("match", "overlay", "apply", "admission", "fallback",
-                 "cached", "churn"):
+                 "cached", "encode_scaling", "churn"):
         if only and name not in only:
             continue
         t0 = time.perf_counter()

@@ -88,6 +88,10 @@ def add_parser(sub: argparse._SubParsersAction) -> None:
     p.add_argument("--xla-trace", default=None, metavar="DIR",
                    help="capture one jax.profiler trace of the validate "
                         "stage into DIR (XLA-level profiling)")
+    p.add_argument("--encode-workers", type=int, default=None, metavar="N",
+                   help="encoder worker processes for the resource encode "
+                        "(default $KYVERNO_TPU_ENCODE_WORKERS or 0; 0 = "
+                        "in-process encode, byte-for-byte today's path)")
     p.set_defaults(func=run)
 
 
@@ -287,14 +291,19 @@ def run(args: argparse.Namespace) -> int:
     ns_labels = {(d.get("metadata") or {}).get("name", ""):
                  ((d.get("metadata") or {}).get("labels") or {})
                  for d in resource_docs if d.get("kind") == "Namespace"}
+    from ..encode import configure_pool, shutdown_pool
     from ..observability.profiling import maybe_xla_trace
 
-    with maybe_xla_trace(getattr(args, "xla_trace", None)):
-        rows = (mutate_rows + vi_rows
-                + (_verdict_rows(policies, resource_docs, ns_labels or None,
-                                 args.engine)
-                   if policies else [])
-                + _vap_rows(vap_docs, resource_docs, ns_labels))
+    configure_pool(getattr(args, "encode_workers", None))
+    try:
+        with maybe_xla_trace(getattr(args, "xla_trace", None)):
+            rows = (mutate_rows + vi_rows
+                    + (_verdict_rows(policies, resource_docs,
+                                     ns_labels or None, args.engine)
+                       if policies else [])
+                    + _vap_rows(vap_docs, resource_docs, ns_labels))
+    finally:
+        shutdown_pool()  # drain + join: apply leaves zero children
 
     counts = {"pass": 0, "fail": 0, "warn": 0, "error": 0, "skip": 0}
     failures: List[Tuple[str, str, str, str]] = []

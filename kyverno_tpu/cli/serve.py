@@ -87,6 +87,14 @@ def add_parser(sub: argparse._SubParsersAction) -> None:
                    help="encode-row LRU capacity in entries "
                         "(default $KYVERNO_TPU_ENCODE_CACHE or 8192; "
                         "0 disables)")
+    # supervised multiprocess encode pool (encode/pool.py): scales the
+    # device feed past one Python process, with crash/hang supervision,
+    # poison-resource quarantine, and an encode-pool breaker that
+    # bypasses to in-process encode
+    p.add_argument("--encode-workers", type=int, default=None, metavar="N",
+                   help="encoder worker processes feeding the device "
+                        "(default $KYVERNO_TPU_ENCODE_WORKERS or 0; "
+                        "0 keeps the in-process encode path byte-for-byte)")
     # policy observatory (observability/analytics.py): SLO targets for
     # the kyverno_slo_* burn-rate gauges + /readyz state, and the
     # cardinality bound on the per-policy kyverno_rule_* metrics
@@ -209,6 +217,11 @@ class ControlPlane:
         self.admission.stop()
         self.lifecycle.stop()
         self.metrics_server.shutdown()
+        # encoder-pool drain rides the lifecycle: in-flight chunks
+        # finish (bounded), workers join, zero orphan children
+        from ..encode import shutdown_pool
+
+        shutdown_pool()
         self._cleanup_on_shutdown(self.snapshot, self.lease_store)
 
 
@@ -309,6 +322,15 @@ def run(args: argparse.Namespace) -> int:
     xla_dir = enable_xla_compile_cache(args.xla_cache_dir)
     if xla_dir:
         print(f"persistent XLA compile cache: {xla_dir}", file=sys.stderr)
+    # the encoder pool spawns BEFORE any compile: worker interpreters
+    # come up (JAX-free) while the parent pays the XLA build
+    from ..encode import configure_pool
+
+    pool = configure_pool(args.encode_workers)
+    if pool is not None:
+        print(f"encode pool: {pool.n_workers} worker processes "
+              f"(supervised; breaker-backed; --encode-workers 0 disables)",
+              file=sys.stderr)
     configuration = Configuration()
     if args.config:
         with open(args.config) as f:

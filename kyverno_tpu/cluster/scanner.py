@@ -309,6 +309,12 @@ class BackgroundScanService:
                                           on_result=on_result)
                 self.stats["pipeline_overlap_ratio"] = \
                     pstats["overlap_ratio"]
+                # the supervised encode pool (encode/pool.py) feeds the
+                # pipeline when configured: surface its health next to
+                # the scan numbers (worker churn here is an incident
+                # breadcrumb, not just a /metrics curve)
+                if "encode_pool" in pstats:
+                    self.stats["encode_pool"] = pstats["encode_pool"]
             except Exception:
                 # the pipeline's own ladder (quarantine, breaker,
                 # scalar completion) should have absorbed this — if it

@@ -212,6 +212,24 @@ class MetricsRegistry:
             "kyverno_serving_flusher_seconds_total",
             "admission flusher wall seconds by state "
             "(wait_queue/evaluate/resolve/request_queue_wait)")
+        # encoder pool (encode/pool.py): the supervised multiprocess
+        # device feed — worker population and churn, dispatch queue
+        # pressure, and per-chunk outcomes across the whole ladder
+        # (ok / retried_ok / poison / encode_error / infra_fail /
+        # bypass)
+        self.encode_pool_workers = self.gauge(
+            "kyverno_encode_pool_workers_alive",
+            "encoder-pool worker processes alive and ready")
+        self.encode_pool_restarts = self.counter(
+            "kyverno_encode_pool_restarts_total",
+            "encoder-pool workers restarted after a crash, hang, or "
+            "silent heartbeat")
+        self.encode_pool_queue_depth = self.gauge(
+            "kyverno_encode_pool_queue_depth",
+            "encode chunks queued or in flight on pool workers")
+        self.encode_pool_chunks = self.counter(
+            "kyverno_encode_pool_chunks_total",
+            "encode chunks dispatched to the pool by outcome")
         # SLO layer (observability/analytics.py SloTracker): rolling-
         # window multi-rate burn-rate gauges; state also rides /readyz
         self.slo_admission_p99 = self.gauge(

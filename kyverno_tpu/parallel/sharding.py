@@ -155,26 +155,24 @@ class ShardedScanner:
         return ((n + d - 1) // d) * d
 
     def encode(self, resources, namespace_labels=None, operations=None):
-        n = len(resources)
-        padded = self.pad(max(n, 1))
-        res = list(resources) + [{} for _ in range(padded - n)]
-        ops = (list(operations) + [""] * (padded - n)) if operations else None
-        vb = encode_resources_vocab(res, self.cps.encode_cfg, self.cps.byte_paths,
-                                    self.cps.key_byte_paths)
-        meta = encode_metadata(res, namespace_labels, ops, cfg=self.cps.meta_cfg,
-                               need=getattr(self, "_meta_need", None))
-        while self._vbucket < vb.vocab_size:
-            self._vbucket *= 2
-        while self._sbucket < len(vb.strs):
-            self._sbucket *= 2
-        max_rows = self.cps.encode_cfg.max_rows
-        while (self._rbucket < int(vb.n_rows.max(initial=0))
-               and self._rbucket < max_rows):
-            self._rbucket = min(self._rbucket * 2, max_rows)
-        host = vb.to_host(meta, self._vbucket, self._sbucket, self._rbucket)
-        used = getattr(self, "_used_keys", None)
-        if used is not None:
-            host = {k: v for k, v in host.items() if k in used}
+        # the ONE vocab-encode body, shared with the encoder-pool
+        # workers (encode/tasks.py run_vocab drives the same function
+        # against the shipped profile) so pooled and in-process encodes
+        # cannot drift
+        from ..encode.tasks import encode_vocab_host
+
+        host, n, buckets = encode_vocab_host(
+            resources, namespace_labels, operations,
+            self.cps.encode_cfg, self.cps.byte_paths,
+            self.cps.key_byte_paths, self.cps.meta_cfg,
+            getattr(self, "_meta_need", None),
+            getattr(self, "_used_keys", None),
+            self.n_devices,
+            (self._vbucket, self._sbucket, self._rbucket),
+            # late-bound through THIS module so a patched
+            # sharding.encode_resources_vocab still intercepts
+            encoder=lambda *a, **kw: encode_resources_vocab(*a, **kw))
+        self._vbucket, self._sbucket, self._rbucket = buckets
         return host, n
 
     def scan_device(self, resources, namespace_labels=None, operations=None) -> Tuple[np.ndarray, np.ndarray]:

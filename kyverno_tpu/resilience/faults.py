@@ -10,13 +10,23 @@ the failure physically happens:
     serving.flush       the admission pipeline's batch evaluation
     policyset.compile   the lifecycle manager's compile-ahead lowering
                         (full-set compiles AND per-policy bisect probes)
+    encode.pool_dispatch  the encoder pool's supervisor-side chunk
+                          dispatch (encode/pool.py)
+    encode.worker       the encode executed INSIDE a pool worker
+                        process (encode/worker.py)
 
 Tests (and the ``KYVERNO_TPU_FAULTS`` env knob) arm a site with a
 probability- or count-based trigger and a mode — ``raise``, ``delay``,
-or ``corrupt`` (shape-mangle the site's result) — so degradation paths
+``corrupt`` (shape-mangle the site's result), or ``crash``
+(``os._exit`` the current process — only meaningful at
+``encode.worker``, where a supervised worker process dying is a
+first-class failure the pool must absorb) — so degradation paths
 are exercised deterministically in CI instead of waiting for real
 hardware to misbehave. Probability triggers draw from a per-fault
-seeded RNG, making a chaos run replayable.
+seeded RNG, making a chaos run replayable. A ``match=<substring>``
+option scopes a fault to calls whose payload (e.g. the chunk of
+resources a worker is encoding) contains the substring — the poison-
+resource chaos tests use it to make ONE resource reliably lethal.
 
 ``corrupt`` is only meaningful at sites that pass their RESULT through
 ``FaultRegistry.corrupt()`` (today: ``tpu.dispatch``, whose verdict
@@ -28,7 +38,8 @@ Env syntax (';'-separated site specs)::
 
     KYVERNO_TPU_FAULTS="tpu.dispatch:raise:p=0.3;gctx.refresh:raise:count=3"
     site ':' mode [':' key=value (',' key=value)*]
-    keys: p=<float 0..1> | count=<int first-N calls> | delay_s=<float> | seed=<int>
+    keys: p=<float 0..1> | count=<int first-N calls> | delay_s=<float>
+          | seed=<int> | match=<substring of the call payload>
 """
 
 from __future__ import annotations
@@ -46,17 +57,26 @@ SITE_CONTEXT_IMAGE_DATA = "context.image_data"
 SITE_GCTX_REFRESH = "gctx.refresh"
 SITE_SERVING_FLUSH = "serving.flush"
 SITE_POLICYSET_COMPILE = "policyset.compile"
+SITE_ENCODE_POOL_DISPATCH = "encode.pool_dispatch"
+SITE_ENCODE_WORKER = "encode.worker"
 
 KNOWN_SITES = frozenset({
     SITE_TPU_DISPATCH, SITE_CONTEXT_API_CALL, SITE_CONTEXT_IMAGE_DATA,
     SITE_GCTX_REFRESH, SITE_SERVING_FLUSH, SITE_POLICYSET_COMPILE,
+    SITE_ENCODE_POOL_DISPATCH, SITE_ENCODE_WORKER,
 })
 
-MODES = ("raise", "delay", "corrupt")
+MODES = ("raise", "delay", "corrupt", "crash")
 
 # sites whose result flows through FaultRegistry.corrupt(); every other
 # site only has the fire() (raise/delay) hook
 CORRUPTIBLE_SITES = frozenset({SITE_TPU_DISPATCH})
+
+# sites where mode=crash (os._exit) is meaningful: the site runs in a
+# SUPERVISED child process whose death the parent is built to absorb.
+# Crashing an unsupervised site would just kill the engine — reject it
+# at arm time like corrupt-at-non-filtering sites.
+CRASHABLE_SITES = frozenset({SITE_ENCODE_WORKER})
 
 
 class FaultInjected(RuntimeError):
@@ -75,6 +95,7 @@ class FaultSpec:
     count: Optional[int] = None     # trigger on the first N calls
     delay_s: float = 0.01           # sleep for mode=delay
     seed: int = 0                   # RNG seed for probability triggers
+    match: Optional[str] = None     # only fire when payload contains this
     calls: int = 0                  # observed calls (all)
     fired: int = 0                  # calls that triggered
     _rng: Random = field(default_factory=Random, repr=False)
@@ -134,7 +155,7 @@ class FaultRegistry:
 
     def arm(self, site: str, mode: str = "raise", p: Optional[float] = None,
             count: Optional[int] = None, delay_s: float = 0.01,
-            seed: int = 0) -> FaultSpec:
+            seed: int = 0, match: Optional[str] = None) -> FaultSpec:
         if site not in KNOWN_SITES:
             raise FaultConfigError(
                 f"unknown fault site {site!r} (known: {sorted(KNOWN_SITES)})")
@@ -143,8 +164,13 @@ class FaultRegistry:
                 f"site {site!r} does not filter results through corrupt() "
                 f"(corruptible: {sorted(CORRUPTIBLE_SITES)}) — arming it "
                 f"would inject nothing")
+        if mode == "crash" and site not in CRASHABLE_SITES:
+            raise FaultConfigError(
+                f"site {site!r} does not run in a supervised child process "
+                f"(crashable: {sorted(CRASHABLE_SITES)}) — crashing it "
+                f"would kill the engine, not exercise recovery")
         spec = FaultSpec(site=site, mode=mode, p=p, count=count,
-                         delay_s=delay_s, seed=seed)
+                         delay_s=delay_s, seed=seed, match=match)
         with self._lock:
             self._armed[site] = spec
         return spec
@@ -188,6 +214,8 @@ class FaultRegistry:
                     kw["delay_s"] = float(v)
                 elif k == "seed":
                     kw["seed"] = int(v)
+                elif k == "match":
+                    kw["match"] = v
                 else:
                     raise FaultConfigError(f"unknown fault option {k!r}")
             self.arm(site, mode=mode, **kw)
@@ -196,12 +224,20 @@ class FaultRegistry:
 
     # -- firing
 
-    def fire(self, site: str) -> None:
-        """Raise/delay hook. A ``corrupt`` fault never fires here — its
-        trigger is consumed by ``corrupt()`` on the result instead."""
+    def fire(self, site: str, payload: Any = None) -> None:
+        """Raise/delay/crash hook. A ``corrupt`` fault never fires here
+        — its trigger is consumed by ``corrupt()`` on the result
+        instead. ``payload`` scopes ``match=`` faults: a string (or a
+        zero-arg callable returning one, evaluated only when a match
+        fault is armed — building the text is not free) describing the
+        call's content."""
         spec = self._armed.get(site)  # GIL-safe fast path when unarmed
         if spec is None or spec.mode == "corrupt":
             return
+        if spec.match is not None:
+            text = payload() if callable(payload) else (payload or "")
+            if spec.match not in text:
+                return
         with self._lock:
             triggered = spec._triggers()
         if not triggered:
@@ -210,6 +246,10 @@ class FaultRegistry:
         if spec.mode == "delay":
             time.sleep(spec.delay_s)
             return
+        if spec.mode == "crash":
+            # the supervised-worker death path: no cleanup, no excuses —
+            # exactly what an OOM kill or a segfaulting extension does
+            os._exit(70)
         raise FaultInjected(f"injected fault at {site}")
 
     def corrupt(self, site: str, value: Any) -> Any:

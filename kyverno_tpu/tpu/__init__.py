@@ -20,6 +20,28 @@ batched device computation:
              completion k-1 overlap instead of serializing
 """
 
-from .compiler import CompiledPolicySet, compile_policy_set
-from .engine import ScanResult, TpuEngine
+# Lazy exports (PEP 562): the compiler/engine pull in JAX, but the
+# encode-pool worker processes (encode/worker.py) import ONLY the host
+# side of this package (flatten, metadata, hashing) and must stay
+# JAX-free — an eager import here would load the full device runtime
+# into every spawned encoder.
+_LAZY = {
+    "CompiledPolicySet": ".compiler",
+    "compile_policy_set": ".compiler",
+    "ScanResult": ".engine",
+    "TpuEngine": ".engine",
+}
+
+
+def __getattr__(name):
+    mod = _LAZY.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(mod, __name__), name)
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_LAZY))
 

@@ -231,6 +231,37 @@ class _EncodedRows:
         self.fallback = fallback
 
 
+def extract_rows(batch, i: int) -> _EncodedRows:
+    """Trim row ``i`` of an encoded RowBatch to the rows/pool slots it
+    actually uses — the transferable per-resource form shared by the
+    encode-row cache and the encoder-pool workers (encode/tasks.py),
+    so pooled results and cached results are the same bytes."""
+    m = int(batch.n_rows[i])
+    lanes: Dict[str, np.ndarray] = {}
+    for name, arr in batch.arrays().items():
+        if name in ("pool", "pool_len", "n_rows", "fallback"):
+            continue
+        lanes[name] = arr[i, :m].copy()
+    used = np.nonzero(batch.pool_len[i] > 0)[0]
+    s = int(used.max()) + 1 if used.size else 0
+    pool = batch.pool[i, :s].copy() if s else None
+    pool_len = batch.pool_len[i, :s].copy() if s else None
+    return _EncodedRows(lanes, pool, pool_len, m, int(batch.fallback[i]))
+
+
+def apply_rows(entry: _EncodedRows, batch, i: int) -> None:
+    """Write a trimmed per-resource row entry into row ``i`` of a fresh
+    RowBatch (whose lanes still hold constructor defaults)."""
+    for name, row in entry.lanes.items():
+        getattr(batch, name)[i, : row.shape[0]] = row
+    if entry.pool is not None:
+        s = entry.pool.shape[0]
+        batch.pool[i, :s] = entry.pool
+        batch.pool_len[i, :s] = entry.pool_len
+    batch.n_rows[i] = entry.n_rows
+    batch.fallback[i] = entry.fallback
+
+
 class EncodeRowCache:
     """LRU of per-resource encoded rows. Keys are
     (encode-path key, resource content hash): the encode-path key
@@ -292,14 +323,7 @@ class EncodeRowCache:
         if entry is None:
             m.encode_cache.inc({"outcome": "miss"})
             return False
-        for name, row in entry.lanes.items():
-            getattr(batch, name)[i, : row.shape[0]] = row
-        if entry.pool is not None:
-            s = entry.pool.shape[0]
-            batch.pool[i, :s] = entry.pool
-            batch.pool_len[i, :s] = entry.pool_len
-        batch.n_rows[i] = entry.n_rows
-        batch.fallback[i] = entry.fallback
+        apply_rows(entry, batch, i)
         m.encode_cache.inc({"outcome": "hit"})
         return True
 
@@ -307,20 +331,16 @@ class EncodeRowCache:
         """Trim + store row ``i`` of an encoded RowBatch."""
         if not self._lru.enabled:
             return
-        m = int(batch.n_rows[i])
-        lanes: Dict[str, np.ndarray] = {}
-        for name, arr in batch.arrays().items():
-            if name in ("pool", "pool_len", "n_rows", "fallback"):
-                continue
-            lanes[name] = arr[i, :m].copy()
-        used = np.nonzero(batch.pool_len[i] > 0)[0]
-        s = int(used.max()) + 1 if used.size else 0
-        pool = batch.pool[i, :s].copy() if s else None
-        pool_len = batch.pool_len[i, :s].copy() if s else None
+        self.put_entry(key, extract_rows(batch, i))
+
+    def put_entry(self, key: Any, entry: _EncodedRows) -> None:
+        """Store an already-trimmed per-resource entry (the encoder
+        pool's rows results arrive in this form — they warm the cache
+        without a round-trip through a RowBatch)."""
+        if not self._lru.enabled:
+            return
         before = self._lru.evictions
-        self._lru.put(key, _EncodedRows(lanes, pool, pool_len,
-                                        int(batch.n_rows[i]),
-                                        int(batch.fallback[i])))
+        self._lru.put(key, entry)
         reg = self._registry()
         evicted = self._lru.evictions - before
         if evicted:

@@ -203,6 +203,9 @@ class TpuEngine:
         self._cache_ident: Optional[str] = None
         self._cache_eligible: Optional[bool] = None
         self._encode_cache_key: Optional[str] = None
+        # encoder-pool profile for the rows feed, registered lazily per
+        # pool instance (a reconfigured pool gets a fresh profile)
+        self._pool_profile: Optional[Tuple[Any, int]] = None
         # policy observatory: per-rule analytics identities + the
         # thread-local slot the device-side verdict-count reduction
         # rides from dispatch to assemble (thread-local because one
@@ -244,7 +247,16 @@ class TpuEngine:
         re-walking the JSON tree. Keyed by encode config + compiled
         byte-path sets, NOT policy content — a policy-set revision bump
         keeps every entry warm (the verdict cache misses, this one
-        doesn't)."""
+        doesn't).
+
+        With an encoder pool configured, cache MISSES encode on a
+        worker process (the serving batcher's feed rides the same
+        supervised ladder as the scan feed), and the pooled results
+        populate the shared cache — warm rows never re-enter the pool.
+        A pool bypass/infra failure falls back to in-process encode; a
+        poison resource (crashes two workers, bisected) comes back
+        flagged and is marked for host fallback exactly like an
+        encode-cap overflow — the scalar oracle answers its column."""
         from .cache import (EncodeRowCache, global_encode_cache,
                             resource_content_hash)
         from .flatten import RowBatch
@@ -265,6 +277,8 @@ class TpuEngine:
             key = (self._encode_cache_key, h) if h is not None else None
             if key is None or not ec.get_into(key, batch, i):
                 misses.append((i, key))
+        if misses and self._encode_rows_pooled(resources, batch, misses, ec):
+            return batch
         if misses:
             sub = encode_resources([resources[i] for i, _ in misses],
                                    self.cps.encode_cfg, self.cps.byte_paths,
@@ -277,6 +291,52 @@ class TpuEngine:
                 if key is not None:
                     ec.put_from(key, sub, j)
         return batch
+
+    # pooling a miss set smaller than this costs more in IPC round-trip
+    # than the in-process encode it replaces (the admission path is
+    # latency-sensitive; a near-warm cache leaves 1-2 misses per flush)
+    POOL_ROWS_MIN = 4
+
+    def _encode_rows_pooled(self, resources, batch, misses, ec) -> bool:
+        """Encode the cache misses on the encoder pool; True when the
+        batch rows were filled (False -> caller encodes in-process)."""
+        if len(misses) < self.POOL_ROWS_MIN:
+            return False
+        from ..encode import (KIND_ROWS, PoolBypassed, PoolInfraError,
+                              WorkerEncodeError, get_pool, profile_spec)
+        from .cache import apply_rows
+
+        pool = get_pool()
+        if pool is None or not pool.running:
+            return False
+        try:
+            if (self._pool_profile is None
+                    or self._pool_profile[0] is not pool):
+                self._pool_profile = (pool, pool.register_profile(
+                    profile_spec(self.cps.encode_cfg,
+                                 byte_paths=self.cps.byte_paths,
+                                 key_byte_paths=self.cps.key_byte_paths)))
+            out = pool.encode_chunk(
+                self._pool_profile[1], KIND_ROWS,
+                {"resources": [resources[i] for i, _ in misses]})
+        except (PoolBypassed, PoolInfraError, WorkerEncodeError):
+            # breaker open / infra out -> in-process path; a worker-
+            # REPORTED encode error re-raises in-process too, where the
+            # existing quarantine ladder owns it
+            return False
+        poison = set(out.get("poison") or ())
+        for j, (i, key) in enumerate(misses):
+            if j in poison:
+                # quarantined: empty lanes + the fallback flag route
+                # this column to the scalar oracle (HOST), and its
+                # placeholder rows never enter the cache
+                batch.fallback[i] = 1
+                continue
+            entry = out["rows"][j]
+            apply_rows(entry, batch, i)
+            if key is not None:
+                ec.put_entry(key, entry)
+        return True
 
     def _encode_dyn_lanes(self, resources, operations, admission_infos):
         """Host-resolved context operands (SURVEY §7 context-dependent

@@ -857,45 +857,77 @@ def encode_resources_vocab(
     return vb
 
 
-def _finish_vocab(enc: _FastEncoder, vb: VocabBatch) -> None:
-    bs = dict(enc.byte_slots)
-    kbs = dict(enc.key_byte_slots)
-    vkey: Dict[tuple, int] = {}
-    vrows: List[tuple] = []
-    nflat = len(enc.flat)
-    ids = np.empty((nflat,), dtype=np.int32)
-    paths, nodes, s1l, s2l, s2o = enc.paths, enc.nodes, enc.scope1, enc.scope2, enc.s2_over
-    get_bs, get_kbs = bs.get, kbs.get
-    vget = vkey.get
-    for j in range(nflat):
-        flat = enc.flat[j]
-        key = (paths[j], nodes[j], s1l[j], s2l[j], s2o[j],
-               get_bs(flat, -1), get_kbs(flat, -1))
-        vid = vget(key)
-        if vid is None:
-            vid = len(vrows) + 1
-            vkey[key] = vid
-            vrows.append(key)
-        ids[j] = vid
-    vb.row_idx.ravel()[np.asarray(enc.flat, dtype=np.int64)] = ids
+# node-record fields carried as floats: for the vectorized dedup they
+# key by their float64 BIT PATTERN (via .view), which is exact — equal
+# bits <=> identical lane bytes, and the records already distinguish
+# 0.0 from -0.0 through their repr hashes
+_NODE_FLOAT_FIELDS = frozenset({"arr_len", "num_val", "qty_val", "dur_val"})
 
-    V = len(vrows) + 1
-    lanes = {name: np.zeros((V,), dtype=_ROW_LANE_DTYPES[name]) for name in _ROW_LANES}
+_PATH_FIELDS = ("norm_hi", "norm_lo", "parent_hi", "parent_lo",
+                "key_hi", "key_lo", "key_glob")
+
+
+def _finish_vocab(enc: _FastEncoder, vb: VocabBatch) -> None:
+    """Columnar vocabulary assembly: one zip-transpose per record
+    family, one ``np.unique(axis=0)`` over the packed int64 row matrix
+    for the dedup, one scatter per lane — no per-row Python tuple
+    construction or dict probes (the former inner loop was the
+    per-worker encode hot spot; the dedup is exact, it just orders the
+    vocabulary lexicographically instead of by first appearance, which
+    the device gather never observes)."""
+    nflat = len(enc.flat)
+    lanes = {name: np.zeros((1,), dtype=_ROW_LANE_DTYPES[name])
+             for name in _ROW_LANES}
     for l in ("scope1", "scope2", "byte_slot", "key_byte_slot"):
         lanes[l][0] = -1
-    if vrows:
-        pcols = tuple(zip(*(r[0] for r in vrows)))
-        for idx, name in enumerate(("norm_hi", "norm_lo", "parent_hi", "parent_lo",
-                                    "key_hi", "key_lo", "key_glob")):
-            lanes[name][1:] = pcols[idx]
-        ncols = tuple(zip(*(r[1] for r in vrows)))
-        for idx, name in enumerate(_NODE_FIELDS):
-            lanes[name][1:] = np.asarray(ncols[idx], dtype=_ROW_LANE_DTYPES[name])
-        lanes["scope1"][1:] = [r[2] for r in vrows]
-        lanes["scope2"][1:] = [r[3] for r in vrows]
-        lanes["s2_overflow"][1:] = [r[4] for r in vrows]
-        lanes["byte_slot"][1:] = [r[5] for r in vrows]
-        lanes["key_byte_slot"][1:] = [r[6] for r in vrows]
+    if nflat:
+        flat_arr = np.asarray(enc.flat, dtype=np.int64)
+        cols: List[np.ndarray] = []
+        names: List[Tuple[str, bool]] = []  # (lane, is_float)
+        pcols = tuple(zip(*enc.paths))
+        for k, name in enumerate(_PATH_FIELDS):
+            cols.append(np.asarray(pcols[k], dtype=np.int64))
+            names.append((name, False))
+        ncols = tuple(zip(*enc.nodes))
+        for k, name in enumerate(_NODE_FIELDS):
+            if name in _NODE_FLOAT_FIELDS:
+                cols.append(np.asarray(ncols[k],
+                                       dtype=np.float64).view(np.int64))
+                names.append((name, True))
+            else:
+                cols.append(np.asarray(ncols[k], dtype=np.int64))
+                names.append((name, False))
+        for name, data in (("scope1", enc.scope1), ("scope2", enc.scope2),
+                           ("s2_overflow", enc.s2_over)):
+            cols.append(np.asarray(data, dtype=np.int64))
+            names.append((name, False))
+        # byte-slot assignments arrive as sparse (flat idx, slot) pairs;
+        # enc.flat ascends strictly, so searchsorted maps them back
+        for name, pairs in (("byte_slot", enc.byte_slots),
+                            ("key_byte_slot", enc.key_byte_slots)):
+            arr = np.full((nflat,), -1, dtype=np.int64)
+            if pairs:
+                idxs, slots = zip(*pairs)
+                arr[np.searchsorted(flat_arr,
+                                    np.asarray(idxs, dtype=np.int64))] = slots
+            cols.append(arr)
+            names.append((name, False))
+        matrix = np.stack(cols, axis=1)
+        uniq, inverse = np.unique(matrix, axis=0, return_inverse=True)
+        vb.row_idx.ravel()[flat_arr] = \
+            (inverse.reshape(-1) + 1).astype(np.int32)
+        V = uniq.shape[0] + 1
+        lanes = {name: np.zeros((V,), dtype=_ROW_LANE_DTYPES[name])
+                 for name in _ROW_LANES}
+        for l in ("scope1", "scope2", "byte_slot", "key_byte_slot"):
+            lanes[l][0] = -1
+        for k, (name, is_float) in enumerate(names):
+            col = uniq[:, k]
+            if is_float:
+                lanes[name][1:] = col.view(np.float64).astype(
+                    _ROW_LANE_DTYPES[name])
+            else:
+                lanes[name][1:] = col.astype(_ROW_LANE_DTYPES[name])
         lanes["valid"][1:] = 1
     vb.lanes = lanes
 

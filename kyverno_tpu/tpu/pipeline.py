@@ -27,6 +27,17 @@ corrupt filter, validation) split into its launch/complete phases, a
 failed chunk scalar-completes via ``assemble`` exactly like a failed
 serial dispatch, and an encode failure falls back to the serial
 quarantining scan for that chunk.
+
+With an encoder pool configured (encode/pool.py, --encode-workers),
+the encode side fans out: the feeder keeps >= 2 chunks encoding
+concurrently on worker processes while the device runs, with the
+pool's own ladder underneath — a chunk whose worker crashes retries
+once, a chunk that kills two workers is bisected to the poison
+resource (its column arrives flagged and scalar-completes through the
+same quarantine path as an encode-cap overflow), and pool-infra
+failures or an OPEN encode-pool breaker drop that chunk back to the
+in-process encoder. Delivery order, backpressure, and verdict
+bit-identity are unchanged.
 """
 
 from __future__ import annotations
@@ -53,15 +64,59 @@ from .evaluator import ERROR, HOST
 OnResult = Callable[[int, ScanResult], None]
 
 
+def scanner_encode_profile(scanner, ns_labels=None) -> Dict[str, Any]:
+    """The encoder-pool profile for a ShardedScanner: everything its
+    encode() bakes in besides the chunk itself (caps, byte paths, meta
+    config, used lanes, mesh pad — and optionally the scan's ns-label
+    map, invariant across chunks, so it ships once per worker instead
+    of riding every task). encode/tasks.py run_vocab drives the SAME
+    encode body ShardedScanner.encode uses against this spec."""
+    from ..encode import profile_spec
+
+    return profile_spec(
+        scanner.cps.encode_cfg,
+        byte_paths=scanner.cps.byte_paths,
+        key_byte_paths=scanner.cps.key_byte_paths,
+        meta_cfg=scanner.cps.meta_cfg,
+        meta_need=getattr(scanner, "_meta_need", None),
+        used_keys=getattr(scanner, "_used_keys", None),
+        pad_multiple=scanner.n_devices,
+        ns_labels=ns_labels,
+    )
+
+
 class PipelinedScanner:
     """Drive a ShardedScanner's encode/step through the overlap
-    pipeline, completing verdicts with the TpuEngine ladder."""
+    pipeline, completing verdicts with the TpuEngine ladder.
 
-    def __init__(self, scanner, depth: int = 2):
+    ``encode_pool``: an EncoderPool to fan the encode side out on;
+    None resolves the process-wide pool (encode.get_pool(), i.e. the
+    --encode-workers / $KYVERNO_TPU_ENCODE_WORKERS knob) at scan time,
+    which is None when disabled — the in-process encode thread then
+    runs exactly as before."""
+
+    def __init__(self, scanner, depth: int = 2, encode_pool=None):
         self.scanner = scanner
         self.engine = TpuEngine(cps=scanner.cps,
                                 exceptions=scanner.exceptions)
         self.depth = max(1, depth)
+        self._encode_pool = encode_pool
+        self._pool_profile: Optional[Tuple[Any, int]] = None
+
+    def _resolve_pool(self):
+        if self._encode_pool is not None:
+            return self._encode_pool if self._encode_pool.running else None
+        from ..encode import get_pool
+
+        pool = get_pool()
+        return pool if (pool is not None and pool.running) else None
+
+    def _profile_for(self, pool) -> int:
+        if self._pool_profile is None or self._pool_profile[0] is not pool:
+            self._pool_profile = (
+                pool, pool.register_profile(
+                    scanner_encode_profile(self.scanner)))
+        return self._pool_profile[1]
 
     def scan_chunks(
         self,
@@ -97,42 +152,145 @@ class PipelinedScanner:
         stop = threading.Event()
 
         chunk_encode_s: Dict[int, float] = {}
+        pool = self._resolve_pool()
+
+        def put_payload(idx: int, payload: Optional[Any]) -> bool:
+            while not stop.is_set():
+                try:
+                    enc_q.put((idx, payload), timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue  # consumer died: stop flag ends us
+            return False
+
+        def encode_inprocess(idx: int) -> Optional[Any]:
+            """One chunk through the in-process encoder — the serial
+            path, and the pool's bypass/infra fallback rung."""
+            chunk = chunks[idx]
+            t0 = time.perf_counter()
+            try:
+                with global_profiler.phase(PHASE_ENCODE), \
+                        global_tracer.span("scan_encode",
+                                           parent=scan_ctx,
+                                           tile=len(chunk)):
+                    ops = list(operations[idx]) if operations else None
+                    batch, n = self.scanner.encode(
+                        chunk, namespace_labels, ops)
+                payload: Optional[Any] = (batch, n, None)
+            except Exception:
+                payload = None  # serial quarantining fallback
+            dt = time.perf_counter() - t0
+            stats["encode_s"] += dt
+            chunk_encode_s[idx] = dt
+            return payload
 
         def encode_worker() -> None:
             # encode chunk k+1 while the device executes chunk k; the
             # bounded queue is the double buffer (encode never runs
             # more than `depth` chunks ahead)
-            for idx, chunk in enumerate(chunks):
+            for idx in range(len(chunks)):
                 if stop.is_set():
                     return
-                t0 = time.perf_counter()
-                try:
-                    with global_profiler.phase(PHASE_ENCODE), \
-                            global_tracer.span("scan_encode",
-                                               parent=scan_ctx,
-                                               tile=len(chunk)):
-                        ops = list(operations[idx]) if operations else None
-                        batch, n = self.scanner.encode(
-                            chunk, namespace_labels, ops)
-                    payload: Optional[Any] = (batch, n)
-                except Exception:
-                    payload = None  # serial quarantining fallback
-                dt = time.perf_counter() - t0
-                stats["encode_s"] += dt
-                chunk_encode_s[idx] = dt
-                while not stop.is_set():
-                    try:
-                        enc_q.put((idx, payload), timeout=0.1)
-                        break
-                    except queue.Full:
-                        continue  # consumer died: stop flag ends us
+                if not put_payload(idx, encode_inprocess(idx)):
+                    return
 
-        worker = threading.Thread(target=encode_worker, daemon=True,
-                                  name="scan-encode")
+        def encode_worker_pooled() -> None:
+            # pool feed: keep >= 2 chunks encoding concurrently on
+            # worker processes while the device runs; results are
+            # delivered in chunk order with the same backpressure
+            from ..encode import (PoolBypassed, PoolInfraError,
+                                  WorkerEncodeError)
+
+            if namespace_labels:
+                # scan-scoped profile: the ns-label map is invariant
+                # across this scan's chunks — ship it once per worker
+                # (with the profile), not pickled into every task
+                profile_id = pool.register_profile(
+                    scanner_encode_profile(self.scanner,
+                                           ns_labels=namespace_labels))
+                scan_profile = profile_id
+            else:
+                profile_id = self._profile_for(pool)
+                scan_profile = None
+            s = self.scanner
+            lookahead = max(self.depth, 2)
+            handles: Dict[int, Optional[Any]] = {}
+            submitted = 0
+
+            def submit_next() -> None:
+                nonlocal submitted
+                idx = submitted
+                ops = list(operations[idx]) if operations else None
+                task = {"resources": list(chunks[idx]),
+                        "operations": ops,
+                        "buckets": (s._vbucket, s._sbucket, s._rbucket)}
+                try:
+                    handles[idx] = pool.submit(profile_id, "vocab", task)
+                except (PoolBypassed, PoolInfraError):
+                    handles[idx] = None  # in-process at resolve time
+                submitted += 1
+
+            def resolve(idx: int) -> Optional[Any]:
+                h = handles.pop(idx)
+                if h is not None:
+                    try:
+                        out = pool.await_result(h)
+                        # fold the worker's monotone bucket growth back
+                        # so later chunks (and the next scan) reuse the
+                        # same jitted shapes
+                        vb_, sb_, rb_ = out["buckets"]
+                        s._vbucket = max(s._vbucket, vb_)
+                        s._sbucket = max(s._sbucket, sb_)
+                        s._rbucket = max(s._rbucket, rb_)
+                        dt = float(out.get("encode_s", 0.0))
+                        stats["encode_s"] += dt
+                        chunk_encode_s[idx] = dt
+                        global_profiler.add(PHASE_ENCODE, dt)
+                        # the encode happened in a worker process: the
+                        # span is recorded retroactively from the
+                        # worker-reported duration so pooled scans keep
+                        # the same trace shape as in-process ones
+                        now = time.monotonic()
+                        global_tracer.record_span(
+                            "scan_encode", now - dt, now, parent=scan_ctx,
+                            tile=len(chunks[idx]), pooled=True)
+                        return (out["host"], out["n"], out.get("poison"))
+                    except WorkerEncodeError:
+                        # content failure inside the worker — exactly
+                        # an in-process encode raise: quarantine ladder
+                        return None
+                    except (PoolBypassed, PoolInfraError):
+                        pass  # pool infra out: encode here instead
+                return encode_inprocess(idx)
+
+            try:
+                for idx in range(len(chunks)):
+                    if stop.is_set():
+                        return
+                    if submitted == idx:
+                        # cold start (and single-chunk scans): first
+                        # chunk alone, so its result warms the shape
+                        # buckets the lookahead chunks then ride
+                        submit_next()
+                    if not put_payload(idx, resolve(idx)):
+                        return
+                    while (submitted < len(chunks)
+                            and submitted - (idx + 1) < lookahead):
+                        submit_next()
+            finally:
+                if scan_profile is not None:
+                    pool.release_profile(scan_profile)
+
+        worker = threading.Thread(
+            target=encode_worker_pooled if pool is not None
+            else encode_worker,
+            daemon=True, name="scan-encode")
         worker.start()
         eng = self.engine
         D = len(eng.cps.device_programs)
-        inflight: List[Tuple[int, Optional[Tuple[Any]], int]] = []
+        # (chunk idx, launch handle, live n, poison column indices)
+        inflight: List[Tuple[int, Optional[Tuple[Any]], int,
+                             Optional[List[int]]]] = []
 
         def publish_live_ratios() -> None:
             # satellite contract: /metrics mid-scan must see LIVE
@@ -145,20 +303,31 @@ class PipelinedScanner:
                 global_registry.pipeline_overlap.set(
                     round(max(0.0, busy - wall) / wall, 4))
 
-        def readback(fut, n):
+        def readback(fut, n, poison):
             # the launched handle is the jitted (verdicts, counts)
             # pair: counts are the device-side rule-analytics
             # reduction; pad columns leave them before the stash
             if isinstance(fut, tuple):
                 v, c = np.asarray(fut[0]), np.asarray(fut[1])
-                c = c.astype(np.int64) - class_counts(v[:, n:])
             else:
                 v, c = np.asarray(fut), None
+            if poison:
+                # poison columns were encoded as {} placeholders after
+                # the pool's bisect: flag them HOST so assemble()
+                # scalar-completes the REAL resources (the encode-
+                # failure quarantine), and drop the device counts —
+                # assemble's host recount over the final table stays
+                # exact without correction bookkeeping
+                v = np.array(v, copy=True)
+                v[:, poison] = HOST
+                c = None
+            if c is not None:
+                c = c.astype(np.int64) - class_counts(v[:, n:])
             eng.set_pending_counts(c)
             return v[:, :n].astype(np.int32)
 
         def drain() -> None:
-            idx, handle, n = inflight.pop(0)
+            idx, handle, n, poison = inflight.pop(0)
             chunk = chunks[idx]
             ops = list(operations[idx]) if operations else None
             t0 = time.perf_counter()
@@ -166,7 +335,7 @@ class PipelinedScanner:
                     global_tracer.span("scan_device_wait", parent=scan_ctx,
                                        tile=n):
                 table = eng.guarded_complete(
-                    handle, lambda fut: readback(fut, n), (D, n))
+                    handle, lambda fut: readback(fut, n, poison), (D, n))
             device_s = time.perf_counter() - t0
             stats["device_s"] += device_s
             global_registry.device_dispatch.observe(
@@ -204,6 +373,7 @@ class PipelinedScanner:
                 "encode_s": round(chunk_encode_s.get(idx, 0.0), 6),
                 "device_s": round(device_s, 6),
                 "host_s": round(host_s, 6),
+                "poison": len(poison) if poison else 0,
             })
             publish_live_ratios()
 
@@ -267,7 +437,7 @@ class PipelinedScanner:
                         drain()
                     serial_chunk(idx)
                     continue
-                batch, n = payload
+                batch, n, poison = payload
                 t0 = time.perf_counter()
                 with global_profiler.phase(PHASE_DISPATCH), \
                         global_tracer.span("scan_dispatch",
@@ -276,7 +446,7 @@ class PipelinedScanner:
                         lambda: self.scanner._step(
                             self.scanner.put(batch)))
                 stats["device_s"] += time.perf_counter() - t0
-                inflight.append((idx, handle, n))
+                inflight.append((idx, handle, n, poison))
                 # double buffer: with chunk k launched, the readback +
                 # host completion of chunk k-1 overlaps k's device time
                 while len(inflight) > 1:
@@ -303,5 +473,7 @@ class PipelinedScanner:
                 max(0.0, busy - wall) / wall, 4) if wall > 0 else 0.0
             global_registry.pipeline_overlap.set(stats["overlap_ratio"])
             scan_span.attributes["overlap_ratio"] = stats["overlap_ratio"]
+            if pool is not None:
+                stats["encode_pool"] = pool.summary()
             global_tracer.end_span(scan_span)
         return stats

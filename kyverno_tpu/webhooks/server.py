@@ -474,6 +474,7 @@ class Handlers:
                 "xla_cache_dir": xla_cache_dir(),
             },
             "policyset": self.lifecycle.state(),
+            "encode_pool": _encode_pool_state(),
             "faults_armed": {
                 site: {"mode": spec.mode, "calls": spec.calls,
                        "fired": spec.fired}
@@ -852,6 +853,17 @@ def build_handlers(cache: PolicyCache, snapshot=None, aggregator=None, **kw) -> 
     return Handlers(cache, snapshot, aggregator, **kw)
 
 
+def _encode_pool_state():
+    """The encoder pool's /debug/state block ({'enabled': False} when
+    --encode-workers is 0 — introspection must not start a pool)."""
+    try:
+        from ..encode import pool_state
+
+        return pool_state()
+    except Exception:
+        return {"enabled": False}
+
+
 def handle_debug_path(path: str, handlers: Optional[Handlers] = None
                       ) -> Tuple[int, bytes, str]:
     """One debug router shared by the admission server and the serve
@@ -910,6 +922,7 @@ def handle_debug_path(path: str, handlers: Optional[Handlers] = None
                 in _reg.serving_flusher_seconds.series()},
             "perf_caches": {"verdict_hit_rate": global_verdict_cache.hit_rate(),
                             "encode_hit_rate": global_encode_cache.hit_rate()},
+            "encode_pool": _encode_pool_state(),
             "slo": global_slo.state(),
             "phase_breakdown": global_profiler.breakdown(),
         }

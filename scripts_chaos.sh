@@ -12,27 +12,39 @@ set -o pipefail
 cd "$(dirname "$0")"
 rc=0
 
-echo "=== leg 1/4: tier-1 (faults disarmed) ==="
+echo "=== leg 1/5: tier-1 (faults disarmed) ==="
 KYVERNO_TPU_FAULTS= JAX_PLATFORMS=cpu timeout -k 10 870 \
   python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors \
   -p no:cacheprovider -p no:xdist -p no:randomly || rc=1
 
-echo "=== leg 2/4: slow chaos + resilience suites (tests arm faults) ==="
+echo "=== leg 2/5: slow chaos + resilience suites (tests arm faults) ==="
 KYVERNO_TPU_FAULTS= JAX_PLATFORMS=cpu timeout -k 10 870 \
   python -m pytest tests/test_chaos_load.py tests/test_resilience.py \
   tests/test_serving_load.py -q -p no:cacheprovider || rc=1
 
-echo "=== leg 3/4: serving suite under ambient env-armed faults ==="
+echo "=== leg 3/5: serving suite under ambient env-armed faults ==="
 KYVERNO_TPU_FAULTS="${AMBIENT_FAULTS:-tpu.dispatch:raise:p=0.3,seed=7}" \
   JAX_PLATFORMS=cpu timeout -k 10 870 \
   python -m pytest tests/test_serving.py tests/test_resilience.py -q \
   -p no:cacheprovider || rc=1
 
-echo "=== leg 4/4: policy churn — 64-thread load + 50ms mutator ==="
+echo "=== leg 4/5: policy churn — 64-thread load + 50ms mutator ==="
 # zero dropped requests, batch-pinned revisions, verdicts bit-identical
 # to the scalar oracle at the revision that served them
 KYVERNO_TPU_FAULTS= JAX_PLATFORMS=cpu timeout -k 10 870 \
   python -m pytest tests/test_policy_churn.py -q -p no:cacheprovider || rc=1
+
+echo "=== leg 5/5: encoder pool — worker kills, poison bisect, breaker ==="
+# pool-enabled scans with encode.worker faults armed (crash/delay) plus
+# direct SIGKILLs of busy workers: verdicts must stay bit-identical to
+# the in-process encode, no scan aborts, the pool self-heals (restarts
+# visible on /metrics), and stop() leaves zero orphan children. The
+# second pass re-runs the suite under ambient worker delay faults.
+KYVERNO_TPU_FAULTS= JAX_PLATFORMS=cpu timeout -k 10 870 \
+  python -m pytest tests/test_encode_pool.py -q -p no:cacheprovider || rc=1
+KYVERNO_TPU_FAULTS="${AMBIENT_ENCODE_FAULTS:-encode.worker:delay:p=0.2,delay_s=0.05,seed=11}" \
+  JAX_PLATFORMS=cpu timeout -k 10 870 \
+  python -m pytest tests/test_encode_pool.py -q -p no:cacheprovider || rc=1
 
 if [ "$rc" -eq 0 ]; then
   echo "CHAOS GATE: all legs passed"

@@ -1,0 +1,529 @@
+"""Supervised multiprocess encode pool (ISSUE 7).
+
+The contract under test: a pool-fed scan is BIT-IDENTICAL to the
+in-process encode path — under worker SIGKILLs mid-scan, hung workers
+(deadline reaper), poison resources that crash every worker that
+touches them (bisect -> encode-failure quarantine), worker-reported
+encode errors, pool-infra failures, and an OPEN encode-pool breaker —
+the scan never aborts, the pool self-heals (restarts visible on
+/metrics), and stop() leaves zero orphan children.
+"""
+
+import os
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from kyverno_tpu.api.policy import ClusterPolicy
+from kyverno_tpu.encode import (EncoderPool, PoolBypassed, PoolConfig,
+                                PoolInfraError, configure_pool, get_pool,
+                                pool_state, shutdown_pool)
+from kyverno_tpu.observability.metrics import global_registry as reg
+from kyverno_tpu.parallel.sharding import ShardedScanner
+from kyverno_tpu.resilience.faults import FaultConfigError, global_faults
+from kyverno_tpu.tpu.engine import TpuEngine
+from kyverno_tpu.tpu.pipeline import PipelinedScanner
+
+
+def _pol(name="p1"):
+    return ClusterPolicy.from_dict({
+        "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+        "metadata": {"name": name},
+        "spec": {"rules": [{
+            "name": "r1",
+            "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+            "validate": {"message": "m", "pattern": {"spec": {"containers": [
+                {"=(securityContext)": {"=(privileged)": "false"}}]}}},
+        }]}})
+
+
+def _pods(n, name_of=None):
+    out = []
+    for i in range(n):
+        name = name_of(i) if name_of else f"p{i}"
+        out.append({
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": name, "namespace": "default"},
+            "spec": {"containers": [{
+                "name": "c", "image": "nginx",
+                **({"securityContext": {"privileged": True}}
+                   if i % 3 == 0 else {})}]},
+        })
+    return out
+
+
+def _chunks(pods, size=8):
+    return [pods[i:i + size] for i in range(0, len(pods), size)]
+
+
+def _pids_gone(pids, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if not any(os.path.exists(f"/proc/{p}") for p in pids):
+            return True
+        time.sleep(0.05)
+    return False
+
+
+@pytest.fixture()
+def scanner():
+    return ShardedScanner([_pol()])
+
+
+@pytest.fixture()
+def reference(scanner):
+    """Serial in-process verdicts (faults disarmed) — the oracle every
+    pooled run must reproduce bit-for-bit."""
+    def compute(chunk_list):
+        eng = TpuEngine(cps=scanner.cps)
+        return np.concatenate([eng.scan(c).verdicts for c in chunk_list],
+                              axis=1)
+    return compute
+
+
+@pytest.fixture(autouse=True)
+def _pool_hygiene(no_verdict_cache):
+    """Every test leaves no global pool, no armed parent-side encode
+    faults, and a closed tpu breaker behind."""
+    yield
+    shutdown_pool()
+    global_faults.disarm("encode.pool_dispatch")
+    global_faults.disarm("tpu.dispatch")
+    from kyverno_tpu.resilience.breaker import tpu_breaker
+
+    tpu_breaker().reset()
+
+
+def _run_pool_scan(scanner, chunk_list, pool):
+    pipe = PipelinedScanner(scanner, encode_pool=pool)
+    got = {}
+    stats = pipe.scan_chunks(chunk_list,
+                             on_result=lambda i, r: got.update(
+                                 {i: r.verdicts}))
+    assert sorted(got) == list(range(len(chunk_list))), \
+        "a chunk was never reported — the scan dropped work"
+    return np.concatenate([got[i] for i in range(len(chunk_list))],
+                          axis=1), stats
+
+
+# ---------------------------------------------------------------------------
+# fault-registry extensions the pool rides on
+
+
+def test_crash_mode_rejected_outside_supervised_sites():
+    with pytest.raises(FaultConfigError):
+        global_faults.arm("tpu.dispatch", mode="crash")
+    spec = global_faults.arm("encode.worker", mode="crash",
+                             match="only-this")
+    assert spec.match == "only-this"
+    global_faults.disarm("encode.worker")
+
+
+def test_match_scoped_fault_only_fires_on_payload():
+    spec = global_faults.arm("encode.pool_dispatch", mode="raise",
+                             match="MARKER")
+    try:
+        global_faults.fire("encode.pool_dispatch", payload="clean text")
+        with pytest.raises(Exception):
+            global_faults.fire("encode.pool_dispatch",
+                               payload=lambda: "has MARKER inside")
+        assert spec.fired == 1
+    finally:
+        global_faults.disarm("encode.pool_dispatch")
+
+
+# ---------------------------------------------------------------------------
+# the happy path: workers are JAX-free, results bit-identical
+
+
+def test_workers_are_jax_free_and_scan_is_bit_identical(scanner, reference):
+    pods = _pods(40)
+    chunk_list = _chunks(pods)
+    want = reference(chunk_list)
+    pool = EncoderPool(2).start()
+    try:
+        assert pool.wait_ready(60) == 2
+        st = pool.state()
+        assert all(w["jax_loaded"] is False for w in st["worker_slots"]), \
+            "a worker imported JAX — the feed must stay NumPy/stdlib"
+        got, stats = _run_pool_scan(scanner, chunk_list, pool)
+        assert np.array_equal(got, want)
+        assert stats["encode_fallback_chunks"] == 0
+        assert stats["encode_pool"]["alive"] == 2
+    finally:
+        pids = pool.worker_pids()
+        pool.stop()
+    assert _pids_gone(pids), "stop() left orphan worker processes"
+
+
+def test_encode_workers_zero_keeps_inprocess_path(scanner, reference):
+    """--encode-workers 0: no pool exists, the pipeline runs its
+    in-process encode thread — today's path byte-for-byte."""
+    configure_pool(0)
+    assert get_pool() is None
+    assert pool_state() == {"enabled": False}
+    chunk_list = _chunks(_pods(24))
+    want = reference(chunk_list)
+    got, stats = _run_pool_scan(scanner, chunk_list, None)
+    assert np.array_equal(got, want)
+    assert "encode_pool" not in stats
+
+
+# ---------------------------------------------------------------------------
+# chaos: worker kills, hangs, poison, breaker
+
+
+def test_worker_sigkill_mid_scan_self_heals(scanner, reference):
+    """The ISSUE acceptance leg: SIGKILL a busy worker mid-scan while
+    tpu.dispatch faults are armed — verdicts bit-identical, zero scan
+    aborts, restart counter visible on /metrics, starvation gauge
+    stays in [0, 1]."""
+    pods = _pods(96)
+    chunk_list = _chunks(pods)
+    want = reference(chunk_list)
+    # slow the workers slightly so the killer reliably catches one busy
+    pool = EncoderPool(
+        2, config=PoolConfig(chunk_deadline_s=20),
+        worker_faults="encode.worker:delay:p=0.9,delay_s=0.05,seed=3",
+    ).start()
+    r0 = reg.encode_pool_restarts.value()
+    killed = threading.Event()
+
+    def killer():
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and not killed.is_set():
+            st = pool.state()
+            busy = [w for w in st["worker_slots"]
+                    if w["busy"] and w["pid"]]
+            if busy:
+                try:
+                    os.kill(busy[0]["pid"], signal.SIGKILL)
+                    killed.set()
+                    return
+                except OSError:
+                    pass
+            time.sleep(0.005)
+
+    try:
+        assert pool.wait_ready(60) == 2
+        global_faults.arm("tpu.dispatch", mode="raise", p=0.3, seed=7)
+        t = threading.Thread(target=killer)
+        t.start()
+        got, _ = _run_pool_scan(scanner, chunk_list, pool)
+        t.join(timeout=35)
+        global_faults.disarm("tpu.dispatch")
+        assert killed.is_set(), "killer never saw a busy worker"
+        assert np.array_equal(got, want), \
+            "verdicts diverged after a worker SIGKILL"
+        assert reg.encode_pool_restarts.value() - r0 >= 1
+        assert pool.wait_ready(30) == 2, "pool did not self-heal"
+        # /metrics surface: the restart counter and the starvation
+        # gauge must be scrapeable and sane
+        exposition = reg.exposition()
+        assert "kyverno_encode_pool_restarts_total" in exposition
+        assert "kyverno_encode_pool_workers_alive" in exposition
+        ratio = reg.feed_starvation.value()
+        assert 0.0 <= ratio <= 1.0
+    finally:
+        pids = pool.worker_pids()
+        pool.stop()
+    assert _pids_gone(pids)
+
+
+def test_poison_resource_bisected_into_quarantine(scanner, reference):
+    """A resource that crashes EVERY worker that encodes it: the chunk
+    kills two workers, bisects to the poison, and the poison column
+    scalar-completes (encode-failure quarantine) — bit-identical to the
+    in-process path, which encodes it harmlessly."""
+    pods = _pods(32, name_of=lambda i:
+                 "POISON-PILL" if i == 11 else f"p{i}")
+    chunk_list = _chunks(pods)
+    want = reference(chunk_list)
+    pool = EncoderPool(
+        2, config=PoolConfig(chunk_deadline_s=15),
+        worker_faults="encode.worker:crash:match=POISON-PILL").start()
+    p0 = reg.encode_pool_chunks.value({"outcome": "poison"})
+    try:
+        assert pool.wait_ready(60) == 2
+        got, stats = _run_pool_scan(scanner, chunk_list, pool)
+        assert np.array_equal(got, want)
+        assert reg.encode_pool_chunks.value({"outcome": "poison"}) - p0 == 1
+        assert [t["poison"] for t in stats["timeline"]].count(1) == 1
+        assert pool.restarts >= 2  # two kills before the bisect alone
+        assert pool.wait_ready(30) == 2
+    finally:
+        pool.stop()
+
+
+def test_hung_worker_deadline_killed_then_quarantined(scanner, reference):
+    """A resource whose encode hangs (delay >> deadline) is a poison of
+    a different flavor: the deadline reaper SIGKILLs the hung worker,
+    the retry hangs too, and the bisect isolates it into quarantine."""
+    pods = _pods(8, name_of=lambda i: "SLOW-MARK" if i == 3 else f"p{i}")
+    chunk_list = _chunks(pods, size=4)
+    want = reference(chunk_list)
+    pool = EncoderPool(
+        2, config=PoolConfig(chunk_deadline_s=1.0, hb_timeout_s=30),
+        worker_faults="encode.worker:delay:delay_s=30,match=SLOW-MARK",
+    ).start()
+    try:
+        assert pool.wait_ready(60) == 2
+        got, _ = _run_pool_scan(scanner, chunk_list, pool)
+        assert np.array_equal(got, want)
+        assert pool.restarts >= 2
+    finally:
+        pool.stop()
+
+
+def test_worker_reported_error_falls_back_to_quarantine(scanner, reference):
+    """A worker-side raise (injected) is a CONTENT failure: the chunk
+    drops to the serial quarantining fallback in-process; the breaker
+    stays closed."""
+    pods = _pods(24, name_of=lambda i: "RAISE-MARK" if i == 5 else f"p{i}")
+    chunk_list = _chunks(pods)
+    want = reference(chunk_list)
+    e0 = reg.encode_pool_chunks.value({"outcome": "encode_error"})
+    pool = EncoderPool(
+        2, worker_faults="encode.worker:raise:match=RAISE-MARK").start()
+    try:
+        assert pool.wait_ready(60) == 2
+        got, stats = _run_pool_scan(scanner, chunk_list, pool)
+        assert np.array_equal(got, want)
+        assert stats["encode_fallback_chunks"] == 1
+        assert reg.encode_pool_chunks.value(
+            {"outcome": "encode_error"}) - e0 == 1
+        assert pool.breaker.state == "closed"
+        assert pool.restarts == 0
+    finally:
+        pool.stop()
+
+
+def test_pool_breaker_opens_bypasses_and_restores(scanner, reference):
+    """K consecutive pool-infra failures open the encode_pool breaker;
+    chunks bypass to in-process encode (verdicts still exact); after
+    the reset timeout a half-open probe restores the pool."""
+    chunk_list = _chunks(_pods(40))
+    want = reference(chunk_list)
+    pool = EncoderPool(
+        1, config=PoolConfig(breaker_threshold=2, breaker_reset_s=0.4),
+    ).start()
+    b0 = reg.encode_pool_chunks.value({"outcome": "bypass"})
+    i0 = reg.encode_pool_chunks.value({"outcome": "infra_fail"})
+    try:
+        assert pool.wait_ready(60) == 1
+        # the first 2 dispatches hit the armed dispatch-site fault:
+        # infra failures -> breaker OPEN; later chunks bypass
+        global_faults.arm("encode.pool_dispatch", mode="raise", count=2)
+        got, _ = _run_pool_scan(scanner, chunk_list, pool)
+        global_faults.disarm("encode.pool_dispatch")
+        assert np.array_equal(got, want), \
+            "bypassed chunks must still be bit-identical"
+        assert reg.encode_pool_chunks.value(
+            {"outcome": "infra_fail"}) - i0 == 2
+        assert reg.encode_pool_chunks.value({"outcome": "bypass"}) - b0 >= 1
+        assert pool.breaker.state == "open"
+        # half-open probe restores the pool path
+        time.sleep(0.5)
+        got2, stats2 = _run_pool_scan(scanner, chunk_list, pool)
+        assert np.array_equal(got2, want)
+        assert pool.breaker.state == "closed"
+        assert stats2["encode_pool"]["breaker"] == "closed"
+    finally:
+        pool.stop()
+
+
+# ---------------------------------------------------------------------------
+# process hygiene
+
+
+def test_stop_mid_scan_drains_and_leaves_no_orphans(scanner, reference):
+    """stop() fired in the middle of a pooled scan: in-flight chunks
+    resolve (pool result or in-process fallback), the scan completes
+    bit-identically, and no child process survives."""
+    pods = _pods(160)
+    chunk_list = _chunks(pods)
+    want = reference(chunk_list)
+    pool = EncoderPool(
+        2, config=PoolConfig(chunk_deadline_s=20, drain_timeout_s=10),
+        worker_faults="encode.worker:delay:p=0.9,delay_s=0.03,seed=5",
+    ).start()
+    assert pool.wait_ready(60) == 2
+    pids = pool.worker_pids()
+    stopper = threading.Timer(0.15, lambda: pool.stop())
+    stopper.start()
+    got, _ = _run_pool_scan(scanner, chunk_list, pool)
+    stopper.join()
+    assert np.array_equal(got, want), \
+        "mid-scan pool stop changed verdicts"
+    assert _pids_gone(pids), "mid-scan stop() left orphan workers"
+    # a stopped pool refuses new work as infra/bypass, never hangs
+    with pytest.raises((PoolInfraError, PoolBypassed)):
+        pool.submit(1, "rows", {"resources": [{}]})
+
+
+def test_ns_labels_ship_once_per_scan_and_release(scanner):
+    """Namespace labels ride a scan-scoped profile (shipped once per
+    worker), not every task — and the profile is released at scan end
+    so long-lived pools don't accumulate one snapshot per tick."""
+    ns_labels = {"prod": {"env": "prod"}, "dev": {"env": "dev"}}
+    chunk_list = _chunks(_pods(24))
+    eng = TpuEngine(cps=scanner.cps)
+    want = np.concatenate(
+        [eng.scan(c, ns_labels).verdicts for c in chunk_list], axis=1)
+    pool = EncoderPool(2).start()
+    try:
+        assert pool.wait_ready(60) == 2
+        pipe = PipelinedScanner(scanner, encode_pool=pool)
+        got = {}
+        pipe.scan_chunks(chunk_list, ns_labels,
+                         on_result=lambda i, r: got.update({i: r.verdicts}))
+        table = np.concatenate([got[i] for i in range(len(chunk_list))],
+                               axis=1)
+        assert np.array_equal(table, want)
+        assert len(pool._profiles) == 0, "scan-scoped profile leaked"
+    finally:
+        pool.stop()
+
+
+def test_never_ready_pool_fails_fast_and_opens_breaker(monkeypatch):
+    """Workers that can never spawn (broken interpreter/venv) must not
+    stall each chunk on the caller backstop: queued chunks expire on
+    the chunk deadline, the breaker opens, callers bypass in-process."""
+    import subprocess
+    import sys
+
+    orig = subprocess.Popen
+
+    class DeadPopen(orig):
+        def __init__(self, cmd, **kw):
+            super().__init__([sys.executable, "-c", "import sys;sys.exit(3)"],
+                             **kw)
+
+    monkeypatch.setattr(subprocess, "Popen", DeadPopen)
+    from kyverno_tpu.encode import profile_spec
+    from kyverno_tpu.tpu.flatten import EncodeConfig
+
+    pool = EncoderPool(
+        2, config=PoolConfig(chunk_deadline_s=1.0, breaker_threshold=2),
+    ).start()
+    try:
+        pid = pool.register_profile(profile_spec(EncodeConfig()))
+        t0 = time.monotonic()
+        for _ in range(3):
+            with pytest.raises((PoolInfraError, PoolBypassed)):
+                pool.encode_chunk(pid, "rows", {"resources": [{"a": 1}]})
+        assert time.monotonic() - t0 < 15
+        assert pool.breaker.state == "open"
+    finally:
+        monkeypatch.setattr(subprocess, "Popen", orig)
+        pool.stop()
+
+
+def test_unpicklable_chunk_is_content_error_not_worker_death():
+    """A chunk the supervisor cannot even serialize resolves as a
+    worker-encode error immediately (in-process quarantine owns it) —
+    no innocent worker is deadline-killed, and the slot's profile
+    bookkeeping stays truthful for the next chunk."""
+    from kyverno_tpu.encode import WorkerEncodeError, profile_spec
+    from kyverno_tpu.tpu.flatten import EncodeConfig
+
+    pool = EncoderPool(1).start()
+    try:
+        assert pool.wait_ready(60) == 1
+        pid = pool.register_profile(profile_spec(EncodeConfig()))
+        with pytest.raises(WorkerEncodeError):
+            pool.encode_chunk(pid, "rows",
+                              {"resources": [{"x": lambda: 1}]})
+        out = pool.encode_chunk(pid, "rows", {"resources": [{"a": 1}]})
+        assert len(out["rows"]) == 1
+        assert pool.restarts == 0
+    finally:
+        pool.stop()
+
+
+def test_atexit_style_kill_reaps_children():
+    pool = EncoderPool(1).start()
+    assert pool.wait_ready(60) == 1
+    pids = pool.worker_pids()
+    pool._kill_all_workers()  # what the atexit guard runs
+    assert _pids_gone(pids)
+    pool.stop()
+
+
+# ---------------------------------------------------------------------------
+# the serving (rows) feed: pool-encoded misses warm the shared cache
+
+
+def test_rows_feed_pooled_and_cache_blocks_reentry(reference):
+    from kyverno_tpu.tpu.cache import global_encode_cache
+
+    pods = _pods(12)
+    eng_ref = TpuEngine([_pol()])
+    global_encode_cache.clear()
+    want = eng_ref.scan(pods).verdicts
+
+    global_encode_cache.clear()
+    configure_pool(2)
+    get_pool().wait_ready(60)
+    ok0 = reg.encode_pool_chunks.value({"outcome": "ok"})
+    eng = TpuEngine([_pol()])
+    got = eng.scan(pods).verdicts
+    assert np.array_equal(got, want)
+    assert reg.encode_pool_chunks.value({"outcome": "ok"}) - ok0 == 1
+    assert len(global_encode_cache) > 0
+    # warm rows never re-enter the pool
+    ok1 = reg.encode_pool_chunks.value({"outcome": "ok"})
+    got2 = eng.scan(pods).verdicts
+    assert np.array_equal(got2, want)
+    assert reg.encode_pool_chunks.value({"outcome": "ok"}) - ok1 == 0
+
+
+def test_rows_feed_poison_marks_host_fallback():
+    """A poison resource in the admission feed: bisected, its column
+    completes on the scalar oracle (fallback flag), the rest of the
+    batch stays pooled — and the placeholder rows never hit the cache."""
+    from kyverno_tpu.tpu.cache import global_encode_cache
+
+    pods = _pods(8, name_of=lambda i: "POISON-PILL" if i == 2 else f"p{i}")
+    eng_ref = TpuEngine([_pol()])
+    global_encode_cache.clear()
+    want = eng_ref.scan(pods).verdicts
+
+    global_encode_cache.clear()
+    configure_pool(2, config=PoolConfig(chunk_deadline_s=15),
+                   worker_faults="encode.worker:crash:match=POISON-PILL")
+    get_pool().wait_ready(60)
+    eng = TpuEngine([_pol()])
+    got = eng.scan(pods).verdicts
+    assert np.array_equal(got, want)
+    assert reg.encode_pool_chunks.value({"outcome": "poison"}) >= 1
+
+
+# ---------------------------------------------------------------------------
+# debug/CLI surfaces
+
+
+def test_debug_state_carries_encode_pool_block():
+    configure_pool(1)
+    get_pool().wait_ready(60)
+    st = pool_state()
+    assert st["enabled"] and st["workers"] == 1
+    assert st["breaker"] in ("closed", "open", "half_open")
+    import json
+
+    json.dumps(st)  # /debug/state must stay JSON-serializable
+    shutdown_pool()
+    assert pool_state() == {"enabled": False}
+
+
+def test_cli_help_covers_encode_workers(capsys):
+    from kyverno_tpu.cli.__main__ import main
+
+    for cmd in (["serve", "--help"], ["apply", "--help"]):
+        with pytest.raises(SystemExit) as exc:
+            main(cmd)
+        assert exc.value.code == 0
+        assert "--encode-workers" in capsys.readouterr().out
