@@ -950,6 +950,166 @@ def mixed_corpus_coverage(corpus_root="/root/reference/test/cli/test"):
 # resilient to a flaky backend and mid-run kills
 
 
+# ---------------------------------------------------------------------------
+# device-side string matching (tpu/dfa.py): a pattern-heavy policy set
+# — globs on image/name/labels, anchored strings, and a matches() VAP
+# expression — evaluated on the DFA-bank device path vs the same set
+# forced onto the host-cell route (today's path for such cells).
+
+
+def _pattern_policies():
+    from kyverno_tpu.api.policy import ClusterPolicy
+
+    def P(name, rules):
+        return ClusterPolicy.from_dict({
+            "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+            "metadata": {"name": name}, "spec": {"rules": rules}})
+
+    pod_match = {"any": [{"resources": {"kinds": ["Pod"]}}]}
+    return [
+        P("pat-image-globs", [{
+            "name": "registry-globs", "match": pod_match,
+            "validate": {"message": "image must come from a known repo",
+                         "pattern": {"spec": {"containers": [{
+                             "image": "nginx-* | redis-?* | registry.corp/*"}]}}},
+        }]),
+        P("pat-anchored", [{
+            "name": "pull-policy", "match": pod_match,
+            "validate": {"message": "anchored string alternatives",
+                         "pattern": {"spec": {"containers": [{
+                             "imagePullPolicy": "Always | IfNotPresent"}]}}},
+        }]),
+        P("pat-name-glob", [{
+            "name": "names", "match": {"any": [{"resources": {
+                "kinds": ["Pod"], "names": ["app-*", "job-?????-*"]}}]},
+            "validate": {"message": "m",
+                         "pattern": {"metadata": {"name": "?*"}}},
+        }]),
+        P("pat-wild-labels", [{
+            "name": "team-label", "match": pod_match,
+            "validate": {"message": "team label tier must be set",
+                         "pattern": {"metadata": {"labels": {
+                             "tier-*": "frontend | backend | cache"}}}},
+        }]),
+        # the matches() VAP shape: CEL regex over names + image tags —
+        # the class that had NO device path before the DFA bank
+        P("pat-vap-matches", [{
+            "name": "re2-names", "match": pod_match,
+            "validate": {"cel": {"expressions": [
+                {"expression":
+                 "object.metadata.name.matches('^[a-z][a-z0-9-]{0,62}$')"},
+                {"expression":
+                 "!object.metadata.name.matches('^(tmp|scratch)-')"},
+            ]}},
+        }]),
+    ]
+
+
+def _pattern_snapshot(n, seed=11):
+    rng = random.Random(seed)
+    out = []
+    prefixes = ["app", "job", "tmp", "scratch", "svc"]
+    images = ["nginx-1.25", "redis-7", "registry.corp/payments/api:v3",
+              "docker.io/library/busybox", "nginx-edge"]
+    for i in range(n):
+        name = f"{rng.choice(prefixes)}-{rng.randrange(10**5):05d}-{i}"
+        labels = {"app": f"a{i % 7}"}
+        if rng.random() < 0.6:
+            labels[f"tier-{rng.randrange(3)}"] = rng.choice(
+                ["frontend", "backend", "cache", "edge"])
+        out.append({
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": name, "namespace": f"ns{i % 5}",
+                         "labels": labels},
+            "spec": {"containers": [{
+                "name": "c", "image": rng.choice(images),
+                "imagePullPolicy": rng.choice(
+                    ["Always", "IfNotPresent", "Never"])}]},
+        })
+    return out
+
+
+def bench_patterns(n_resources=None, tile=2048):
+    import numpy as np
+
+    from kyverno_tpu.observability.analytics import global_pattern_cells
+    from kyverno_tpu.tpu.cache import global_verdict_cache as vc
+    from kyverno_tpu.tpu.engine import TpuEngine
+
+    if n_resources is None:
+        n_resources = int(os.environ.get("BENCH_PATTERN_RESOURCES", "6000"))
+    baseline_n = min(n_resources,
+                     int(os.environ.get("BENCH_PATTERN_BASELINE", "800")))
+    policies = _pattern_policies()
+    resources = _pattern_snapshot(n_resources)
+    tiles = [resources[i:i + tile] for i in range(0, n_resources, tile)]
+
+    eng = TpuEngine(policies)
+    dev_rules, total_rules = eng.coverage()
+    v_cap = vc._lru.capacity
+    try:
+        vc.set_capacity(0)  # measure evaluation, not the verdict cache
+        # XLA builds outside timing: the residual tile may pad to a
+        # different power-of-two bucket than the full tiles
+        eng.scan(tiles[0])
+        if len(tiles) > 1:
+            eng.scan(tiles[-1])
+        # the artifact's pattern_cells must describe the MEASURED scan
+        # only — reset after the warm-up work above recorded its cells
+        global_pattern_cells.reset()
+        t0 = time.perf_counter()
+        device_out = [eng.scan(t) for t in tiles]
+        t_device = time.perf_counter() - t0
+
+        # host-cell baseline: the SAME policies with every rule forced
+        # onto the host route (quarantine -> scalar oracle per cell) —
+        # exactly where pattern cells lived before the DFA path. The
+        # oracle is slow, so the baseline runs a subset and reports
+        # res/s; bit-identity is asserted on that same subset.
+        from kyverno_tpu.tpu.compiler import compile_policy_set
+
+        host_cps = compile_policy_set(
+            policies, quarantine={i: "patterns-baseline"
+                                  for i in range(len(policies))})
+        host_eng = TpuEngine(cps=host_cps)
+        sub = resources[:baseline_n]
+        t0 = time.perf_counter()
+        host_out = host_eng.scan(sub)
+        t_host = time.perf_counter() - t0
+        dev_sub = np.concatenate(
+            [o.verdicts for o in device_out], axis=1)[:, :baseline_n]
+        bit_identical = bool(np.array_equal(dev_sub, host_out.verdicts))
+        assert bit_identical, \
+            "device pattern verdicts diverged from the scalar oracle"
+    finally:
+        vc.set_capacity(v_cap)
+
+    cells = global_pattern_cells.totals()
+    confirm_rate = global_pattern_cells.confirm_rate()
+    dev_rps = n_resources / max(t_device, 1e-9)
+    host_rps = baseline_n / max(t_host, 1e-9)
+    bank = eng.cps.dfa.stats() if eng.cps.dfa is not None else {}
+    import jax
+
+    return {
+        "metric": "pattern_resources_per_sec",
+        "value": round(dev_rps, 1),
+        "unit": "res/s",
+        "vs_baseline": round(dev_rps / max(host_rps, 1e-9), 2),
+        "backend": jax.default_backend(),
+        "resources": n_resources,
+        "baseline_resources": baseline_n,
+        "device_seconds": round(t_device, 3),
+        "host_cell_seconds": round(t_host, 3),
+        "host_cell_resources_per_sec": round(host_rps, 1),
+        "device_coverage": round(dev_rules / max(total_rules, 1), 4),
+        "pattern_cells": cells,
+        "confirm_rate": confirm_rate,
+        "dfa_bank": bank,
+        "bit_identical": bit_identical,
+    }
+
+
 FNS = {
     "scan": lambda: bench_scan(),
     "match": lambda: bench_match(),
@@ -960,12 +1120,24 @@ FNS = {
     "churn": lambda: bench_churn(),
     "cached": lambda: bench_cached(),
     "encode_scaling": lambda: bench_encode_scaling(),
+    "patterns": lambda: bench_patterns(),
 }
 
 
 def _default_xla_cache_dir():
     return os.environ.get("KYVERNO_TPU_XLA_CACHE_DIR") or os.path.join(
         os.path.dirname(os.path.abspath(__file__)), ".xla_cache")
+
+
+def _xla_cache_warmth():
+    """cold/warm state of the persistent XLA cache BEFORE the probe —
+    rides the artifact as probe_xla_cache so a trajectory of probe
+    timings is interpretable (a cold probe pays the full build)."""
+    try:
+        return "warm" if any(os.scandir(_default_xla_cache_dir())) \
+            else "cold"
+    except OSError:
+        return "cold"
 
 
 def _parse_probe_phases(stdout):
@@ -1015,13 +1187,18 @@ def _probe_backend(retries=None, sleep_s=None, timeout_s=None):
         return "compile_timeout" if "devices" in phases \
             else "backend_unavailable"
 
+    # the probe subprocess reuses the persistent XLA cache dir by
+    # DEFAULT (not only when the caller exported it): a cold probe is
+    # exactly the compile-timeout failure mode of BENCH_r03-r05
+    env = dict(os.environ)
+    env.setdefault("KYVERNO_TPU_XLA_CACHE_DIR", _default_xla_cache_dir())
     last = {"error": "backend probe failed", "stderr_tail": "",
             "phases": {}, "kind": "backend_unavailable"}
     for i in range(retries):
         try:
             r = subprocess.run(
                 [sys.executable, os.path.abspath(__file__), "_probe"],
-                capture_output=True, text=True, timeout=timeout_s)
+                capture_output=True, text=True, timeout=timeout_s, env=env)
             if r.returncode == 0 and "probe-ok" in r.stdout:
                 return None
             phases = _parse_probe_phases(r.stdout)
@@ -1115,6 +1292,7 @@ def run_all():
     # full recompile into a disk read
     os.environ.setdefault("KYVERNO_TPU_XLA_CACHE_DIR",
                           _default_xla_cache_dir())
+    out["probe_xla_cache"] = _xla_cache_warmth()
     err = None if os.environ.get("BENCH_SKIP_PROBE") else _probe_backend()
     platform_env = {}
     if err is not None:
@@ -1164,7 +1342,7 @@ def run_all():
         out["mixed_corpus_coverage"] = {"error": repr(e)[:300]}
     emit(out)
     for name in ("match", "overlay", "apply", "admission", "fallback",
-                 "cached", "encode_scaling", "churn"):
+                 "cached", "encode_scaling", "patterns", "churn"):
         if only and name not in only:
             continue
         t0 = time.perf_counter()
@@ -1242,6 +1420,8 @@ def main():
     config = argv[0] if argv else "all"
     if config == "--cached":  # flag spelling of the cached config
         config = "cached"
+    if config == "--patterns":  # flag spelling of the patterns config
+        config = "patterns"
     if config == "_probe":
         # phase-stamped progress: the parent's failure artifact shows
         # how far the probe got (import vs device attach vs compile)
@@ -1268,7 +1448,11 @@ def main():
         from kyverno_tpu.tpu.cache import enable_xla_compile_cache
         from kyverno_tpu.tpu.engine import TpuEngine
 
-        enable_xla_compile_cache()
+        # ALWAYS the bench-anchored persistent dir — a probe invoked
+        # outside run_all (or from another cwd) must not fall back to a
+        # cwd-relative cache and pay a cold build every run (the
+        # r03-r05 probe-timeout trajectory)
+        enable_xla_compile_cache(_default_xla_cache_dir())
         eng = TpuEngine([expand_policy(p) for p in load_pss_policies()])
         eng.scan([{}])
         print(f"probe-phase compile {time.perf_counter() - t0:.3f}",

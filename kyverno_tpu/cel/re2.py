@@ -74,12 +74,22 @@ class CharSet:
 
     def matches(self, ch: str) -> bool:
         if self.ci:
-            # some case folds are multi-char ('ß'.upper() == 'SS');
-            # those cannot equal a single class codepoint — skip them
-            lo, up = ch.lower(), ch.upper()
-            hit = (self._in(ch)
-                   or (len(lo) == 1 and self._in(lo))
-                   or (len(up) == 1 and self._in(up)))
+            # RE2 uses simple case-folding ORBITS, which can take two
+            # steps to land in a class range: 'ſ' (U+017F) folds via
+            # 'S' to 's', so (?i)[a-z] must match it. Close over
+            # lower/upper twice; multi-char folds ('ß'.upper() == 'SS')
+            # cannot equal a single class codepoint and are skipped.
+            cands = {ch}
+            frontier = {ch}
+            for _ in range(2):
+                nxt = set()
+                for c in frontier:
+                    for f in (c.lower(), c.upper()):
+                        if len(f) == 1 and f not in cands:
+                            cands.add(f)
+                            nxt.add(f)
+                frontier = nxt
+            hit = any(self._in(c) for c in cands)
         else:
             hit = self._in(ch)
         return hit != self.negated

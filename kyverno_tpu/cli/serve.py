@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import signal
 import sys
 import threading
@@ -115,6 +116,12 @@ def add_parser(sub: argparse._SubParsersAction) -> None:
                    help="per-policy kyverno_rule_* metric series kept "
                         "before collapsing into the _overflow bucket "
                         "(default $KYVERNO_TPU_RULE_METRICS_TOPK or 20)")
+    p.add_argument("--dfa-state-budget", type=int, default=None, metavar="N",
+                   help="per-pattern DFA state budget for device-side "
+                        "string matching: exact tables up to N states, "
+                        "over-approximating reduced tables (device hits "
+                        "confirmed by the scalar oracle) beyond it "
+                        "(default $KYVERNO_TPU_DFA_STATE_BUDGET or 192)")
     p.set_defaults(func=run)
 
 
@@ -319,6 +326,11 @@ def run(args: argparse.Namespace) -> int:
     global_slo.config.device_coverage_floor = args.slo_device_coverage_floor
     if args.rule_metrics_top_k is not None:
         global_registry.rule_stats.top_k = args.rule_metrics_top_k
+    if args.dfa_state_budget is not None:
+        # compile-time knob read at every policy-set compile (hot
+        # reloads included) via tpu/dfa.py state_budget()
+        os.environ["KYVERNO_TPU_DFA_STATE_BUDGET"] = \
+            str(args.dfa_state_budget)
     xla_dir = enable_xla_compile_cache(args.xla_cache_dir)
     if xla_dir:
         print(f"persistent XLA compile cache: {xla_dir}", file=sys.stderr)

@@ -281,6 +281,7 @@ class BackgroundScanService:
 
             global_rule_stats.ingest_table(eng.rule_idents(), hit_table,
                                            source="cached")
+            eng.record_pattern_replay(len(hit_entries))
         if miss:
             chunks, chunk_keys = [], []
             for start in range(0, len(miss), self.batch_size):

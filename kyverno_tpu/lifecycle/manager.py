@@ -511,6 +511,9 @@ class PolicySetLifecycleManager:
                 (dev / total) if total else 1.0)
         except Exception:
             pass
+        # re-publish the DFA bank gauges for the set that is now
+        # ACTIVE (probe/bisect compiles must not own these numbers)
+        engine.cps.publish_dfa_gauges()
         global_tracer.record_span(
             "policyset.swap", now, time.monotonic(),
             from_revision=prior.revision if prior else None,

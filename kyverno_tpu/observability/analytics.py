@@ -46,9 +46,10 @@ import numpy as np
 
 # verdict codes, tpu/evaluator.py order (mirrored, not imported: this
 # module must not pull jax into metrics-only consumers)
-PASS, SKIP, FAIL, NOT_MATCHED, ERROR, HOST = 0, 1, 2, 3, 4, 5
-NUM_CLASSES = 6
-CLASS_NAMES = ("pass", "skip", "fail", "not_matched", "error", "host")
+PASS, SKIP, FAIL, NOT_MATCHED, ERROR, HOST, CONFIRM = 0, 1, 2, 3, 4, 5, 6
+NUM_CLASSES = 7
+CLASS_NAMES = ("pass", "skip", "fail", "not_matched", "error", "host",
+               "confirm")
 
 
 def class_counts(table: Any, num_classes: int = NUM_CLASSES) -> np.ndarray:
@@ -257,9 +258,15 @@ class RuleStatsAccumulator:
                 a["fails"] += int(rec.counts[FAIL])
                 a["never_fired"] += 0 if fired else 1
         out = []
+        pattern_cells = global_pattern_cells.per_policy()
         for a in agg.values():
             a["device_coverage"] = round(
                 a["device_rules"] / a["rules"], 4) if a["rules"] else 0.0
+            pc = pattern_cells.get(a["policy"])
+            if pc:
+                # pattern host cells vs other host cells: the pattern
+                # block isolates how much host work is pattern-caused
+                a["pattern_cells"] = pc
             out.append(a)
         return sorted(out, key=lambda a: (-a["evals"], a["policy"]))
 
@@ -296,6 +303,71 @@ class RuleStatsAccumulator:
 
 
 global_rule_stats = RuleStatsAccumulator()
+
+
+class PatternCellTracker:
+    """Process-wide accounting of pattern-bearing cells by resolution
+    path (tpu/dfa.py ladder): ``device`` — the DFA verdict stood,
+    ``confirm`` — an approximate/byte-sensitive hit was confirmed by
+    the scalar oracle, ``host`` — a non-lowerable pattern kept the
+    whole cell on the host route. Feeds
+    kyverno_tpu_pattern_cells_total and the /debug/rules per-policy
+    coverage breakdown (pattern host cells vs other host cells)."""
+
+    PATHS = ("device", "confirm", "host")
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._per_policy: Dict[str, Dict[str, int]] = {}
+
+    def record(self, policy: str, device: int = 0, confirm: int = 0,
+               host: int = 0) -> None:
+        if not (device or confirm or host):
+            return
+        with self._lock:
+            d = self._per_policy.setdefault(
+                policy, {"device": 0, "confirm": 0, "host": 0})
+            d["device"] += int(device)
+            d["confirm"] += int(confirm)
+            d["host"] += int(host)
+        try:
+            from .metrics import global_registry as reg
+
+            for path, v in (("device", device), ("confirm", confirm),
+                            ("host", host)):
+                if v:
+                    reg.pattern_cells.inc({"path": path}, int(v))
+        except Exception:  # noqa: BLE001
+            pass  # metrics must never block the verdict path
+
+    def per_policy(self) -> Dict[str, Dict[str, int]]:
+        with self._lock:
+            return {k: dict(v) for k, v in self._per_policy.items()}
+
+    def totals(self) -> Dict[str, int]:
+        out = {p: 0 for p in self.PATHS}
+        with self._lock:
+            for d in self._per_policy.values():
+                for p in self.PATHS:
+                    out[p] += d[p]
+        return out
+
+    def confirm_rate(self) -> float:
+        t = self.totals()
+        denom = t["device"] + t["confirm"]
+        return round(t["confirm"] / denom, 6) if denom else 0.0
+
+    def state(self) -> Dict[str, Any]:
+        return {"totals": self.totals(),
+                "confirm_rate": self.confirm_rate(),
+                "per_policy": self.per_policy()}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._per_policy.clear()
+
+
+global_pattern_cells = PatternCellTracker()
 
 
 # ---------------------------------------------------------------------------

@@ -185,6 +185,24 @@ class MetricsRegistry:
         self.encode_cache_evictions = self.counter(
             "kyverno_tpu_encode_cache_evictions_total",
             "encode-row cache entries evicted at the LRU bound")
+        # device-side string matching (tpu/dfa.py): pattern-bearing
+        # cells by resolution path — device (DFA verdict stood),
+        # confirm (approximate/byte-sensitive hit confirmed by the
+        # scalar oracle), host (non-lowerable pattern) — plus the
+        # compiled bank's size gauges (set at policy-set compile)
+        self.pattern_cells = self.counter(
+            "kyverno_tpu_pattern_cells_total",
+            "pattern-bearing (rule, resource) cells by resolution path "
+            "(device/confirm/host)")
+        self.dfa_tables = self.gauge(
+            "kyverno_tpu_dfa_tables",
+            "compiled DFA pattern tables in the active policy set's bank")
+        self.dfa_states = self.gauge(
+            "kyverno_tpu_dfa_states",
+            "total DFA states across the active bank's tables")
+        self.dfa_bytes = self.gauge(
+            "kyverno_tpu_dfa_table_bytes",
+            "packed size of the active DFA bank's device arrays")
         # pipelined scan (tpu/pipeline.py): how much host work hid
         # behind device time in the last pipelined scan (0 = strictly
         # serial, higher = more overlap), plus chunk accounting

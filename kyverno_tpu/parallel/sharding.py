@@ -63,7 +63,7 @@ class ShardedScanner:
     across devices — the scan-service summary used for report rollups.
     """
 
-    NUM_CLASSES = 6
+    NUM_CLASSES = 7  # evaluator.NUM_VERDICT_CLASSES (incl. HOST/CONFIRM)
 
     def __init__(
         self,
@@ -85,7 +85,8 @@ class ShardedScanner:
         self.axes: Tuple[str, ...] = tuple(self.mesh.axis_names)
         self.axis = self.axes[0]
         self._raw_fn = build_program(
-            self.cps.device_programs, self.cps.encode_cfg.max_instances
+            self.cps.device_programs, self.cps.encode_cfg.max_instances,
+            dfa=self.cps.dfa,
         )
         repl = NamedSharding(self.mesh, P())
         # vocabulary-axis buckets grow monotonically so tile-to-tile
@@ -298,7 +299,8 @@ class ShardedScanner:
                         namespace_labels,
                         operations[sl] if operations else None,
                     )
-                stats["host_cells"] += int((table == HOST).sum())
+                # HOST and CONFIRM cells both resolved on the host
+                stats["host_cells"] += int((table >= HOST).sum())
                 stats["host_s"] += time.perf_counter() - t0
                 tables.append(res.verdicts)
             else:

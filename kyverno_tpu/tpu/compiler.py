@@ -19,9 +19,25 @@ from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
 import jax
 
 from ..api.policy import ClusterPolicy, Rule
+from ..engine.operator import Operator
+from ..utils.wildcard import contains_wildcard
+from .dfa import DfaBank, DfaUnsupported, state_budget
 from .evaluator import build_program
-from .flatten import EncodeConfig
-from .ir import DynKey, DynSlot, DynValueRef, RuleProgram, Unsupported, compile_rule
+from .flatten import EncodeConfig, plan_byte_pool
+from .ir import (
+    ArrayMapsNode,
+    ArrayScalarNode,
+    DynKey,
+    DynSlot,
+    DynValueRef,
+    ExistenceNode,
+    LeafNode,
+    MapNode,
+    RuleProgram,
+    StrLeaf,
+    Unsupported,
+    compile_rule,
+)
 from .metadata import MetaConfig
 
 
@@ -43,6 +59,98 @@ class RuleEntry:
     rule_name: str
     device_row: Optional[int]      # row in the device verdict table
     fallback_reason: Optional[str]  # set for host rules
+
+    @property
+    def pattern_host(self) -> bool:
+        """Host rule whose fallback is pattern-caused (non-lowerable
+        regex etc.) — the coverage accounting distinguishes these
+        cells from other host cells."""
+        return bool(self.fallback_reason
+                    and self.fallback_reason.startswith("pattern:"))
+
+
+# ---------------------------------------------------------------------------
+# DFA bank collection: every glob/regex operand a compiled program
+# evaluates, registered per byte-lane family (tpu/dfa.py)
+
+
+def _leaf_glob_operands(leaf) -> List[str]:
+    if not isinstance(leaf, StrLeaf):
+        return []
+    return [c.operand
+            for units in leaf.alternatives for unit in units for c in unit
+            if c.is_glob and c.operand != "*"
+            and c.op in (Operator.EQUAL, Operator.NOT_EQUAL)]
+
+
+def _walk_pattern_globs(specs: List[Tuple[str, str, str]], node) -> None:
+    if node is None:
+        return
+    if isinstance(node, (LeafNode, ArrayScalarNode)):
+        for g in _leaf_glob_operands(node.leaf):
+            specs.append(("glob", g, "pool"))
+        return
+    if isinstance(node, MapNode):
+        for a in node.anchors:
+            _walk_pattern_globs(specs, a.child)
+            _note_wildcard_key(specs, a.wildcard)
+        for c in node.phase2:
+            _walk_pattern_globs(specs, c.child)
+            _note_wildcard_key(specs, c.wildcard)
+        return
+    if isinstance(node, ArrayMapsNode):
+        _walk_pattern_globs(specs, node.element)
+        return
+    if isinstance(node, ExistenceNode):
+        for el in node.elements:
+            _walk_pattern_globs(specs, el)
+
+
+def _note_wildcard_key(specs: List[Tuple[str, str, str]], wc) -> None:
+    if wc is None:
+        return
+    specs.append(("glob", wc.glob, "pool"))  # key bytes share the pool
+    for g in _leaf_glob_operands(wc.leaf):
+        specs.append(("glob", g, "pool"))
+
+
+def _program_pattern_specs(prog: RuleProgram) -> List[Tuple[str, str, str]]:
+    """Every (kind, pattern, lane-family) a compiled program matches
+    through the DFA bank."""
+    specs: List[Tuple[str, str, str]] = []
+    for root in prog.patterns:
+        _walk_pattern_globs(specs, root)
+    for block in (prog.match, prog.exclude):
+        if block is None:
+            continue
+        for f in block.filters:
+            for nm in ([f.name] if f.name else []) + list(f.names):
+                if contains_wildcard(nm):
+                    specs.append(("glob", nm, "name"))
+            for ns in f.namespaces:
+                if contains_wildcard(ns):
+                    specs.append(("glob", ns, "ns"))
+                    # Namespace-kind resources compare their NAME
+                    specs.append(("glob", ns, "name"))
+            if f.selector is not None:
+                for k_pat, v_pat in getattr(f.selector, "wild_labels", ()):
+                    specs.append(("glob", k_pat, "labels_kb"))
+                    specs.append(("glob", v_pat, "labels_vb"))
+    for rx in prog.regex_patterns:
+        specs.append(("re2", rx, "pool"))
+    return specs
+
+
+def _register_program_patterns(bank: DfaBank, prog: RuleProgram) -> bool:
+    """Register a program's patterns; returns whether it has any
+    (pattern-cell accounting rides prog.uses_patterns)."""
+    specs = _program_pattern_specs(prog)
+    for kind, pattern, family in specs:
+        if kind == "re2":
+            bank.add_re2(pattern, family)
+        else:
+            bank.add_glob(pattern, family)
+    return bool(specs)
 
 
 @dataclass
@@ -66,6 +174,10 @@ class CompiledPolicySet:
     # (their rules are host-fallback RuleEntries tagged "quarantined:"),
     # with the compile error that put them there
     quarantined: Dict[int, str] = field(default_factory=dict)
+    # the policy set's compiled pattern tables (tpu/dfa.py): every
+    # glob/regex operand as one DFA in a packed bank, evaluated by the
+    # device program in one scan per byte-lane family
+    dfa: Optional[DfaBank] = None
     _fn: Optional[Callable] = field(default=None, repr=False)
     _cache_key: Optional[str] = field(default=None, repr=False)
     _policy_spec_hashes: Optional[List[str]] = field(default=None, repr=False)
@@ -95,7 +207,7 @@ class CompiledPolicySet:
                 self._fn = jax.jit(
                     build_program(self.device_programs,
                                   self.encode_cfg.max_instances,
-                                  with_counts=True)
+                                  with_counts=True, dfa=self.dfa)
                 )
         else:
             global_registry.compile_cache.inc({"outcome": "hit"})
@@ -115,6 +227,23 @@ class CompiledPolicySet:
     def coverage(self) -> Tuple[int, int]:
         dev = sum(1 for e in self.rules if e.device_row is not None)
         return dev, len(self.rules)
+
+    def publish_dfa_gauges(self) -> None:
+        """Point the bank-size gauges at THIS set. Called when a set
+        becomes the serving artifact (engine construction, lifecycle
+        swap) — NOT on every compile, so probe/bisect/baseline
+        compiles never clobber the active set's numbers."""
+        if self.dfa is None:
+            return
+        try:
+            from ..observability.metrics import global_registry as _reg
+
+            stats = self.dfa.stats()
+            _reg.dfa_tables.set(stats["tables"])
+            _reg.dfa_states.set(stats["states"])
+            _reg.dfa_bytes.set(stats["bytes"])
+        except Exception:  # noqa: BLE001
+            pass  # metrics must never block the serving path
 
     def cache_key(self) -> str:
         """Content identity of this compiled artifact — the policy-set
@@ -136,7 +265,11 @@ class CompiledPolicySet:
                  self.encode_cfg.byte_pool_slots,
                  self.encode_cfg.byte_pool_width),
                 sorted(vars(self.meta_cfg).items()),
-                sorted(self.byte_paths), sorted(self.key_byte_paths))
+                sorted(self.byte_paths), sorted(self.key_byte_paths),
+                # the DFA state budget changes tables (and the confirm
+                # ladder) without changing policy content — the bank
+                # digest rotates verdict-cache keys when it moves
+                self.dfa.digest() if self.dfa is not None else "")
         return self._cache_key
 
 
@@ -179,6 +312,7 @@ def _compile_policy_set(
     key_byte_paths: Set[int] = set()
     deps: Dict[str, Optional[str]] = {}
     dyn_slots: List[DynSlot] = []
+    bank = DfaBank(state_budget())
     for pi, policy in enumerate(policies):
         q_err = quarantine.get(pi)
         for rule in policy.get_rules():
@@ -190,6 +324,14 @@ def _compile_policy_set(
                 continue
             try:
                 prog = compile_rule(policy, rule, data_sources, deps)
+                # register the rule's patterns with the bank BEFORE
+                # committing the program: a full bank demotes the rule
+                # to host instead of compiling an unevaluable program
+                try:
+                    prog.uses_patterns = _register_program_patterns(bank,
+                                                                    prog)
+                except DfaUnsupported as e:
+                    raise Unsupported(f"pattern: {e}")
                 row = len(programs)
                 if prog.dyn_slots:
                     # rebase rule-local operand slots onto the global
@@ -220,6 +362,12 @@ def _compile_policy_set(
         for block in (prog.match, prog.exclude) if block is not None
         for f in block.filters
         for sel in (f.selector, f.ns_selector) if sel is not None)
+    bank.finalize()
+    # byte-lane capacity planning: pattern-referenced paths need pool
+    # slots; a pattern-heavy set grows the pool instead of flagging
+    # every resource into host fallback (the cfg copy keeps the
+    # caller's shared EncodeConfig untouched, like meta_cfg above)
+    encode_cfg = plan_byte_pool(encode_cfg, byte_paths, key_byte_paths)
     return CompiledPolicySet(
         policies=list(policies),
         rules=entries,
@@ -231,4 +379,5 @@ def _compile_policy_set(
         context_deps=deps,
         dyn_slots=dyn_slots,
         quarantined=quarantine,
+        dfa=bank,
     )

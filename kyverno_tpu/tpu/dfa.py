@@ -1,0 +1,582 @@
+"""Pattern classes -> dense DFA transition tables over byte lanes.
+
+The device engine historically evaluated glob operands with a
+bit-parallel NFA unrolled at trace time: one ``lax.scan`` with
+O(pattern_len) boolean state columns PER DISTINCT PATTERN
+(evaluator.glob_match). That shape is linear in patterns twice — XLA
+program size and device work both grow with (patterns x positions) —
+and regex patterns (CEL ``matches()``) had no device story at all,
+keeping whole rules on the host path.
+
+This module compiles the pattern classes the engine already parses —
+``utils/wildcard`` globs, the tractable subset of ``cel/re2.py``
+regexes — into dense DFA transition tables stepped as batched table
+lookups (the Hyperflex SIMD-DFA model, arXiv:2512.07123): one
+``(states x alphabet)`` uint16 table per pattern, alphabet compressed
+to per-pattern byte classes, all tables of a policy set concatenated
+into ONE bank evaluated in ONE ``lax.scan`` over the byte lanes —
+every (pattern x string-lane) pair in a single fused dispatch.
+
+Exactness ladder (approximate-reduction, arXiv:1710.08647):
+
+- DFAs are built by subset construction under a per-pattern state
+  budget. A pattern that blows the budget gets an OVER-approximating
+  reduced DFA (overflow states collapse into an accept-all TOP state):
+  a device MISS is definitive, a device HIT is confirmed by the scalar
+  oracle — so approximation costs confirmation work on the rare hits,
+  never correctness.
+- Tables run over UTF-8 BYTES while the host oracles match CODEPOINTS.
+  For pure-ASCII subjects the two are identical; patterns whose
+  semantics can differ on multi-byte subjects (``?`` globs — one char
+  vs one byte — and every regex) carry ``confirm_nonascii``: subjects
+  containing a byte >= 0x80 route to oracle confirmation regardless of
+  the DFA verdict. ``*``-only ASCII-literal globs are byte-exact for
+  ALL subjects and skip the ladder entirely.
+
+Genuinely non-lowerable patterns (word boundaries, multiline anchors,
+lookaround — which cel/re2.py itself rejects) raise
+:class:`DfaUnsupported` and keep today's host route.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from ..cel.re2 import (
+    A_BOT,
+    A_EOT,
+    Re2Error,
+    _NFA,
+    _Parser,
+    _compile as _re2_nfa_compile,
+)
+
+__all__ = [
+    "Dfa", "DfaBank", "DfaUnsupported", "compile_glob", "compile_re2",
+    "bank_match", "nonascii_mask", "state_budget",
+]
+
+
+class DfaUnsupported(Exception):
+    """Pattern outside the lowerable subset -> host route."""
+
+
+DEFAULT_STATE_BUDGET = 192
+# total bank states must index as uint16 with headroom
+MAX_BANK_STATES = 60000
+
+
+def state_budget() -> int:
+    """Per-pattern DFA state budget (the approximate-reduction knob):
+    exact subset construction up to this many states, over-approximating
+    TOP-collapse beyond it. serve --dfa-state-budget / env override."""
+    try:
+        return max(4, int(os.environ.get("KYVERNO_TPU_DFA_STATE_BUDGET",
+                                         str(DEFAULT_STATE_BUDGET))))
+    except ValueError:
+        return DEFAULT_STATE_BUDGET
+
+
+@dataclass
+class Dfa:
+    """One compiled pattern: dense transition table over byte classes.
+
+    ``trans`` is (n_states, n_classes) int32 with LOCAL state ids;
+    ``class_map`` maps each byte 0..255 to its column; ``accept`` marks
+    accepting states (evaluated at end-of-string — the scan freezes the
+    state once the cursor passes the string length)."""
+
+    pattern: str
+    kind: str                    # glob | re2
+    trans: np.ndarray
+    class_map: np.ndarray        # (256,) uint8
+    accept: np.ndarray           # (n_states,) bool
+    start: int
+    exact: bool                  # False => over-approximating (hit -> confirm)
+    confirm_nonascii: bool       # byte/codepoint semantics may differ
+
+    @property
+    def n_states(self) -> int:
+        return int(self.trans.shape[0])
+
+    @property
+    def n_classes(self) -> int:
+        return int(self.trans.shape[1])
+
+    def match_bytes(self, data: bytes) -> bool:
+        """Host-side table walk — the parity/fuzz oracle for the packed
+        device kernel (identical table, identical stepping order)."""
+        s = self.start
+        trans, cmap = self.trans, self.class_map
+        for b in data:
+            s = int(trans[s, cmap[b]])
+        return bool(self.accept[s])
+
+    def match_str(self, text: str) -> bool:
+        return self.match_bytes(text.encode("utf-8"))
+
+
+# ---------------------------------------------------------------------------
+# alphabet compression: partition bytes by membership signature
+
+def _byte_classes(predicates: Sequence[FrozenSet[int]]
+                  ) -> Tuple[np.ndarray, List[int]]:
+    """Bytes indistinguishable by every predicate share a class.
+    Returns (class_map (256,) uint8, representative byte per class)."""
+    if not predicates:
+        return np.zeros(256, dtype=np.uint8), [0]
+    member = np.zeros((len(predicates), 256), dtype=bool)
+    for i, pred in enumerate(predicates):
+        for b in pred:
+            member[i, b] = True
+    # unique signature columns -> class ids
+    _, inverse = np.unique(member.T, axis=0, return_inverse=True)
+    class_map = inverse.astype(np.uint8)
+    reps: List[int] = []
+    seen: Dict[int, int] = {}
+    for b in range(256):
+        c = int(class_map[b])
+        if c not in seen:
+            seen[c] = b
+    for c in range(int(class_map.max()) + 1):
+        reps.append(seen[c])
+    return class_map, reps
+
+
+class _Determinizer:
+    """Budgeted subset construction. Overflow states collapse into one
+    accept-all TOP state (over-approximation: miss stays definitive)."""
+
+    def __init__(self, n_classes: int, budget: int):
+        self.n_classes = n_classes
+        self.budget = budget
+        self.ids: Dict[object, int] = {}
+        self.trans: List[List[int]] = []
+        self.accept: List[bool] = []
+        self.exact = True
+        self._top: Optional[int] = None
+
+    def top(self) -> int:
+        if self._top is None:
+            self._top = len(self.trans)
+            self.trans.append([self._top] * self.n_classes)
+            self.accept.append(True)
+        return self._top
+
+    def intern(self, key) -> Tuple[int, bool]:
+        """(state id, is_new). Over budget -> TOP, exact=False."""
+        sid = self.ids.get(key)
+        if sid is not None:
+            return sid, False
+        if len(self.trans) >= self.budget:
+            self.exact = False
+            return self.top(), False
+        sid = len(self.trans)
+        self.ids[key] = sid
+        self.trans.append([0] * self.n_classes)
+        self.accept.append(False)
+        return sid, True
+
+
+# ---------------------------------------------------------------------------
+# glob -> DFA (anchored full match, go-wildcard semantics over bytes)
+
+def _glob_elems(pattern: str) -> List[Tuple]:
+    elems: List[Tuple] = []
+    for ch in pattern:
+        if ch == "*":
+            if elems and elems[-1][0] == "star":
+                continue
+            elems.append(("star",))
+        elif ch == "?":
+            elems.append(("any",))
+        else:
+            for b in ch.encode("utf-8"):
+                elems.append(("byte", b))
+    return elems
+
+
+# compiled-table memo: subset construction runs once per (pattern,
+# budget) per process, not once per policy-set compile — the IR
+# lowering probes compile_re2 for lowerability and the bank compiles
+# the same pattern again, and lifecycle compile-ahead / quarantine
+# bisect recompile whole sets repeatedly. Dfa instances are
+# read-only-by-convention and safely shared across banks.
+_DFA_MEMO: Dict[Tuple[str, str, int], "Dfa"] = {}
+_DFA_MEMO_CAP = 1024
+
+
+def _memoized(kind: str, pattern: str, budget: int, build) -> "Dfa":
+    key = (kind, pattern, budget)
+    dfa = _DFA_MEMO.get(key)
+    if dfa is None:
+        dfa = build()
+        if len(_DFA_MEMO) >= _DFA_MEMO_CAP:
+            _DFA_MEMO.clear()
+        _DFA_MEMO[key] = dfa
+    return dfa
+
+
+def compile_glob(pattern: str, budget: Optional[int] = None) -> Dfa:
+    budget = budget or state_budget()
+    return _memoized("glob", pattern, budget,
+                     lambda: _compile_glob(pattern, budget))
+
+
+def _compile_glob(pattern: str, budget: int) -> Dfa:
+    elems = _glob_elems(pattern)
+    m = len(elems)
+
+    def close(posns: Set[int]) -> FrozenSet[int]:
+        out = set(posns)
+        stack = list(posns)
+        while stack:
+            j = stack.pop()
+            if j < m and elems[j][0] == "star" and j + 1 not in out:
+                out.add(j + 1)
+                stack.append(j + 1)
+        return frozenset(out)
+
+    lits = sorted({e[1] for e in elems if e[0] == "byte"})
+    predicates = [frozenset((b,)) for b in lits]
+    has_any = any(e[0] in ("any", "star") for e in elems)
+    if has_any:
+        predicates.append(frozenset(range(256)))
+    class_map, reps = _byte_classes(predicates)
+
+    det = _Determinizer(len(reps), budget)
+    start_set = close({0})
+    start, _ = det.intern(start_set)
+    det.accept[start] = m in start_set
+    work = [(start, start_set)]
+    while work:
+        sid, S = work.pop()
+        for c, rb in enumerate(reps):
+            moved: Set[int] = set()
+            for j in S:
+                if j >= m:
+                    continue
+                k, *payload = elems[j]
+                if k == "byte":
+                    if payload[0] == rb:
+                        moved.add(j + 1)
+                elif k == "any":
+                    moved.add(j + 1)
+                else:  # star: consumes any byte, stays (closure adds j+1)
+                    moved.add(j)
+            nset = close(moved)
+            nid, fresh = det.intern(nset)
+            det.trans[sid][c] = nid
+            if fresh:
+                det.accept[nid] = m in nset
+                work.append((nid, nset))
+    return Dfa(
+        pattern=pattern, kind="glob",
+        trans=np.asarray(det.trans, dtype=np.int32).reshape(
+            len(det.trans), det.n_classes),
+        class_map=class_map,
+        accept=np.asarray(det.accept, dtype=bool),
+        start=start, exact=det.exact,
+        confirm_nonascii=("?" in pattern),
+    )
+
+
+# ---------------------------------------------------------------------------
+# re2 subset -> DFA (unanchored search, cel matches() semantics)
+
+def _charset_bytes(cs) -> FrozenSet[int]:
+    """ASCII bytes the charset matches exactly, plus the 0x80-0xFF lump
+    whenever the set can match any non-ASCII codepoint (subjects with
+    such bytes confirm on the oracle anyway — see module docstring)."""
+    out = {b for b in range(128) if cs.matches(chr(b))}
+    if cs.ci:
+        high = True  # case folds can cross the ASCII boundary
+    elif cs.negated:
+        # negation matches some codepoint >= 128 unless the ranges
+        # cover [128, 0x10FFFF] completely
+        cursor = 128
+        for lo, hi in sorted(cs.ranges):
+            if hi < cursor:
+                continue
+            if lo > cursor:
+                break
+            cursor = hi + 1
+        high = cursor <= 0x10FFFF
+    else:
+        high = any(hi >= 128 for _, hi in cs.ranges)
+    if high:
+        out |= set(range(128, 256))
+    return frozenset(out)
+
+
+def compile_re2(pattern: str, budget: Optional[int] = None) -> Dfa:
+    """Compile a cel/re2.py pattern into a search DFA (partial-match
+    semantics: the byte automaton re-seeds the NFA start at every
+    position, acceptance is sticky). Raises DfaUnsupported for
+    constructs byte tables cannot carry (word boundaries, multiline
+    anchors) — and Re2Error propagates for non-RE2 syntax."""
+    budget = budget or state_budget()
+    return _memoized("re2", pattern, budget,
+                     lambda: _compile_re2(pattern, budget))
+
+
+def _compile_re2(pattern: str, budget: int) -> Dfa:
+    try:
+        ast = _Parser(pattern).parse()
+    except Re2Error:
+        raise
+    nfa = _NFA()
+    accept_id = nfa.state()
+    nfa_start = _re2_nfa_compile(nfa, ast, accept_id)
+    for a in nfa.asserts:
+        if a is not None and a not in (A_BOT, A_EOT):
+            raise DfaUnsupported(
+                f"assertion {a} (word boundary / multiline anchor) has no "
+                f"byte-DFA lowering")
+
+    char_states = [s for s in range(len(nfa.chars))
+                   if nfa.chars[s] is not None]
+    byteset: Dict[int, FrozenSet[int]] = {
+        s: _charset_bytes(nfa.chars[s]) for s in char_states}
+    class_map, reps = _byte_classes(list(byteset.values()))
+
+    def closure(raw: FrozenSet[int], at_start: bool, at_end: bool
+                ) -> Tuple[FrozenSet[int], bool]:
+        seen: Set[int] = set()
+        chars: Set[int] = set()
+        hit = False
+        stack = list(raw)
+        while stack:
+            s = stack.pop()
+            if s in seen:
+                continue
+            seen.add(s)
+            if s == accept_id:
+                hit = True
+                continue
+            if nfa.chars[s] is not None:
+                chars.add(s)
+                continue
+            a = nfa.asserts[s]
+            if a == A_BOT and not at_start:
+                continue
+            if a == A_EOT and not at_end:
+                continue
+            stack.extend(nfa.eps[s])
+        return frozenset(chars), hit
+
+    det = _Determinizer(len(reps), budget)
+    start_key = (frozenset((nfa_start,)), True)
+    start, _ = det.intern(start_key)
+    _, acc0 = closure(start_key[0], True, True)
+    det.accept[start] = acc0
+    work = [(start, start_key)]
+    while work:
+        sid, (raw, at_start) = work.pop()
+        chars, hit_mid = closure(raw, at_start, False)
+        if hit_mid:
+            # search already succeeded before this position: sticky
+            det.trans[sid] = [det.top()] * det.n_classes
+            det.accept[sid] = True
+            continue
+        for c, rb in enumerate(reps):
+            moved: Set[int] = set()
+            for s in chars:
+                if rb in byteset[s]:
+                    moved.update(nfa.eps[s])
+            # unanchored search: re-seed the NFA start at the next byte
+            nraw = frozenset(moved | {nfa_start})
+            nkey = (nraw, False)
+            nid, fresh = det.intern(nkey)
+            det.trans[sid][c] = nid
+            if fresh:
+                _, acc = closure(nraw, False, True)
+                det.accept[nid] = acc
+                work.append((nid, nkey))
+    return Dfa(
+        pattern=pattern, kind="re2",
+        trans=np.asarray(det.trans, dtype=np.int32).reshape(
+            len(det.trans), det.n_classes),
+        class_map=class_map,
+        accept=np.asarray(det.accept, dtype=bool),
+        start=start, exact=det.exact,
+        confirm_nonascii=True,
+    )
+
+
+# ---------------------------------------------------------------------------
+# the bank: one packed table set per compiled policy set
+
+@dataclass
+class DfaBank:
+    """All of a policy set's patterns, concatenated for one-dispatch
+    evaluation. ``families`` records which byte-lane family each
+    pattern is matched against (pool / name / ns / labels_kb /
+    labels_vb), so the evaluator runs one scan per family covering
+    every pattern used on it."""
+
+    budget: int = field(default_factory=state_budget)
+    patterns: List[Dfa] = field(default_factory=list)
+    glob_ids: Dict[str, int] = field(default_factory=dict)
+    re2_ids: Dict[str, int] = field(default_factory=dict)
+    families: Dict[str, List[int]] = field(default_factory=dict)
+    # packed (finalize())
+    trans: Optional[np.ndarray] = None       # (S_total, C_max) uint16, GLOBAL ids
+    class_map: Optional[np.ndarray] = None   # (P, 256) uint8
+    start: Optional[np.ndarray] = None       # (P,) int32 global
+    accept: Optional[np.ndarray] = None      # (S_total,) bool
+    exact: Optional[np.ndarray] = None       # (P,) bool
+    confirm_nonascii: Optional[np.ndarray] = None  # (P,) bool
+
+    def _room(self, dfa: Dfa) -> bool:
+        total = sum(p.n_states for p in self.patterns)
+        return total + dfa.n_states <= MAX_BANK_STATES
+
+    def add_glob(self, pattern: str, family: str) -> Optional[int]:
+        """Register a glob; None when the bank is full (the evaluator
+        then falls back to the legacy per-pattern NFA for it)."""
+        pid = self.glob_ids.get(pattern)
+        if pid is None:
+            dfa = compile_glob(pattern, self.budget)
+            if not self._room(dfa):
+                return None
+            pid = len(self.patterns)
+            self.patterns.append(dfa)
+            self.glob_ids[pattern] = pid
+        self._note(family, pid)
+        return pid
+
+    def add_re2(self, pattern: str, family: str = "pool") -> int:
+        """Register a regex; raises DfaUnsupported when non-lowerable
+        or the bank has no room (the rule keeps its host route)."""
+        pid = self.re2_ids.get(pattern)
+        if pid is None:
+            dfa = compile_re2(pattern, self.budget)
+            if not self._room(dfa):
+                raise DfaUnsupported("DFA bank state capacity exhausted")
+            pid = len(self.patterns)
+            self.patterns.append(dfa)
+            self.re2_ids[pattern] = pid
+        self._note(family, pid)
+        return pid
+
+    def _note(self, family: str, pid: int) -> None:
+        ids = self.families.setdefault(family, [])
+        if pid not in ids:
+            ids.append(pid)
+            ids.sort()
+
+    def __len__(self) -> int:
+        return len(self.patterns)
+
+    def finalize(self) -> "DfaBank":
+        P = len(self.patterns)
+        c_max = max((p.n_classes for p in self.patterns), default=1)
+        s_total = sum(p.n_states for p in self.patterns)
+        trans = np.zeros((max(s_total, 1), c_max), dtype=np.uint16)
+        cmap = np.zeros((max(P, 1), 256), dtype=np.uint8)
+        start = np.zeros((max(P, 1),), dtype=np.int32)
+        accept = np.zeros((max(s_total, 1),), dtype=bool)
+        exact = np.ones((max(P, 1),), dtype=bool)
+        conf_na = np.zeros((max(P, 1),), dtype=bool)
+        base = 0
+        for i, p in enumerate(self.patterns):
+            n = p.n_states
+            # pad columns repeat the state's class-0 move: class ids
+            # beyond the pattern's own alphabet are never produced by
+            # its class_map, so the padding is unreachable by design
+            local = p.trans + base
+            trans[base:base + n, :p.n_classes] = local
+            if p.n_classes < c_max:
+                trans[base:base + n, p.n_classes:] = local[:, :1]
+            cmap[i] = p.class_map
+            start[i] = base + p.start
+            accept[base:base + n] = p.accept
+            exact[i] = p.exact
+            conf_na[i] = p.confirm_nonascii
+            base += n
+        self.trans, self.class_map = trans, cmap
+        self.start, self.accept = start, accept
+        self.exact, self.confirm_nonascii = exact, conf_na
+        return self
+
+    # -- introspection / identity
+
+    def stats(self) -> Dict[str, int]:
+        states = sum(p.n_states for p in self.patterns)
+        packed = 0
+        if self.trans is not None and self.patterns:
+            # pattern-free banks hold 1-row placeholder arrays only —
+            # report 0, not the placeholder footprint
+            packed = (self.trans.nbytes + self.class_map.nbytes
+                      + self.start.nbytes + self.accept.nbytes)
+        return {"tables": len(self.patterns), "states": states,
+                "bytes": packed,
+                "approx": sum(1 for p in self.patterns if not p.exact)}
+
+    def digest(self) -> str:
+        """Cache-key material: the state budget changes table shapes
+        (and the confirm ladder) without changing policy content, so
+        the compiled-set identity must cover it."""
+        h = hashlib.sha256()
+        h.update(str(self.budget).encode())
+        for p in self.patterns:
+            h.update(f"|{p.kind}:{p.pattern}:{int(p.exact)}:"
+                     f"{p.n_states}".encode())
+        return h.hexdigest()[:16]
+
+
+# ---------------------------------------------------------------------------
+# batched device kernel: ONE scan over bytes steps every
+# (pattern x string-lane) pair through the packed tables
+
+def bank_match(bank: DfaBank, ids: Sequence[int], bytes_, lens):
+    """Evaluate the bank patterns ``ids`` against padded byte tensors.
+
+    bytes_: (..., W) uint8, lens: (...) int32 -> (..., K) bool accepts,
+    K = len(ids). The scan performs two gathers per byte position —
+    class lookup and transition lookup — for ALL pattern/string pairs
+    at once; pad bytes beyond each string's length freeze the state, so
+    acceptance reads out at exactly end-of-string."""
+    import jax
+    import jax.numpy as jnp
+
+    assert bank.trans is not None, "bank not finalized"
+    idx = np.asarray(list(ids), dtype=np.int32)
+    K = idx.shape[0]
+    cmap_t = jnp.asarray(bank.class_map[idx].T.astype(np.int32))  # (256, K)
+    start = jnp.asarray(bank.start[idx])
+    C = bank.trans.shape[1]
+    trans_flat = jnp.asarray(bank.trans.reshape(-1).astype(np.int32))
+    accept = jnp.asarray(bank.accept)
+    lead = bytes_.shape[:-1]
+    W = bytes_.shape[-1]
+    state0 = jnp.broadcast_to(start, lead + (K,)).astype(jnp.int32)
+    seq = jnp.moveaxis(bytes_, -1, 0)  # (W, ...)
+
+    def step(state, xw):
+        b, w = xw
+        cls = cmap_t[b.astype(jnp.int32)]          # (..., K)
+        nxt = jnp.take(trans_flat, state * C + cls)
+        active = (w < lens)[..., None]
+        return jnp.where(active, nxt, state), None
+
+    state, _ = jax.lax.scan(
+        step, state0, (seq, jnp.arange(W, dtype=np.int32)))
+    return jnp.take(accept, state)
+
+
+def nonascii_mask(bytes_, lens):
+    """(...,) bool: any byte >= 0x80 within the string length — the
+    subjects whose byte/codepoint semantics can diverge (they take the
+    oracle-confirmation path for confirm_nonascii patterns)."""
+    import jax.numpy as jnp
+
+    W = bytes_.shape[-1]
+    live = jnp.arange(W, dtype=np.int32) < lens[..., None]
+    return ((bytes_ >= np.uint8(0x80)) & live).any(axis=-1)

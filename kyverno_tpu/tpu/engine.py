@@ -9,7 +9,10 @@ and resources exceeding encode caps are completed with the scalar
 engine, so results always cover everything.
 
 Verdict codes follow evaluator.py: 0 PASS, 1 SKIP, 2 FAIL,
-3 NOT_MATCHED, 4 ERROR (5 HOST never escapes — it is resolved here).
+3 NOT_MATCHED, 4 ERROR (5 HOST and 6 CONFIRM never escape — both are
+resolved here; CONFIRM is the pattern-confirmation sub-batch from the
+approximate-DFA ladder, counted as device work in coverage terms
+because only the rare hits pay it).
 """
 
 from __future__ import annotations
@@ -28,6 +31,7 @@ from ..engine.match import RequestInfo
 from ..engine.policycontext import PolicyContext
 from ..engine.response import EngineResponse
 from ..observability.analytics import (NUM_CLASSES, RuleIdent, class_counts,
+                                       global_pattern_cells,
                                        global_rule_stats, global_starvation)
 from ..observability.profiling import (PATH_DEVICE, PATH_SCALAR_FALLBACK,
                                        PHASE_DISPATCH, PHASE_ENCODE,
@@ -37,7 +41,8 @@ from ..observability.profiling import (PATH_DEVICE, PATH_SCALAR_FALLBACK,
 from ..observability.tracing import global_tracer
 from ..resilience.faults import SITE_TPU_DISPATCH, global_faults
 from .compiler import CompiledPolicySet, compile_policy_set
-from .evaluator import ERROR, FAIL, HOST, NOT_MATCHED, PASS, SKIP, batch_to_host
+from .evaluator import (CONFIRM, ERROR, FAIL, HOST, NOT_MATCHED, PASS, SKIP,
+                        batch_to_host)
 from .flatten import EncodeConfig, encode_resources
 from .metadata import MetaConfig, encode_metadata
 
@@ -216,6 +221,7 @@ class TpuEngine:
             global_rule_stats.register(self.rule_idents())
         except Exception:
             pass  # analytics must never block engine construction
+        self.cps.publish_dfa_gauges()
 
     @classmethod
     def from_compiled(cls, cps: CompiledPolicySet) -> "TpuEngine":
@@ -700,7 +706,28 @@ class TpuEngine:
                 global_rule_stats.ingest_table(
                     self.rule_idents(), total[:, live_hits],
                     source="cached")
+                self.record_pattern_replay(len(live_hits))
         return ScanResult(verdicts=total, rules=rules)
+
+    def record_pattern_replay(self, n_cols: int) -> None:
+        """Pattern-cell accounting for cache-served verdict columns —
+        the replay convention every cached path follows for rule stats
+        applies to the pattern split too, so warm rescans report the
+        same pattern work as the cold scan that populated the cache.
+        Cached columns count as path=device (the stored verdict was
+        device-derived; any confirmation happened at populate time)."""
+        if not n_cols:
+            return
+        for ri, entry in enumerate(self.cps.rules):
+            if entry.device_row is None or ri in self._exception_rules:
+                if entry.pattern_host:
+                    global_pattern_cells.record(entry.policy_name,
+                                                host=n_cols)
+                continue
+            if getattr(self.cps.device_programs[entry.device_row],
+                       "uses_patterns", False):
+                global_pattern_cells.record(entry.policy_name,
+                                            device=n_cols)
 
     def _scan_uncached(
         self,
@@ -997,20 +1024,45 @@ class TpuEngine:
                         contains_wildcard(g) for g in (info.groups or [])):
                     glob_identity_cis.append(ci)
 
-        # which (policy, resource) pairs need the scalar engine?
+        # which (policy, resource) pairs need the scalar engine? HOST
+        # and CONFIRM cells both resolve there — CONFIRM is the
+        # pattern-confirmation sub-batch (over-approximate DFA hits,
+        # byte-sensitive patterns on non-ASCII subjects), attributed
+        # separately in the pattern-cell accounting below
         host_cells: Dict[Tuple[int, int], None] = {}
+        live = n if live_n is None else min(live_n, n)
         for ri, entry in enumerate(self.cps.rules):
             if entry.device_row is None or ri in self._exception_rules:
                 for ci in range(n):
                     host_cells[(entry.policy_idx, ci)] = None
+                if entry.pattern_host and live:
+                    # non-lowerable pattern kept this rule on the host
+                    global_pattern_cells.record(entry.policy_name,
+                                                host=live)
             else:
                 row = device_table[entry.device_row].copy()
                 if glob_identity_cis and self.cps.device_programs[
                         entry.device_row].uses_userinfo:
                     row[glob_identity_cis] = HOST
                 total[ri] = row
-                for ci in np.nonzero(row == HOST)[0]:
+                for ci in np.nonzero(row >= HOST)[0]:
                     host_cells[(entry.policy_idx, int(ci))] = None
+                if live and getattr(
+                        self.cps.device_programs[entry.device_row],
+                        "uses_patterns", False):
+                    # path attribution for a LOWERED pattern rule:
+                    # device = the DFA verdict stood, confirm = the
+                    # oracle confirmed a maybe. Its HOST cells are NOT
+                    # pattern-caused (encode caps, userinfo globs, CEL
+                    # DELETE diversion) and stay out of the split —
+                    # path="host" means exactly the non-lowerable
+                    # pattern rules counted in the branch above.
+                    rowv = row[:live]
+                    c = int((rowv == CONFIRM).sum())
+                    h = int((rowv == HOST).sum())
+                    global_pattern_cells.record(entry.policy_name,
+                                                device=live - c - h,
+                                                confirm=c)
 
         from ..engine.match import matches_resource_description
 
@@ -1063,7 +1115,7 @@ class TpuEngine:
             host_rule = (entry.device_row is None
                          or ri in self._exception_rules)
             for ci, verdicts in cells:
-                if host_rule or total[ri, ci] == HOST:
+                if host_rule or total[ri, ci] >= HOST:
                     # pre-screened cells carry no verdict rows: the
                     # whole policy was unmatched (HOST must not escape)
                     total[ri, ci] = ERROR if verdicts is None \

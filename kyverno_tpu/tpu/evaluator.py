@@ -21,7 +21,10 @@ Design choices (tpu-first):
   anchor/handlers.go) exactly, including phase-1/phase-2 ordering.
 
 Verdict codes: 0 PASS, 1 SKIP, 2 FAIL, 3 NOT_MATCHED, 4 ERROR,
-5 HOST (resource exceeded encode caps -> host fallback).
+5 HOST (resource exceeded encode caps -> host fallback), 6 CONFIRM
+(a pattern evaluated through an over-approximating DFA — or over bytes
+whose codepoint semantics can differ — hit this cell: the device
+verdict is a maybe, the scalar oracle confirms it; see tpu/dfa.py).
 """
 
 from __future__ import annotations
@@ -48,11 +51,19 @@ from .hashing import (
     hash_str,
     split32,
 )
+from .dfa import DfaBank, bank_match, nonascii_mask
 from .ir import (
     AnchorChild,
     ArrayMapsNode,
     ArrayScalarNode,
     BoolLeaf,
+    CelAnd,
+    CelConst,
+    CelHas,
+    CelMatches,
+    CelNot,
+    CelOr,
+    CelStrCmp,
     CondIR,
     CondTreeIR,
     Cmp,
@@ -78,8 +89,8 @@ from .ir import (
 )
 from .metadata import MetaBatch, OP_CODES
 
-PASS, SKIP, FAIL, NOT_MATCHED, ERROR, HOST = 0, 1, 2, 3, 4, 5
-NUM_VERDICT_CLASSES = 6
+PASS, SKIP, FAIL, NOT_MATCHED, ERROR, HOST, CONFIRM = 0, 1, 2, 3, 4, 5, 6
+NUM_VERDICT_CLASSES = 7
 
 
 # ---------------------------------------------------------------------------
@@ -180,9 +191,12 @@ def densify(batch: Dict[str, jnp.ndarray], record: bool = False):
 
 
 class Ctx:
-    def __init__(self, batch: Dict[str, jnp.ndarray], max_instances: int):
+    def __init__(self, batch: Dict[str, jnp.ndarray], max_instances: int,
+                 dfa: Optional[DfaBank] = None):
         self.b = batch
         self.I = max_instances
+        self.dfa = dfa if (dfa is not None and dfa.trans is not None
+                           and len(dfa)) else None
         n, r = batch["norm_hi"].shape
         self.N, self.R = n, r
         self._row_masks: Dict[Tuple[int, str], jnp.ndarray] = {}
@@ -193,6 +207,9 @@ class Ctx:
         # per-rule host-fallback masks appended during trace (nested
         # instance-join overflow); eval_rule drains them
         self.host_acc: List[jnp.ndarray] = []
+        # per-rule oracle-confirmation masks (approximate-DFA hits,
+        # non-ASCII subjects under byte-sensitive patterns)
+        self.confirm_acc: List[jnp.ndarray] = []
 
     # -- row masks
 
@@ -248,31 +265,118 @@ class Ctx:
             self._oh2 = (oh & self._valid[:, :, None]).astype(jnp.float32)
         return self._oh2
 
-    # -- glob NFA over pool bytes; returns (N, K) accepts per pool slot
+    # -- pattern matching over byte lanes. With a compiled DFA bank
+    # (tpu/dfa.py) every pattern of a lane family is stepped through
+    # the packed tables in ONE shared lax.scan; without one (legacy
+    # callers, bank-capacity overflow) each glob falls back to the
+    # per-pattern bit-parallel NFA below.
+
+    _FAMILY_LANES = {
+        "pool": ("pool", "pool_len"),
+        "name": ("meta_name_bytes", "meta_name_len"),
+        "ns": ("meta_ns_bytes", "meta_ns_len"),
+        "user": ("meta_user_bytes", "meta_user_len"),
+        "labels_kb": ("meta_labels_kb", "meta_labels_kb_len"),
+        "labels_vb": ("meta_labels_vb", "meta_labels_vb_len"),
+    }
+
+    def _family_tensors(self, family: str):
+        byte_lane, len_lane = self._FAMILY_LANES[family]
+        return self.b[byte_lane], self.b[len_lane]
+
+    def _bank_lookup(self, kind: str, pattern: str, family: str):
+        """(accept plane, Dfa) for one bank pattern on one lane family
+        — the family's FULL accept tensor is computed once and cached,
+        so N patterns on a family cost one scan, not N. None when the
+        pattern is not in the bank (legacy NFA path)."""
+        bank = self.dfa
+        if bank is None:
+            return None
+        ids = bank.families.get(family)
+        pid = (bank.glob_ids if kind == "glob" else bank.re2_ids).get(pattern)
+        if pid is None or not ids or pid not in ids:
+            return None
+        key = ("\x00bank", family)
+        if key not in self._glob_cache:
+            byt, lens = self._family_tensors(family)
+            self._glob_cache[key] = bank_match(bank, ids, byt, lens)
+        return self._glob_cache[key][..., ids.index(pid)], bank.patterns[pid]
+
+    def _family_nonascii(self, family: str) -> jnp.ndarray:
+        key = ("\x00nonascii", family)
+        if key not in self._glob_cache:
+            byt, lens = self._family_tensors(family)
+            self._glob_cache[key] = nonascii_mask(byt, lens)
+        return self._glob_cache[key]
+
+    def _accept_confirm(self, kind: str, pattern: str, family: str):
+        """(accepts, confirm-needed | None) over a lane family. The
+        confirm plane marks positions whose device verdict is a maybe:
+        over-approximating tables on a HIT (miss stays definitive),
+        byte-sensitive patterns on non-ASCII subjects (either way)."""
+        got = self._bank_lookup(kind, pattern, family)
+        if got is None:
+            if kind == "re2":
+                return None, None  # no bank => caller routes to host
+            byt, lens = self._family_tensors(family)
+            acc = glob_match(pattern, byt, lens)
+            if "?" in pattern:
+                # legacy NFA consumes one BYTE per '?': confirm
+                # non-ASCII subjects exactly like the bank path
+                return acc, self._family_nonascii(family)
+            return acc, None
+        acc, dfa = got
+        conf = None
+        if not dfa.exact:
+            conf = acc
+        if dfa.confirm_nonascii:
+            na = self._family_nonascii(family)
+            conf = na if conf is None else (conf | na)
+        return acc, conf
+
+    def _accept_confirm_cached(self, kind: str, pattern: str, family: str):
+        """One (accepts, confirm) pair per (kind, pattern, family) —
+        the legacy NFA fallback traces a full scan per pattern, so the
+        pair MUST be computed once, not once per consumer."""
+        key = ("\x00ac", kind, pattern, family)
+        if key not in self._glob_cache:
+            self._glob_cache[key] = self._accept_confirm(
+                kind, pattern, family)
+        return self._glob_cache[key]
 
     def glob_pool(self, pattern: str) -> jnp.ndarray:
-        key = (pattern, "pool")
-        if key not in self._glob_cache:
-            self._glob_cache[key] = glob_match(pattern, self.b["pool"], self.b["pool_len"])
-        return self._glob_cache[key]
+        return self._accept_confirm_cached("glob", pattern, "pool")[0]
+
+    def _pool_confirm(self, kind: str, pattern: str):
+        return self._accept_confirm_cached(kind, pattern, "pool")[1]
 
     def glob_meta(self, pattern: str, which: str) -> jnp.ndarray:
-        """which: name | ns | user. Returns (N,) accepts."""
-        key = (pattern, which)
-        if key not in self._glob_cache:
-            self._glob_cache[key] = glob_match(
-                pattern, self.b[f"meta_{which}_bytes"], self.b[f"meta_{which}_len"]
-            )
-        return self._glob_cache[key]
+        """which: name | ns | user. Returns (N,) accepts; confirm-needy
+        cells accumulate into confirm_acc."""
+        acc, conf = self._accept_confirm_cached("glob", pattern, which)
+        if conf is not None:
+            self.confirm_acc.append(conf)
+        return acc
+
+    def _rows_from_pool(self, plane: jnp.ndarray,
+                        lane: str = "byte_slot") -> jnp.ndarray:
+        """Gather a (N, K) pool-slot plane to (N, R) rows via the
+        row->slot lane (False when the row has no slot)."""
+        slot = self.b[lane]
+        safe = jnp.clip(slot, 0, plane.shape[1] - 1)
+        got = jnp.take_along_axis(
+            plane, safe.reshape(self.N, -1), axis=1).reshape(slot.shape)
+        return got & (slot >= 0)
 
     def glob_rows(self, pattern: str, lane: str = "byte_slot") -> jnp.ndarray:
         """(N, R) glob accept per row via its byte-pool slot (False when
         the row has no slot)."""
-        acc = self.glob_pool(pattern)  # (N, K)
-        slot = self.b[lane]
-        safe = jnp.clip(slot, 0, acc.shape[1] - 1)
-        got = jnp.take_along_axis(acc, safe.reshape(self.N, -1), axis=1).reshape(slot.shape)
-        return got & (slot >= 0)
+        acc = self._rows_from_pool(self.glob_pool(pattern), lane)
+        conf = self._pool_confirm("glob", pattern)
+        if conf is not None:
+            self.confirm_acc.append(
+                self._rows_from_pool(conf, lane).any(axis=-1))
+        return acc
 
     def glob_key_rows(self, pattern: str) -> jnp.ndarray:
         """(N, R) glob accept of each row's map KEY bytes."""
@@ -1457,17 +1561,16 @@ def _eval_selector(ctx: Ctx, sel, kh_lane: str, vh_lane: str, n_lane: str) -> jn
 def _label_glob_pair_any(ctx: Ctx, n_lane: str, k_pat: str, v_pat: str) -> jnp.ndarray:
     """Any live label slot whose KEY bytes glob-match k_pat AND VALUE
     bytes glob-match v_pat (resource label byte lanes). Literal
-    patterns degrade to exact byte equality via the same NFA."""
-    kb = ctx.b["meta_labels_kb"]          # (N, L, KW) uint8
-    kb_len = ctx.b["meta_labels_kb_len"]  # (N, L)
-    vb = ctx.b["meta_labels_vb"]
-    vb_len = ctx.b["meta_labels_vb_len"]
+    patterns degrade to exact byte equality via the same tables."""
     n = ctx.b["meta_" + n_lane]
-    L = kb.shape[1]
+    k_acc, k_conf = ctx._accept_confirm_cached("glob", k_pat, "labels_kb")
+    v_acc, v_conf = ctx._accept_confirm_cached("glob", v_pat, "labels_vb")
+    L = k_acc.shape[1]
     live = jnp.arange(L, dtype=np.int32)[None, :] < n[:, None]
-    hit = (glob_match(k_pat, kb, kb_len)
-           & glob_match(v_pat, vb, vb_len) & live)
-    return hit.any(-1)
+    for conf in (k_conf, v_conf):
+        if conf is not None:
+            ctx.confirm_acc.append((conf & live).any(-1))
+    return (k_acc & v_acc & live).any(-1)
 
 
 def _hash_in_lanes(ctx: Ctx, lane: str, n_lane: str, values: List[str], tag: str) -> jnp.ndarray:
@@ -1697,8 +1800,92 @@ def _eval_foreach_deny(
     return cls, errored, host
 
 
+# ---------------------------------------------------------------------------
+# validate.cel (the matches() subset, ir.compile_cel_validation)
+
+
+def _eval_cel_node(ctx: Ctx, node: Any) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """CEL three-valued evaluation over (N,) lanes: (val, err) with the
+    invariant val is False wherever err — mirroring cel/interp.py
+    semantics for the lowered subset, including &&/|| error absorption
+    (_logic: a determined operand absorbs the other side's error)."""
+    shape = (ctx.N,)
+    if isinstance(node, CelConst):
+        return jnp.full(shape, node.value, dtype=bool), \
+            jnp.zeros(shape, dtype=bool)
+    if isinstance(node, CelNot):
+        v, e = _eval_cel_node(ctx, node.sub)
+        return ~v & ~e, e
+    if isinstance(node, CelAnd):
+        lv, le = _eval_cel_node(ctx, node.left)
+        rv, re_ = _eval_cel_node(ctx, node.right)
+        lfalse = ~lv & ~le
+        rfalse = ~rv & ~re_
+        return lv & rv, (le | re_) & ~lfalse & ~rfalse
+    if isinstance(node, CelOr):
+        lv, le = _eval_cel_node(ctx, node.left)
+        rv, re_ = _eval_cel_node(ctx, node.right)
+        val = lv | rv
+        return val, (le | re_) & ~val
+    if isinstance(node, CelStrCmp):
+        mask = ctx.rows_at(node.path)
+        exists = mask.any(axis=-1)
+        eq = (mask & ctx.type_is(T_STR)
+              & ctx.heq("repr", hash_str(node.value, tag="s"))).any(axis=-1)
+        # select on a missing path is no_such_field; heterogeneous
+        # equality on a present non-string is false, never an error
+        err = ~exists
+        val = (exists & ~eq) if node.negate else eq
+        return val & ~err, err
+    if isinstance(node, CelHas):
+        prows = ctx.rows_at(node.parent)
+        is_map = (prows & ctx.type_is(T_MAP)).any(axis=-1)
+        child = ctx.rows_at(node.parent + (node.fld,)).any(axis=-1)
+        # has() on a missing/non-map target is a CEL error
+        return is_map & child, ~is_map
+    if isinstance(node, CelMatches):
+        mask = ctx.rows_at(node.path)
+        str_rows = mask & ctx.type_is(T_STR)
+        is_str = str_rows.any(axis=-1)
+        got = ctx._bank_lookup("re2", node.regex, "pool")
+        if got is None:
+            # compiled without a bank (legacy build_program callers):
+            # the whole cell resolves on the host
+            ctx.host_acc.append(jnp.ones(shape, dtype=bool))
+            return jnp.zeros(shape, dtype=bool), jnp.zeros(shape, dtype=bool)
+        acc, conf = ctx._accept_confirm_cached("re2", node.regex, "pool")
+        hit = (str_rows & ctx._rows_from_pool(acc)).any(axis=-1)
+        if conf is not None:
+            ctx.confirm_acc.append(
+                (str_rows & ctx._rows_from_pool(conf)).any(axis=-1))
+        # matches() on a non-string / missing target is a CEL error
+        err = ~is_str
+        return hit & ~err, err
+    raise Unsupported(f"cel IR node {type(node).__name__}")
+
+
+def _eval_cel_rule(ctx: Ctx, prog: RuleProgram
+                   ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """All expressions must hold; any expression error is a rule ERROR
+    (engine._validate_cel orders errors before fails). DELETE
+    admissions divert per cell to the host — the skip-on-delete guard
+    depends on request state the lanes don't carry."""
+    from .metadata import OP_CODES as _OPS
+
+    ok = jnp.ones((ctx.N,), dtype=bool)
+    err = jnp.zeros((ctx.N,), dtype=bool)
+    for node in prog.cel:
+        v, e = _eval_cel_node(ctx, node)
+        ok = ok & v
+        err = err | e
+    ctx.host_acc.append(
+        ctx.b["meta_op_code"] == np.int32(_OPS["DELETE"]))
+    return jnp.where(ok, PASS, FAIL), err
+
+
 def eval_rule(ctx: Ctx, prog: RuleProgram) -> jnp.ndarray:
     ctx.host_acc = []
+    ctx.confirm_acc = []
     matched = eval_match(ctx, prog.match, prog.exclude, prog.policy_namespace)
     pre_ok, pre_err = eval_cond_tree(ctx, prog.preconditions)
     host_extra = jnp.zeros((ctx.N,), dtype=bool)
@@ -1711,6 +1898,8 @@ def eval_rule(ctx: Ctx, prog: RuleProgram) -> jnp.ndarray:
         err = jnp.zeros((ctx.N,), dtype=bool)
     elif prog.kind == "foreach_deny":
         cls, err, host_extra = _eval_foreach_deny(ctx, prog)
+    elif prog.kind == "cel":
+        cls, err = _eval_cel_rule(ctx, prog)
     else:  # any_pattern (validate_resource.go:382)
         classes = [eval_node(ctx, Depth0(), p) for p in prog.patterns]
         any_pass = functools.reduce(jnp.logical_or, [c == PASS for c in classes])
@@ -1721,6 +1910,12 @@ def eval_rule(ctx: Ctx, prog: RuleProgram) -> jnp.ndarray:
     verdict = jnp.where(err, ERROR, cls)
     verdict = jnp.where(pre_err, ERROR, jnp.where(pre_ok, verdict, SKIP))
     verdict = jnp.where(matched, verdict, NOT_MATCHED)
+    # pattern confirmation (tpu/dfa.py ladder): cells whose pattern
+    # verdict is a maybe resolve via the scalar oracle like host cells,
+    # but are attributed separately (miss = definitive, hit = confirm)
+    if ctx.confirm_acc:
+        confirm = functools.reduce(jnp.logical_or, ctx.confirm_acc)
+        verdict = jnp.where(confirm, CONFIRM, verdict)
     fallback = (ctx.b["fallback"] == 1) | (ctx.b["meta_fallback"] == 1)
     fallback = fallback | host_extra | _glob_fallback(ctx, prog)
     for h in ctx.host_acc:
@@ -1729,7 +1924,8 @@ def eval_rule(ctx: Ctx, prog: RuleProgram) -> jnp.ndarray:
 
 
 def build_program(programs: Sequence[RuleProgram], max_instances: int,
-                  with_counts: bool = False) -> Callable:
+                  with_counts: bool = False,
+                  dfa: Optional[DfaBank] = None) -> Callable:
     """Returns a jittable fn(batch dict) -> (num_rules, N) int32, or —
     with ``with_counts`` — (table, (num_rules, NUM_VERDICT_CLASSES)
     int32): the per-rule verdict reduction folded into the compiled
@@ -1739,7 +1935,7 @@ def build_program(programs: Sequence[RuleProgram], max_instances: int,
     noise next to rule evaluation itself)."""
 
     def run(batch: Dict[str, jnp.ndarray]):
-        ctx = Ctx(densify(batch), max_instances)
+        ctx = Ctx(densify(batch), max_instances, dfa=dfa)
         outs = [eval_rule(ctx, p) for p in programs]
         if not outs:
             table = jnp.zeros((0, ctx.N), dtype=jnp.int32)

@@ -70,6 +70,34 @@ class EncodeConfig:
         self.byte_pool_width = byte_pool_width
 
 
+def plan_byte_pool(cfg: EncodeConfig, byte_paths, key_byte_paths) -> EncodeConfig:
+    """Byte-lane capacity planning for pattern-referenced paths.
+
+    Every value at a pattern-referenced path occupies one pool slot per
+    resource (array broadcast and wildcard-key maps can occupy
+    several), so a pattern-heavy policy set can exhaust the default
+    slot count and silently demote whole resources to host fallback.
+    Grow the pool (on a COPY — callers may share the config across
+    compiles) to 2x the referenced-path count, power-of-two, capped at
+    256: overflow beyond the plan still flags ``fallback`` — degraded
+    to host completion, never wrong."""
+    n_paths = len(set(byte_paths)) + len(set(key_byte_paths))
+    if n_paths == 0:
+        return cfg
+    need = min(max(2 * n_paths, cfg.byte_pool_slots), 256)
+    slots = max(cfg.byte_pool_slots, 1)
+    while slots < need:
+        slots *= 2
+    slots = min(slots, 256)
+    if slots <= cfg.byte_pool_slots:
+        return cfg
+    import copy as _copy
+
+    cfg = _copy.copy(cfg)
+    cfg.byte_pool_slots = slots
+    return cfg
+
+
 _LANES_U32 = (
     "norm_hi", "norm_lo", "parent_hi", "parent_lo", "key_hi", "key_lo",
     "repr_hi", "repr_lo", "qty_hi", "qty_lo", "dur_hi", "dur_lo",

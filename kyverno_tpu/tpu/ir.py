@@ -1096,6 +1096,184 @@ def compile_foreach_list(ast: Tuple) -> List[Tuple[str, ...]]:
 
 
 # ---------------------------------------------------------------------------
+# CEL validate.cel IR (the tractable matches() subset)
+#
+# CEL rules historically had NO device lowering (the whole rule routed
+# to the scalar engine). The subset below — boolean combinations of
+# `object.<chain>.matches('literal')`, string ==/!=, has() guards and
+# bool literals — covers the pattern-bearing VAP/cel shapes that cap
+# device_coverage, and lowers onto the DFA bank (tpu/dfa.py) plus the
+# existing row lanes. Everything else keeps today's host route.
+
+
+@dataclass
+class CelMatches:
+    """object.<path>.matches('<re2 literal>') — DFA over the value's
+    byte-pool lane; non-string/missing targets are CEL errors."""
+
+    path: Tuple[str, ...]
+    regex: str
+
+
+@dataclass
+class CelStrCmp:
+    """object.<path> ==/!= '<literal>' — heterogeneous equality is
+    false (never an error); select errors on missing paths."""
+
+    path: Tuple[str, ...]
+    value: str
+    negate: bool
+
+
+@dataclass
+class CelHas:
+    """has(object.<parent>.<field>): parent must be a map (else CEL
+    error), truth is key presence."""
+
+    parent: Tuple[str, ...]
+    fld: str
+
+
+@dataclass
+class CelNot:
+    sub: Any
+
+
+@dataclass
+class CelAnd:
+    left: Any
+    right: Any
+
+
+@dataclass
+class CelOr:
+    left: Any
+    right: Any
+
+
+@dataclass
+class CelConst:
+    value: bool
+
+
+def _cel_chain(ast: Any) -> Tuple[str, ...]:
+    segs: List[str] = []
+    while isinstance(ast, tuple) and ast[0] == "select":
+        segs.append(str(ast[2]))
+        ast = ast[1]
+    if ast != ("ident", "object"):
+        raise Unsupported("cel expression not rooted at object")
+    return tuple(reversed(segs))
+
+
+def _lower_cel_ast(ast: Any) -> Any:
+    tag = ast[0]
+    if tag == "lit":
+        if isinstance(ast[1], bool):
+            return CelConst(ast[1])
+        raise Unsupported("cel non-boolean literal expression")
+    if tag == "not":
+        return CelNot(_lower_cel_ast(ast[1]))
+    if tag == "and":
+        return CelAnd(_lower_cel_ast(ast[1]), _lower_cel_ast(ast[2]))
+    if tag == "or":
+        return CelOr(_lower_cel_ast(ast[1]), _lower_cel_ast(ast[2]))
+    if tag == "method" and ast[2] == "matches" and len(ast[3]) == 1:
+        return _lower_cel_matches(ast[1], ast[3][0])
+    if tag == "call" and ast[1] == "matches" and len(ast[2]) == 2:
+        return _lower_cel_matches(ast[2][0], ast[2][1])
+    if tag == "binop" and ast[1] in ("==", "!="):
+        lhs, rhs = ast[2], ast[3]
+        if isinstance(rhs, tuple) and rhs[0] == "lit":
+            chain, lit = lhs, rhs
+        elif isinstance(lhs, tuple) and lhs[0] == "lit":
+            chain, lit = rhs, lhs
+        else:
+            raise Unsupported("cel comparison without a literal side")
+        if not isinstance(lit[1], str):
+            raise Unsupported("cel non-string comparison literal")
+        return CelStrCmp(_cel_chain(chain), lit[1], ast[1] == "!=")
+    if tag == "has":
+        return CelHas(_cel_chain(ast[1]), str(ast[2]))
+    raise Unsupported(f"cel construct {tag}")
+
+
+def _lower_cel_matches(target: Any, arg: Any) -> "CelMatches":
+    if not (isinstance(arg, tuple) and arg[0] == "lit"
+            and isinstance(arg[1], str)):
+        raise Unsupported("cel matches() with non-literal pattern")
+    path = _cel_chain(target)
+    from .dfa import DfaUnsupported, compile_re2
+
+    try:
+        compile_re2(arg[1])
+    except DfaUnsupported as e:
+        # genuinely non-lowerable pattern — today's host-cell route;
+        # the "pattern:" tag attributes these host cells to the
+        # pattern class in coverage accounting
+        raise Unsupported(f"pattern: {e}")
+    except Exception as e:  # Re2Error etc: host compile will error too
+        raise Unsupported(f"pattern: regex {e}")
+    return CelMatches(path, arg[1])
+
+
+def _walk_cel_ir(node: Any, paths: Set[Tuple[str, ...]],
+                 regexes: List[str]) -> None:
+    if isinstance(node, CelMatches):
+        paths.add(node.path)
+        regexes.append(node.regex)
+    elif isinstance(node, CelNot):
+        _walk_cel_ir(node.sub, paths, regexes)
+    elif isinstance(node, (CelAnd, CelOr)):
+        _walk_cel_ir(node.left, paths, regexes)
+        _walk_cel_ir(node.right, paths, regexes)
+
+
+def compile_cel_validation(rule: Rule, prog: "RuleProgram") -> None:
+    """Lower validate.cel onto ``prog`` (kind='cel') or raise
+    Unsupported. Mirrors engine._validate_cel semantics for the
+    lowered shape: every expression must hold (first error -> rule
+    ERROR, else any false -> FAIL); DELETE admissions divert per cell
+    to the host (the skip-on-delete guard)."""
+    from ..cel import compile as cel_compile
+    from ..cel.parser import parse as cel_parse
+
+    if rule.cel_preconditions:
+        raise Unsupported("celPreconditions (matchConditions)")
+    spec = rule.validation.cel or {}
+    extra = {k for k, v in spec.items()
+             if v not in (None, [], {}) and k != "expressions"}
+    if extra:
+        # variables / auditAnnotations / paramKind change evaluation or
+        # response content in ways the lowering does not model
+        raise Unsupported(f"cel spec keys {sorted(extra)}")
+    exprs = spec.get("expressions") or []
+    if not exprs:
+        raise Unsupported("cel without expressions")
+    prog.kind = "cel"
+    for e in exprs:
+        if not isinstance(e, dict):
+            raise Unsupported("malformed cel expression entry")
+        bad = set(e) - {"expression", "message"}
+        if bad:
+            # messageExpression computes per-resource messages on host
+            raise Unsupported(f"cel expression keys {sorted(bad)}")
+        text = e.get("expression") or ""
+        try:
+            cel_compile(text)  # host compile failure => rule-level error
+        except Exception as ex:  # noqa: BLE001
+            raise Unsupported(f"cel compile: {ex}")
+        prog.cel.append(_lower_cel_ast(cel_parse(text)))
+    paths: Set[Tuple[str, ...]] = set()
+    regexes: List[str] = []
+    for node in prog.cel:
+        _walk_cel_ir(node, paths, regexes)
+    for pth in paths:
+        prog.byte_paths.add(hash_path(pth))
+    prog.regex_patterns = regexes
+
+
+# ---------------------------------------------------------------------------
 # match / exclude IR
 
 
@@ -1268,10 +1446,17 @@ class RuleProgram:
     match: Optional[MatchIR]
     exclude: Optional[MatchIR]
     preconditions: Optional[CondTreeIR]
-    kind: str  # pattern | any_pattern | deny | foreach_deny
+    kind: str  # pattern | any_pattern | deny | foreach_deny | cel
     patterns: List[Node] = field(default_factory=list)
     deny: Optional[CondTreeIR] = None
     foreach: List[ForeachDeny] = field(default_factory=list)
+    # validate.cel lowering: per-expression IR trees (the matches()
+    # subset) + the re2 patterns they reference (DFA-bank input)
+    cel: List[Any] = field(default_factory=list)
+    regex_patterns: List[str] = field(default_factory=list)
+    # set by the policy-set compiler when this program evaluates any
+    # glob/regex through the DFA bank (pattern-cell accounting)
+    uses_patterns: bool = False
     byte_paths: Set[int] = field(default_factory=set)
     key_byte_paths: Set[int] = field(default_factory=set)
     message: str = ""
@@ -1736,5 +1921,11 @@ def _compile_rule_body(policy: ClusterPolicy, rule: Rule,
                 raise Unsupported("foreach deny without conditions")
             prog.foreach.append(ForeachDeny(arrays, tree,
                                             strict_maps=scope_flag is True))
+        return prog
+    # scalar dispatch order (engine._validate_rule): podSecurity comes
+    # before cel — a rule carrying both must keep the scalar handler
+    if v.cel is not None and v.pod_security is None \
+            and v.manifests is None:
+        compile_cel_validation(rule, prog)
         return prog
     raise Unsupported("podSecurity/cel/manifest rule")

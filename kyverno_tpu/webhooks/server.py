@@ -198,6 +198,7 @@ class Handlers:
 
             global_rule_stats.ingest_column(eng.rule_idents(), col,
                                             source="cached")
+            eng.record_pattern_replay(1)
         except Exception:
             pass
         return VerdictRows(
@@ -474,6 +475,8 @@ class Handlers:
                 "xla_cache_dir": xla_cache_dir(),
             },
             "policyset": self.lifecycle.state(),
+            "patterns": _pattern_state(
+                active.engine.cps if active is not None else None),
             "encode_pool": _encode_pool_state(),
             "faults_armed": {
                 site: {"mode": spec.mode, "calls": spec.calls,
@@ -864,6 +867,33 @@ def _encode_pool_state():
         return {"enabled": False}
 
 
+def _active_cps(handlers):
+    try:
+        active = handlers.lifecycle.active
+        return active.engine.cps if active is not None else None
+    except Exception:
+        return None
+
+
+def _pattern_state(cps=None):
+    """Device-side string matching introspection: the compiled DFA
+    bank's shape plus the pattern-cell path accounting (device /
+    confirm / host) — the /debug/state and /debug/utilization
+    ``patterns`` block."""
+    try:
+        from ..observability.analytics import global_pattern_cells
+
+        out = global_pattern_cells.state()
+    except Exception:
+        out = {}
+    if cps is not None and getattr(cps, "dfa", None) is not None:
+        try:
+            out["bank"] = cps.dfa.stats()
+        except Exception:
+            pass
+    return out
+
+
 def handle_debug_path(path: str, handlers: Optional[Handlers] = None
                       ) -> Tuple[int, bytes, str]:
     """One debug router shared by the admission server and the serve
@@ -922,6 +952,7 @@ def handle_debug_path(path: str, handlers: Optional[Handlers] = None
                 in _reg.serving_flusher_seconds.series()},
             "perf_caches": {"verdict_hit_rate": global_verdict_cache.hit_rate(),
                             "encode_hit_rate": global_encode_cache.hit_rate()},
+            "patterns": _pattern_state(_active_cps(handlers)),
             "encode_pool": _encode_pool_state(),
             "slo": global_slo.state(),
             "phase_breakdown": global_profiler.breakdown(),

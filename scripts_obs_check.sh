@@ -11,12 +11,12 @@ set -o pipefail
 cd "$(dirname "$0")"
 rc=0
 
-echo "=== leg 1/3: tier-1 (faults disarmed) ==="
+echo "=== leg 1/4: tier-1 (faults disarmed) ==="
 KYVERNO_TPU_FAULTS= JAX_PLATFORMS=cpu timeout -k 10 870 \
   python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors \
   -p no:cacheprovider -p no:xdist -p no:randomly || rc=1
 
-echo "=== leg 2/3: /metrics + /debug/* smoke under load, tpu.dispatch armed ==="
+echo "=== leg 2/4: /metrics + /debug/* smoke under load, tpu.dispatch armed ==="
 KYVERNO_TPU_FAULTS="tpu.dispatch:raise:p=1.0" JAX_PLATFORMS=cpu \
   timeout -k 10 300 python - <<'EOF' || rc=1
 import http.client
@@ -152,7 +152,7 @@ finally:
     cp.stop()
 EOF
 
-echo "=== leg 3/3: policy observatory (rule analytics + starvation + SLO) ==="
+echo "=== leg 3/4: policy observatory (rule analytics + starvation + SLO) ==="
 KYVERNO_TPU_FAULTS= JAX_PLATFORMS=cpu timeout -k 10 300 python - <<'EOF' || rc=1
 import http.client
 import json
@@ -257,6 +257,99 @@ try:
     print(f"OBSERVATORY OK: starvation={ratio}, "
           f"hot={len(doc['top'])}, never_fired={len(doc['never_fired'])}, "
           f"slo_breached={util['slo']['breached']}")
+finally:
+    cp.stop()
+EOF
+
+echo "=== leg 4/4: device-side string matching (pattern metrics + /scan device cells) ==="
+KYVERNO_TPU_FAULTS= JAX_PLATFORMS=cpu timeout -k 10 300 python - <<'EOF' || rc=1
+import http.client
+import json
+import re
+
+from kyverno_tpu.api.policy import ClusterPolicy
+from kyverno_tpu.cli.serve import ControlPlane
+
+# a pattern-heavy set: glob operands + a matches() CEL expression —
+# BOTH must evaluate on the device path (pattern_cells path="device")
+POLICIES = [ClusterPolicy.from_dict({
+    "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+    "metadata": {"name": "pattern-globs"},
+    "spec": {"validationFailureAction": "Audit", "rules": [{
+        "name": "image-glob",
+        "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+        "validate": {"message": "m", "pattern": {"spec": {"containers": [
+            {"image": "nginx-* | redis-?*"}]}}},
+    }]}}), ClusterPolicy.from_dict({
+    "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+    "metadata": {"name": "pattern-matches"},
+    "spec": {"validationFailureAction": "Audit", "rules": [{
+        "name": "re2-name",
+        "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+        "validate": {"cel": {"expressions": [
+            {"expression": "object.metadata.name.matches('^[a-z][a-z0-9-]*$')"}]}},
+    }]}})]
+
+METRIC_LINE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? [0-9.eE+-]+|NaN"
+    r"( # \{[^{}]*\} [0-9.eE+-]+( [0-9.eE+-]+)?)?$")
+
+
+def get(port, path):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    conn.request("GET", path)
+    resp = conn.getresponse()
+    body = resp.read()
+    conn.close()
+    return resp.status, body
+
+
+def post(port, path, body):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    conn.request("POST", path, body, {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    out = resp.read()
+    conn.close()
+    return resp.status, out
+
+
+cp = ControlPlane(POLICIES, port=0, metrics_port=0, batching=True)
+cp.start(scan_interval=3600.0)
+met = cp.metrics_server.server_address[1]
+try:
+    for i in range(6):
+        pod = {"apiVersion": "v1", "kind": "Pod",
+               "metadata": {"name": f"pat-{i}", "namespace": "d",
+                            "uid": f"u{i}"},
+               "spec": {"containers": [
+                   {"name": "c", "image": f"nginx-{i}"}]}}
+        assert post(met, "/snapshot/upsert", json.dumps(pod))[0] == 200
+    assert post(met, "/scan", json.dumps({"full": True}))[0] == 200
+
+    text = get(met, "/metrics")[1].decode()
+    for line in text.splitlines():
+        if line.startswith("#") or not line:
+            continue
+        assert METRIC_LINE.match(line), f"unparseable: {line!r}"
+    for fam in ("kyverno_tpu_pattern_cells_total",
+                "kyverno_tpu_dfa_tables", "kyverno_tpu_dfa_states",
+                "kyverno_tpu_dfa_table_bytes"):
+        assert fam in text, f"{fam} missing from /metrics"
+    dev = [l for l in text.splitlines()
+           if l.startswith('kyverno_tpu_pattern_cells_total{path="device"}')]
+    assert dev, "no device-path pattern cells after a pattern-heavy /scan"
+    assert float(dev[0].rsplit(" ", 1)[1]) > 0, dev
+
+    state = json.loads(get(met, "/debug/state")[1])
+    pat = state["patterns"]
+    assert pat["totals"]["device"] > 0, pat
+    assert pat["bank"]["tables"] >= 2, pat
+    util = json.loads(get(met, "/debug/utilization")[1])
+    assert "patterns" in util
+    rules = json.loads(get(met, "/debug/rules")[1])
+    with_cells = [p for p in rules["policies"] if "pattern_cells" in p]
+    assert with_cells, rules["policies"]
+    print(f"PATTERNS OK: cells={pat['totals']}, bank={pat['bank']}")
 finally:
     cp.stop()
 EOF

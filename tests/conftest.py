@@ -39,13 +39,15 @@ def _fresh_perf_caches():
 # test starts from an empty accumulator and fresh SLO/starvation state
 @pytest.fixture(autouse=True)
 def _fresh_observatory():
-    from kyverno_tpu.observability.analytics import (global_rule_stats,
+    from kyverno_tpu.observability.analytics import (global_pattern_cells,
+                                                     global_rule_stats,
                                                      global_slo,
                                                      global_starvation)
 
     global_rule_stats.reset()
     global_starvation.reset()
     global_slo.reset()
+    global_pattern_cells.reset()
     yield
 
 

@@ -1,0 +1,239 @@
+"""tpu/dfa.py: pattern -> DFA table compiler.
+
+Unit coverage plus the seeded fuzz-parity harness between the host
+oracles (utils/wildcard.match, cel/re2.search) and the compiled
+tables: globs over anchors-free byte matching, re2 over anchors /
+classes / alternation / quantifiers, unicode-in-class edge cases, and
+the over-approximation ladder's miss-is-definitive invariant."""
+
+import random
+
+import numpy as np
+import pytest
+
+from kyverno_tpu.cel.re2 import Re2Error, search as re2_search
+from kyverno_tpu.tpu.dfa import (
+    DfaBank,
+    DfaUnsupported,
+    bank_match,
+    compile_glob,
+    compile_re2,
+    nonascii_mask,
+)
+from kyverno_tpu.utils.wildcard import match as glob_oracle
+
+# ---------------------------------------------------------------------------
+# glob tables
+
+
+GLOB_CASES = [
+    "", "*", "?", "a", "ab", "a*", "*a", "*a*", "a*b", "a?b", "??",
+    "nginx-*", "*-suffix", "a*b*c", "**a**", "?*", "*?", "a**?b",
+    "registry.corp/*", "v?-*",
+]
+GLOB_SUBJECTS = [
+    "", "a", "b", "ab", "ba", "abc", "aXb", "axxb", "nginx-1.25",
+    "nginx", "x-suffix", "-suffix", "abbc", "registry.corp/img:v3",
+    "v1-rc", "aa", "aab",
+]
+
+
+def test_glob_dfa_matches_wildcard_oracle():
+    for pat in GLOB_CASES:
+        d = compile_glob(pat)
+        assert d.exact
+        for s in GLOB_SUBJECTS:
+            assert d.match_str(s) == glob_oracle(pat, s), (pat, s)
+
+
+def test_glob_fuzz_parity_seeded():
+    rng = random.Random(1234)
+    alphabet = "ab*?"
+    for _ in range(400):
+        pat = "".join(rng.choice(alphabet) for _ in range(rng.randint(0, 8)))
+        d = compile_glob(pat)
+        for _ in range(20):
+            s = "".join(rng.choice("ab")
+                        for _ in range(rng.randint(0, 10)))
+            assert d.match_str(s) == glob_oracle(pat, s), (pat, s)
+
+
+def test_glob_star_only_is_byte_exact_for_unicode():
+    """'*'-only ASCII-literal globs match byte-for-byte what the char
+    oracle matches — even on multi-byte subjects (literal byte
+    sequences equal literal char sequences)."""
+    for pat in ("名前-*", "*é*", "a*b"):
+        d = compile_glob(pat)
+        assert not d.confirm_nonascii
+        for s in ("名前-x", "café", "aéb", "ab", "名前"):
+            assert d.match_str(s) == glob_oracle(pat, s), (pat, s)
+
+
+def test_glob_question_mark_flags_nonascii_confirm():
+    """'?' consumes one CHAR in the oracle but one BYTE in the table —
+    the pattern must carry confirm_nonascii so multi-byte subjects
+    route to oracle confirmation instead of trusting the table."""
+    d = compile_glob("a?c")
+    assert d.confirm_nonascii
+    # the divergence the flag guards against:
+    assert glob_oracle("a?c", "aéc") is True
+    assert d.match_str("aéc") is False  # é is two bytes
+
+
+# ---------------------------------------------------------------------------
+# re2 tables
+
+
+RE2_CASES = [
+    "abc", "^abc$", "a.c", "a*", "^a+b?$", "[abc]+", "[^abc]",
+    "(ab|cd)+", "^foo-[0-9]+$", "colou?r", "(?i)nginx", "a{2,3}b",
+    "^$", ".*", "[a-z]+[0-9]*$", r"\d+", r"[\w-]+", "x|y|z",
+    "^(tmp|scratch)-", "[[:alpha:]]+$", r"a\.b", "(?i)[a-f]{2}",
+]
+RE2_SUBJECTS = [
+    "", "abc", "xabcx", "ac", "axc", "aaa", "b", "abcd", "cdab",
+    "foo-12", "foo-", "color", "colour", "NGINX", "nGiNx", "aab",
+    "aaab", "ab", "z9", "Z9", "tmp-1", "a.b", "aXb", "Fe", "0xfe",
+    "under_score", "dash-ed",
+]
+
+
+def test_re2_dfa_matches_host_engine():
+    for pat in RE2_CASES:
+        d = compile_re2(pat)
+        assert d.exact, pat
+        assert d.confirm_nonascii  # every regex is byte-sensitive
+        for s in RE2_SUBJECTS:
+            assert d.match_str(s) == re2_search(pat, s), (pat, s)
+
+
+def _random_re2(rng: random.Random, depth: int = 0) -> str:
+    atoms = ["a", "b", "c", "0", "1", ".", "[abc]", "[^ab]", "[a-c0-1]",
+             r"\d", r"\w", r"\."]
+    if depth < 2 and rng.random() < 0.4:
+        inner = _random_re2(rng, depth + 1)
+        atom = f"({inner})" if inner else rng.choice(atoms)
+    else:
+        atom = rng.choice(atoms)
+    if rng.random() < 0.4:
+        atom += rng.choice(["*", "+", "?", "{1,3}", "{2}"])
+    if depth < 2 and rng.random() < 0.3:
+        atom = atom + _random_re2(rng, depth + 1)
+    if depth < 2 and rng.random() < 0.2:
+        atom = f"{atom}|{_random_re2(rng, depth + 1) or 'b'}"
+    return atom
+
+
+def test_re2_fuzz_parity_seeded():
+    """The satellite harness: seeded generator over classes,
+    alternation, quantifiers and anchors vs the host NFA engine."""
+    rng = random.Random(77)
+    tested = 0
+    for _ in range(250):
+        body = _random_re2(rng)
+        if not body:
+            continue
+        pat = body
+        if rng.random() < 0.3:
+            pat = "^" + pat
+        if rng.random() < 0.3:
+            pat = pat + "$"
+        try:
+            d = compile_re2(pat)
+        except (Re2Error, DfaUnsupported):
+            continue
+        tested += 1
+        for _ in range(15):
+            s = "".join(rng.choice("abc01x.")
+                        for _ in range(rng.randint(0, 9)))
+            want = re2_search(pat, s)
+            if d.exact:
+                assert d.match_str(s) == want, (pat, s)
+            elif not d.match_str(s):
+                # over-approximation invariant: a miss is definitive
+                assert not want, (pat, s)
+    assert tested > 150
+
+
+def test_re2_unicode_class_edges():
+    """Unicode-in-class edge cases: the table is only trusted for
+    ASCII subjects (confirm_nonascii routes the rest), but ASCII
+    behavior must match the host engine exactly — including the
+    case-fold orbit fix in cel/re2.py (ſ folds into the s orbit)."""
+    assert re2_search("(?i)[a-z]", "ſ") is True  # the host-side fix
+    assert re2_search("(?i)[^a-z]", "ſ") is False
+    for pat in ("(?i)[a-z]+", "[^é]", "x[é-ÿ]?"):
+        d = compile_re2(pat)
+        for s in ("abc", "XYZ", "x", "", "q9"):
+            assert d.match_str(s) == re2_search(pat, s), (pat, s)
+
+
+def test_re2_word_boundary_unlowerable():
+    with pytest.raises(DfaUnsupported):
+        compile_re2(r"\bword\b")
+    with pytest.raises(DfaUnsupported):
+        compile_re2(r"(?m)^line$")
+
+
+def test_budget_overflow_over_approximates():
+    pat = "^(ab|cd){1,10}x[0-9]{3}$"
+    exact = compile_re2(pat)
+    approx = compile_re2(pat, budget=6)
+    assert exact.exact and not approx.exact
+    rng = random.Random(5)
+    for _ in range(300):
+        s = "".join(rng.choice("abcdx0129") for _ in range(rng.randint(0, 12)))
+        want = re2_search(pat, s)
+        assert exact.match_str(s) == want
+        if not approx.match_str(s):
+            assert not want, s  # miss stays definitive
+
+
+# ---------------------------------------------------------------------------
+# the packed bank + device kernel
+
+
+def _pack_strings(strs, width=32):
+    byt = np.zeros((len(strs), width), np.uint8)
+    lens = np.zeros((len(strs),), np.int32)
+    for i, s in enumerate(strs):
+        e = s.encode("utf-8")[:width]
+        byt[i, : len(e)] = np.frombuffer(e, np.uint8)
+        lens[i] = len(e)
+    return byt, lens
+
+
+def test_bank_kernel_matches_host_tables():
+    bank = DfaBank(budget=64)
+    for p in GLOB_CASES:
+        bank.add_glob(p, "pool")
+    for p in RE2_CASES:
+        bank.add_re2(p, "pool")
+    bank.finalize()
+    assert bank.stats()["tables"] == len(bank)
+    byt, lens = _pack_strings(GLOB_SUBJECTS + RE2_SUBJECTS)
+    ids = bank.families["pool"]
+    acc = np.asarray(bank_match(bank, ids, byt, lens))
+    for k, pid in enumerate(ids):
+        d = bank.patterns[pid]
+        for i, s in enumerate(GLOB_SUBJECTS + RE2_SUBJECTS):
+            assert bool(acc[i, k]) == d.match_bytes(s.encode()[:32]), \
+                (d.pattern, s)
+
+
+def test_bank_dedup_families_and_digest():
+    b1 = DfaBank(budget=64)
+    assert b1.add_glob("a*", "pool") == b1.add_glob("a*", "name")
+    assert len(b1) == 1
+    assert b1.families == {"pool": [0], "name": [0]}
+    b1.finalize()
+    b2 = DfaBank(budget=8)
+    b2.add_glob("a*", "pool")
+    b2.finalize()
+    assert b1.digest() != b2.digest()  # budget is cache-key material
+
+
+def test_nonascii_mask():
+    byt, lens = _pack_strings(["ascii", "café", "", "名前"])
+    na = np.asarray(nonascii_mask(byt, lens))
+    assert na.tolist() == [False, True, False, True]

@@ -1,0 +1,270 @@
+"""Device-side string matching e2e: pattern-heavy policy sets must
+evaluate with full device coverage and verdicts bit-identical to the
+scalar oracle across the device, confirm-ladder, breaker-OPEN, cached,
+and pipelined paths (ISSUE 8 acceptance)."""
+
+import numpy as np
+import pytest
+
+from kyverno_tpu.api.policy import ClusterPolicy
+from kyverno_tpu.engine.engine import Engine as ScalarEngine
+from kyverno_tpu.observability.analytics import global_pattern_cells
+from kyverno_tpu.tpu.engine import (
+    TpuEngine,
+    VERDICT_NAMES,
+    _scalar_rule_verdicts,
+    build_scan_context,
+)
+
+
+def make_policy(name, rules):
+    return ClusterPolicy.from_dict({
+        "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+        "metadata": {"name": name}, "spec": {"rules": rules}})
+
+
+POD_MATCH = {"any": [{"resources": {"kinds": ["Pod"]}}]}
+
+
+def pattern_policies():
+    return [
+        make_policy("glob-images", [{
+            "name": "registry", "match": POD_MATCH,
+            "validate": {"message": "m", "pattern": {"spec": {"containers": [
+                {"image": "nginx-* | redis-?*"}]}}},
+        }]),
+        make_policy("anchored", [{
+            "name": "pull", "match": POD_MATCH,
+            "validate": {"message": "m", "pattern": {"spec": {"containers": [
+                {"imagePullPolicy": "Always | IfNotPresent"}]}}},
+        }]),
+        make_policy("wild-labels", [{
+            "name": "tier", "match": POD_MATCH,
+            "validate": {"message": "m", "pattern": {"metadata": {"labels": {
+                "tier-*": "frontend | backend"}}}},
+        }]),
+        make_policy("vap-matches", [{
+            "name": "re2", "match": POD_MATCH,
+            "validate": {"cel": {"expressions": [
+                {"expression":
+                 "object.metadata.name.matches('^[a-z][a-z0-9-]*$')"},
+                {"expression":
+                 "!object.metadata.name.matches('^(tmp|scratch)-')"},
+            ]}},
+        }]),
+        make_policy("cel-combo", [{
+            "name": "combo", "match": POD_MATCH,
+            "validate": {"cel": {"expressions": [
+                {"expression": "has(object.spec.runtimeClassName) || "
+                               "object.metadata.name == 'legacy'"},
+            ]}},
+        }]),
+    ]
+
+
+def pattern_pods():
+    def pod(name, image="nginx-1", labels=None, pull="Always", **spec):
+        return {"apiVersion": "v1", "kind": "Pod",
+                "metadata": {"name": name, "namespace": "d",
+                             **({"labels": labels} if labels else {})},
+                "spec": {"containers": [{"name": "c", "image": image,
+                                         "imagePullPolicy": pull}], **spec}}
+
+    return [
+        pod("app-1", labels={"tier-0": "frontend"},
+            runtimeClassName="rc"),
+        pod("tmp-x", image="redis-7", labels={"tier-1": "edge"}),
+        pod("BadName", image="busybox", pull="Never"),
+        pod("legacy", image="nginx-edge"),
+        pod("app-2", labels={"app": "nolabel"}),
+        # adversarial CEL shapes: missing chains, non-string targets
+        {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "bare"},
+         "spec": {}},
+        {"apiVersion": "v1", "kind": "Pod",
+         "metadata": {"name": 42, "namespace": "d"}, "spec": {}},
+        # non-ASCII name under the re2 pattern -> confirm path
+        pod("café-1", labels={"tier-0": "backend"}),
+    ]
+
+
+def assert_parity(policies, resources, eng=None):
+    eng = eng or TpuEngine(policies)
+    out = eng.scan(resources)
+    sc = ScalarEngine()
+    for row, (pn, rn) in enumerate(out.rules):
+        pol = next(p for p in policies if p.name == pn)
+        for ci, res in enumerate(resources):
+            pctx = build_scan_context(pol, res, {}, "")
+            want = _scalar_rule_verdicts(sc, pol, pctx)[rn]
+            got = int(out.verdicts[row, ci])
+            assert got == want, (
+                f"{pn}/{rn} resource {ci}: device="
+                f"{VERDICT_NAMES.get(got, got)} "
+                f"scalar={VERDICT_NAMES.get(want, want)}")
+    return out
+
+
+def test_pattern_heavy_set_full_device_coverage_and_parity():
+    policies = pattern_policies()
+    eng = TpuEngine(policies)
+    dev, total = eng.coverage()
+    assert dev == total == 5, "pattern-heavy set must be fully on device"
+    assert eng.cps.dfa is not None and len(eng.cps.dfa) >= 5
+    assert_parity(policies, pattern_pods(), eng=eng)
+    cells = global_pattern_cells.totals()
+    assert cells["device"] > 0
+    # the café pod confirms under the byte-sensitive re2 pattern
+    assert cells["confirm"] > 0
+
+
+def test_confirm_ladder_under_tiny_budget(monkeypatch):
+    """A starved state budget forces over-approximating tables: every
+    DFA hit confirms on the oracle, verdicts stay bit-identical."""
+    monkeypatch.setenv("KYVERNO_TPU_DFA_STATE_BUDGET", "5")
+    policies = pattern_policies()
+    eng = TpuEngine(policies)
+    assert eng.cps.dfa.stats()["approx"] >= 1
+    assert_parity(policies, pattern_pods(), eng=eng)
+    assert global_pattern_cells.totals()["confirm"] > 0
+    assert 0.0 < global_pattern_cells.confirm_rate() <= 1.0
+
+
+def test_budget_rotates_cache_key(monkeypatch):
+    policies = pattern_policies()
+    k1 = TpuEngine(policies).cps.cache_key()
+    monkeypatch.setenv("KYVERNO_TPU_DFA_STATE_BUDGET", "5")
+    k2 = TpuEngine(policies).cps.cache_key()
+    assert k1 != k2
+
+
+class _OpenBreaker:
+    name = "pattern-test-open"
+    state = "open"
+
+    def allow(self):
+        return False
+
+    def record_failure(self):
+        pass
+
+    def record_success(self):
+        pass
+
+
+def test_breaker_open_scalar_fallback_parity(no_verdict_cache):
+    policies = pattern_policies()
+    pods = pattern_pods()
+    dev = TpuEngine(policies).scan(pods)
+    fb = TpuEngine(policies, breaker=_OpenBreaker()).scan(pods)
+    assert np.array_equal(dev.verdicts, fb.verdicts)
+
+
+def test_cached_path_parity():
+    policies = pattern_policies()
+    pods = pattern_pods()[:6]  # hashable resources only
+    eng = TpuEngine(policies)
+    first = eng.scan(pods)
+    second = eng.scan(pods)
+    assert np.array_equal(first.verdicts, second.verdicts)
+    from kyverno_tpu.observability.metrics import global_registry as reg
+
+    assert reg.verdict_cache.value({"outcome": "hit"}) >= 1
+
+
+def test_pipelined_scan_parity(no_verdict_cache):
+    from kyverno_tpu.parallel.sharding import ShardedScanner, make_mesh
+    from kyverno_tpu.tpu.pipeline import PipelinedScanner
+
+    policies = pattern_policies()
+    pods = pattern_pods() * 4
+    sc = ShardedScanner(policies, mesh=make_mesh())
+    serial = sc.scan(pods)
+    out = {}
+    PipelinedScanner(sc).scan_chunks(
+        [pods[i:i + 8] for i in range(0, len(pods), 8)],
+        on_result=lambda i, r: out.__setitem__(i, r))
+    got = np.concatenate([out[i].verdicts for i in sorted(out)], axis=1)
+    assert np.array_equal(serial.verdicts, got)
+
+
+def test_nonlowerable_regex_keeps_host_route_tagged():
+    policies = [make_policy("wordy", [{
+        "name": "wb", "match": POD_MATCH,
+        "validate": {"cel": {"expressions": [
+            {"expression": r"object.metadata.name.matches('\\bword\\b')"}]}},
+    }])]
+    eng = TpuEngine(policies)
+    assert eng.coverage() == (0, 1)
+    entry = eng.cps.rules[0]
+    assert entry.pattern_host, entry.fallback_reason
+    assert_parity(policies, pattern_pods()[:4], eng=eng)
+    # the host cells are attributed to the pattern class
+    assert global_pattern_cells.totals()["host"] > 0
+
+
+def test_cel_error_semantics_parity():
+    """Missing chains, non-string matches() targets, has() on
+    non-maps, and &&/|| error absorption — all must agree with the
+    scalar oracle (which runs the real CEL interpreter)."""
+    policies = [make_policy("cel-errs", [{
+        "name": "e", "match": POD_MATCH,
+        "validate": {"cel": {"expressions": [
+            {"expression": "object.spec.nodeName.matches('^n')"}]}},
+    }]), make_policy("cel-absorb", [{
+        "name": "a", "match": POD_MATCH,
+        "validate": {"cel": {"expressions": [
+            {"expression": "object.spec.missing.matches('x') || true"},
+            {"expression":
+             "!(false && object.spec.missing.matches('x'))"},
+        ]}},
+    }])]
+    pods = [
+        {"kind": "Pod", "metadata": {"name": "n1"},
+         "spec": {"nodeName": "node-1"}},
+        {"kind": "Pod", "metadata": {"name": "n2"}, "spec": {}},
+        {"kind": "Pod", "metadata": {"name": "n3"},
+         "spec": {"nodeName": 7}},
+        {"kind": "Pod", "metadata": {"name": "n4"},
+         "spec": {"nodeName": ["list"]}},
+    ]
+    assert_parity(policies, pods)
+
+
+def test_pattern_metrics_and_debug_surfaces():
+    from kyverno_tpu.observability.analytics import global_rule_stats
+    from kyverno_tpu.observability.metrics import global_registry as reg
+
+    policies = pattern_policies()
+    eng = TpuEngine(policies)
+    eng.scan(pattern_pods())
+    text = reg.exposition()
+    assert 'kyverno_tpu_pattern_cells_total{path="device"}' in text
+    assert "kyverno_tpu_dfa_tables" in text
+    assert "kyverno_tpu_dfa_states" in text
+    assert "kyverno_tpu_dfa_table_bytes" in text
+    state = global_pattern_cells.state()
+    assert set(state["totals"]) == {"device", "confirm", "host"}
+    # /debug/rules per-policy aggregates carry the pattern-cell split
+    report = global_rule_stats.report()
+    per_policy = {p["policy"]: p for p in report["policies"]}
+    assert "pattern_cells" in per_policy["glob-images"]
+    assert per_policy["glob-images"]["pattern_cells"]["device"] > 0
+
+
+def test_unsupported_cel_shapes_stay_host():
+    """Everything outside the lowered subset keeps today's host route
+    — and still answers correctly through the oracle."""
+    policies = [make_policy("cel-host", [{
+        "name": "sz", "match": POD_MATCH,
+        "validate": {"cel": {"expressions": [
+            {"expression": "size(object.metadata.name) >= 2"}]}},
+    }]), make_policy("cel-msgexpr", [{
+        "name": "me", "match": POD_MATCH,
+        "validate": {"cel": {"expressions": [
+            {"expression": "object.metadata.name == 'x'",
+             "messageExpression": "'no ' + object.metadata.name"}]}},
+    }])]
+    eng = TpuEngine(policies)
+    assert eng.coverage() == (0, 2)
+    assert not eng.cps.rules[0].pattern_host  # not pattern-caused
+    assert_parity(policies, pattern_pods()[:4], eng=eng)

@@ -41,11 +41,14 @@ SHADOWED_RULE = {
 }
 # CEL validate lowers to a host-fallback rule (fallback_reason set):
 # exercises the host-row branch of the device-count merge
+# size() is outside the lowered CEL subset (tpu/ir.py
+# compile_cel_validation), so this rule stays a host rule — the test
+# needs one in-set to exercise host-row merging
 CEL_RULE = {
     "name": "cel-host",
     "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
     "validate": {"cel": {"expressions": [
-        {"expression": "object.metadata.name != 'x'"}]}},
+        {"expression": "size(object.metadata.name) >= 1"}]}},
 }
 
 
@@ -84,13 +87,15 @@ def test_verdict_class_constants_mirror_evaluator():
 
 
 def test_class_counts_matches_naive_loop():
+    from kyverno_tpu.observability.analytics import NUM_CLASSES
+
     rng = np.random.default_rng(7)
-    table = rng.integers(0, 6, size=(11, 37)).astype(np.int32)
+    table = rng.integers(0, NUM_CLASSES, size=(11, 37)).astype(np.int32)
     got = class_counts(table)
     for ri in range(11):
-        for c in range(6):
+        for c in range(NUM_CLASSES):
             assert got[ri, c] == int((table[ri] == c).sum())
-    assert class_counts(np.zeros((0, 5), np.int32)).shape == (0, 6)
+    assert class_counts(np.zeros((0, 5), np.int32)).shape == (0, NUM_CLASSES)
     # 1-D column input
     col = np.array([0, 2, 2, 4], np.int32)
     got = class_counts(col)
