@@ -36,6 +36,13 @@ Other configs (run `python bench.py <name>`):
              on vs off (BENCH_ENCODE_RESOURCES / _CHUNK /
              _WORKERS_LIST); the encode-bottleneck roadmap item's
              measured leg
+  --capture FILE  drive the admission leg with the resource bodies of
+             a spooled flight capture (flight-dump --out / --flight-dir
+             spool) instead of the synthetic snapshot (BENCH_CAPTURE).
+             The admission leg always runs with the flight recorder at
+             default sampling plus background shadow verification and
+             carries a `verification` rollup (divergences asserted 0)
+             in the artifact — in the default driver loop too.
 
 The driver also measures the persistent XLA compilation cache
 (tpu/cache.py enable_xla_compile_cache): a cold-vs-warm compile of the
@@ -453,15 +460,38 @@ def bench_admission(n_requests=None, workers=64):
 
     from kyverno_tpu.tpu.flatten import EncodeConfig
 
+    from kyverno_tpu.observability.flightrecorder import (global_flight,
+                                                          load_capture)
+    from kyverno_tpu.observability.verification import global_verifier
+    from kyverno_tpu.serving.dispatch import resource_verdicts
+
     if n_requests is None:
         n_requests = int(os.environ.get("BENCH_ADM_REQUESTS", "50000"))
     policies = [expand_policy(p) for p in load_pss_policies()]
     # admission pods are small: a tighter row cap (oversized resources
     # still complete via host fallback) cuts encode + transfer per flush
     eng = TpuEngine(policies, encode_cfg=EncodeConfig(max_rows=128))
+    # --capture FILE / BENCH_CAPTURE: drive the leg with the resource
+    # bodies of a spooled flight capture instead of the synthetic
+    # snapshot — a production incident's workload becomes a bench
+    workload = "synthetic"
     pods = make_snapshot(2048, seed=9)
+    capture_path = os.environ.get("BENCH_CAPTURE")
+    if capture_path:
+        bodies = [r["resource"] for r in load_capture(capture_path)
+                  if isinstance(r.get("resource"), dict)]
+        if bodies:
+            pods, workload = bodies, f"capture:{capture_path}"
 
     max_batch = int(os.environ.get("BENCH_ADM_BATCH", "64"))
+    # flight recorder at DEFAULT sampling + background shadow
+    # verification: the leg measures the recorder's real hot-path cost
+    # (the <=5% overhead acceptance) and the artifact asserts zero
+    # divergences across everything the verifier sampled
+    global_flight.reset()
+    global_verifier.reset()
+    global_verifier.configure(
+        rate=float(os.environ.get("BENCH_VERIFY_RATE", "0.1")))
 
     def evaluate(payloads):
         # the pipeline hands us the drained batch padded with None up
@@ -470,6 +500,14 @@ def bench_admission(n_requests=None, workers=64):
         res_list = [(p["resource"] if p is not None else {}) for p in payloads]
         ops = [(p["op"] if p is not None else "") for p in payloads]
         res = eng.scan(res_list, operations=ops)
+        for ci, p in enumerate(payloads):
+            if p is not None:
+                global_flight.record_admission(
+                    res_list[ci], resource_verdicts(res, ci), "batched",
+                    engine=eng,
+                    namespace=(res_list[ci].get("metadata") or {})
+                    .get("namespace", ""),
+                    operation=ops[ci])
         blocked = (res.verdicts == FAIL).any(axis=0)
         return [bool(b) for b in blocked]
 
@@ -509,7 +547,55 @@ def bench_admission(n_requests=None, workers=64):
         t.join()
     wall = time.perf_counter() - t0
     pipeline.stop()
+    # verification rollup: drain the shadow verifier, then round-trip
+    # up to 64 recorded decisions through the offline replay machinery
+    # — the artifact asserts the whole audit came back clean (ok)
+    global_verifier.drain(timeout=30.0)
+    vstats = dict(global_verifier.state()["stats"])
+    verification = {
+        "checked": vstats.get("checked", 0),
+        "divergences": vstats.get("divergences", 0),
+        "skipped": vstats.get("skipped_impure", 0)
+        + vstats.get("skipped_no_engine", 0)
+        + vstats.get("skipped_overflow", 0),
+    }
+    try:
+        from kyverno_tpu.cli.flight import replay_capture
+
+        rep = replay_capture(global_flight.dump(64), policies,
+                             against="device", limit=64, engine=eng)
+        verification["replayed"] = rep["replayed"]
+        verification["replay_divergences"] = rep["divergent_records"]
+    except Exception as e:  # noqa: BLE001
+        verification["replay_error"] = repr(e)[:200]
+    # a crashed replay audit is NOT a clean audit: ok demands zero
+    # divergences AND a replay that actually ran
+    verification["ok"] = (verification["divergences"] == 0
+                          and verification.get("replay_divergences", 0) == 0
+                          and "replay_error" not in verification)
+    flight_state = global_flight.state()
+    global_verifier.configure(rate=0.0)
+    global_verifier.stop()
     lat = np.array(latencies)
+    if lat.size == 0:
+        # every request failed (a wedged/contended box expires the
+        # whole run): emit a diagnosable artifact — flush accounting +
+        # the flight ring's outcome split say WHY — instead of dying
+        # in np.percentile and leaving nothing
+        return {
+            "metric": "admission_p99_latency_ms", "value": 0.0,
+            "unit": "ms", "vs_baseline": 0.0,
+            "error": "no request completed (all expired/failed)",
+            "requests": n_requests, "workers": workers,
+            "workload": workload,
+            "flush_reasons": pipeline.stats["flush_reasons"],
+            "shed": pipeline.stats["shed"],
+            "expired": pipeline.stats["expired"],
+            "verification": verification,
+            "flight": {"captured": flight_state["stats"]["captured"],
+                       "by_outcome":
+                           flight_state["stats"]["by_outcome"]},
+        }
     return {
         "metric": "admission_p99_latency_ms",
         "value": round(float(np.percentile(lat, 99)) * 1000, 2),
@@ -519,9 +605,14 @@ def bench_admission(n_requests=None, workers=64):
         "requests": n_requests,
         "requests_per_sec": round(n_requests / wall, 1),
         "workers": workers,
+        "workload": workload,
         "mean_batch_size": round(pipeline.mean_batch_size(), 1),
         "flush_reasons": pipeline.stats["flush_reasons"],
         "shed": pipeline.stats["shed"],
+        "verification": verification,
+        "flight": {"captured": flight_state["stats"]["captured"],
+                   "sampled_out": flight_state["stats"]["sampled_out"],
+                   "sample_rate": flight_state["sample_rate"]},
     }
 
 
@@ -1307,6 +1398,14 @@ def run_all():
         out["tpu_probe_error_kind"] = err.get("kind", "backend_unavailable")
         out["tpu_probe_stderr_tail"] = err["stderr_tail"]
         out["tpu_probe_phases"] = err["phases"]
+        # canonical names next to the legacy tpu_-prefixed ones: the
+        # r03-r05 probe-timeout artifacts were undiagnosable because
+        # the breakdown was missing — these three fields are the
+        # contract a timed-out probe must still honor (phases reached,
+        # stderr tail, and whether the XLA cache was cold or warm)
+        out["probe_phases"] = err["phases"]
+        out["probe_stderr_tail"] = err["stderr_tail"]
+        out["probe_xla_cache_after"] = _xla_cache_warmth()
         out["platform_fallback"] = "cpu"
         os.environ.setdefault("BENCH_RESOURCES", "20000")
         os.environ.setdefault("BENCH_ITERS", "3")
@@ -1422,6 +1521,25 @@ def main():
         config = "cached"
     if config == "--patterns":  # flag spelling of the patterns config
         config = "patterns"
+    if config in ("capture", "--capture"):
+        # replay a spooled flight capture as the admission workload:
+        # `python bench.py --capture FILE` (kyverno-tpu flight-dump
+        # --out FILE or a --flight-dir spool produce one)
+        if len(argv) < 2:
+            print("bench.py --capture requires a capture file",
+                  file=sys.stderr)
+            sys.exit(2)
+        os.environ["BENCH_CAPTURE"] = argv[1]
+        out = FNS["admission"]()
+        try:
+            out["rule_stats"] = _rule_stats_rollup()
+            out["feed_starvation"] = _feed_starvation_rollup()
+        except Exception:  # noqa: BLE001
+            pass
+        emit(out)
+        if want_phases:
+            _emit_phase_split()
+        return
     if config == "_probe":
         # phase-stamped progress: the parent's failure artifact shows
         # how far the probe got (import vs device attach vs compile)

@@ -11,6 +11,7 @@ import sys
 
 from . import apply as apply_cmd
 from . import chainsaw as chainsaw_cmd
+from . import flight as flight_cmd
 from . import jp as jp_cmd
 from . import serve as serve_cmd
 from . import test as test_cmd
@@ -51,6 +52,7 @@ def build_parser() -> argparse.ArgumentParser:
     test_cmd.add_parser(sub)
     serve_cmd.add_parser(sub)
     tools_cmd.add_parsers(sub)
+    flight_cmd.add_parsers(sub)
     chainsaw_cmd.add_parser(sub)
     v = sub.add_parser("version", help="print version")
     v.set_defaults(func=_version)

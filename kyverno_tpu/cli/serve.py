@@ -116,6 +116,37 @@ def add_parser(sub: argparse._SubParsersAction) -> None:
                    help="per-policy kyverno_rule_* metric series kept "
                         "before collapsing into the _overflow bucket "
                         "(default $KYVERNO_TPU_RULE_METRICS_TOPK or 20)")
+    # flight recorder + continuous shadow verification
+    # (observability/flightrecorder.py, observability/verification.py)
+    p.add_argument("--flight-sample-rate", type=float, default=None,
+                   metavar="R",
+                   help="fraction of ok/cached decisions captured in the "
+                        "flight-recorder ring (default "
+                        "$KYVERNO_TPU_FLIGHT_SAMPLE or 0.01; error/"
+                        "fallback/confirm/shed outcomes always capture)")
+    p.add_argument("--flight-capacity", type=int, default=None, metavar="N",
+                   help="flight-recorder ring size in records (default "
+                        "$KYVERNO_TPU_FLIGHT_CAPACITY or 2048)")
+    p.add_argument("--flight-dir", default=None, metavar="DIR",
+                   help="spool the flight ring to DIR as NDJSON on "
+                        "breaker transitions and SLO burns; shadow-"
+                        "verification divergences append to "
+                        "divergences.ndjson (replayable via "
+                        "`kyverno-tpu replay`)")
+    p.add_argument("--shadow-verify-rate", type=float, default=0.0,
+                   metavar="R",
+                   help="fraction of captured records continuously "
+                        "re-evaluated through the scalar oracle at the "
+                        "pinned revision by a low-priority background "
+                        "thread; divergences count on kyverno_"
+                        "verification_divergence_total and burn the "
+                        "verdict-integrity SLO (0 disables)")
+    p.add_argument("--log-file", default=None, metavar="PATH",
+                   help="append structured operational events (breaker "
+                        "transitions, swaps/rollbacks, quarantine, pool "
+                        "restarts, SLO burns, divergences) to PATH as "
+                        "JSONL; without it events go to stderr in human "
+                        "format")
     p.add_argument("--dfa-state-budget", type=int, default=None, metavar="N",
                    help="per-pattern DFA state budget for device-side "
                         "string matching: exact tables up to N states, "
@@ -131,7 +162,22 @@ class ControlPlane:
     def __init__(self, policies, port=0, metrics_port=0, cert=None, key=None,
                  configuration=None, toggles=None, batching=False,
                  batch_config=None, request_timeout_s=10.0,
-                 policy_watch=None, reload_interval=2.0):
+                 policy_watch=None, reload_interval=2.0,
+                 flight_sample_rate=None, flight_capacity=None,
+                 flight_dir=None, shadow_verify_rate=None):
+        # flight recorder + shadow verifier are process-global (like
+        # the caches); only explicitly-passed knobs are applied so a
+        # test-configured recorder survives ControlPlane construction
+        from ..observability.flightrecorder import global_flight
+        from ..observability.verification import global_verifier
+
+        if (flight_sample_rate is not None or flight_capacity is not None
+                or flight_dir is not None):
+            global_flight.configure(capacity=flight_capacity,
+                                    sample_rate=flight_sample_rate,
+                                    spool_dir=flight_dir)
+        if shadow_verify_rate is not None:
+            global_verifier.configure(rate=shadow_verify_rate)
         self.cache = PolicyCache()
         for p in policies:
             self.cache.set(p)
@@ -223,6 +269,9 @@ class ControlPlane:
             self.watcher.stop()
         self.admission.stop()
         self.lifecycle.stop()
+        from ..observability.verification import global_verifier
+
+        global_verifier.stop()
         self.metrics_server.shutdown()
         # encoder-pool drain rides the lifecycle: in-flight chunks
         # finish (bounded), workers join, zero orphan children
@@ -304,6 +353,12 @@ def _load_policies(paths) -> list:
 
 
 def run(args: argparse.Namespace) -> int:
+    # the structured operational log replaces the ad-hoc stderr prints
+    # below: human format on stderr by default, JSONL when --log-file
+    # names a sink (both carry the same events)
+    from ..observability.log import global_oplog
+
+    global_oplog.configure(path=args.log_file, stderr=True)
     policies = _load_policies(args.policies)
     if not policies:
         print("no policies found", file=sys.stderr)
@@ -333,16 +388,14 @@ def run(args: argparse.Namespace) -> int:
             str(args.dfa_state_budget)
     xla_dir = enable_xla_compile_cache(args.xla_cache_dir)
     if xla_dir:
-        print(f"persistent XLA compile cache: {xla_dir}", file=sys.stderr)
+        global_oplog.emit("xla_cache_enabled", dir=xla_dir)
     # the encoder pool spawns BEFORE any compile: worker interpreters
     # come up (JAX-free) while the parent pays the XLA build
     from ..encode import configure_pool
 
     pool = configure_pool(args.encode_workers)
     if pool is not None:
-        print(f"encode pool: {pool.n_workers} worker processes "
-              f"(supervised; breaker-backed; --encode-workers 0 disables)",
-              file=sys.stderr)
+        global_oplog.emit("encode_pool_started", workers=pool.n_workers)
     configuration = Configuration()
     if args.config:
         with open(args.config) as f:
@@ -366,30 +419,37 @@ def run(args: argparse.Namespace) -> int:
 
         exporter = OTLPJsonFileExporter(args.trace_export)
         global_tracer.add_exporter(exporter)
-        print(f"trace export -> {args.trace_export} (OTLP-JSON lines)",
-              file=sys.stderr)
+        global_oplog.emit("trace_export_enabled", path=args.trace_export)
     cp = ControlPlane(policies, port=args.port, metrics_port=args.metrics_port,
                       cert=args.cert, key=args.key,
                       configuration=configuration, toggles=toggles,
                       batching=args.batching, batch_config=batch_config,
                       request_timeout_s=args.request_timeout_s,
                       policy_watch=args.policy_watch,
-                      reload_interval=args.reload_interval)
+                      reload_interval=args.reload_interval,
+                      flight_sample_rate=args.flight_sample_rate,
+                      flight_capacity=args.flight_capacity,
+                      flight_dir=args.flight_dir,
+                      shadow_verify_rate=args.shadow_verify_rate)
     if args.policy_watch:
-        print(f"policy watch on {args.policy_watch} "
-              f"(every {args.reload_interval}s): changes compile ahead and "
-              f"hot-swap atomically", file=sys.stderr)
+        global_oplog.emit("policy_watch_enabled", dir=args.policy_watch,
+                          interval_s=args.reload_interval)
+    if args.flight_dir:
+        global_oplog.emit("flight_spool_enabled", dir=args.flight_dir)
+    if args.shadow_verify_rate:
+        global_oplog.emit("shadow_verification_enabled",
+                          rate=args.shadow_verify_rate)
     from ..resilience.faults import global_faults
 
     armed = global_faults.armed()
     if armed:
         # chaos runs must be unmistakable in the serve log
-        print(f"FAULTS ARMED (KYVERNO_TPU_FAULTS): {sorted(armed)}",
-              file=sys.stderr)
+        global_oplog.emit("faults_armed", level="warn",
+                          sites=sorted(armed))
     cp.start(args.scan_interval)
-    print(f"admission on :{cp.admission.port}, metrics on "
-          f":{cp.metrics_server.server_address[1]}, "
-          f"{len(policies)} policies loaded", file=sys.stderr)
+    global_oplog.emit("serve_started", admission_port=cp.admission.port,
+                      metrics_port=cp.metrics_server.server_address[1],
+                      policies=len(policies))
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *a: stop.set())
     signal.signal(signal.SIGINT, lambda *a: stop.set())

@@ -213,10 +213,13 @@ class BackgroundScanService:
         pipe = self._get_pipeline(scanner)
         eng = pipe.engine
 
-        def report(chunk, result) -> None:
+        def report(chunk, result, evaluated: bool = False) -> None:
             """Report rows for one evaluated (or cache-served) chunk —
             in the pipelined path this runs for chunk k-1 while chunk k
-            executes on the device."""
+            executes on the device. ``evaluated`` marks chunks that
+            actually went through the dispatch ladder on THIS thread,
+            where the dispatch-path thread-local and the engine's
+            confirm flag are trustworthy."""
             for ci, (uid, res, h) in enumerate(chunk):
                 meta = res.get("metadata") or {}
                 results = []
@@ -239,6 +242,26 @@ class BackgroundScanService:
                 self.aggregator.put(uid, results)
                 with self._lock:
                     self._scanned[uid] = (h, revision)
+            # flight recorder: sampled per-resource records for this
+            # chunk (error/fallback/confirm columns always captured) —
+            # the scan side of the black box, uniform with admission
+            # records so replay and shadow verification treat both
+            # identically
+            try:
+                from ..observability.flightrecorder import global_flight
+
+                fallback = confirm = False
+                if evaluated:
+                    from ..observability.profiling import (
+                        PATH_SCALAR_FALLBACK, last_dispatch_path)
+
+                    fallback = last_dispatch_path() == PATH_SCALAR_FALLBACK
+                    confirm = eng.confirm_seen()
+                global_flight.record_scan_chunk(
+                    chunk, result, engine=eng, ns_labels=ns_labels,
+                    revision=revision, fallback=fallback, confirm=confirm)
+            except Exception:
+                pass
 
         # verdict cache: content-identical (resource, ns-labels) pairs
         # under the same compiled set serve their columns straight from
@@ -296,7 +319,7 @@ class BackgroundScanService:
                 chunk = miss[idx * self.batch_size:
                              (idx + 1) * self.batch_size]
                 self.metrics.batch_size.observe(len(chunk))
-                report(chunk, result)
+                report(chunk, result, evaluated=True)
                 if getattr(result, "infra_error", False):
                     return  # ERROR fill-in rows are not content truth
                 for ci, key in enumerate(chunk_keys[idx]):
@@ -328,13 +351,17 @@ class BackgroundScanService:
                         continue
                     # reported, NOT cached: an infrastructure failure's
                     # ERROR rows must never be served as content truth
+                    fill = ScanResult(
+                        verdicts=np.full((len(rules), len(chunk_res)),
+                                         _ERR, dtype=np.int32),
+                        rules=rules)
+                    # the flag the cache check reads; the flight
+                    # recorder also keys off it (records stay, but
+                    # without an engine the verifier won't compare
+                    # infra noise against the oracle)
+                    fill.infra_error = True
                     report(miss[idx * self.batch_size:
-                                (idx + 1) * self.batch_size],
-                           ScanResult(
-                               verdicts=np.full(
-                                   (len(rules), len(chunk_res)),
-                                   _ERR, dtype=np.int32),
-                               rules=rules))
+                                (idx + 1) * self.batch_size], fill)
         total = len(todo)
         self.stats["scans"] += 1
         self.stats["resources_scanned"] += total

@@ -674,6 +674,16 @@ class EncoderPool:
                     "encode_worker_died", worker=slot.idx,
                     pid=slot.pid, consecutive=slot.consecutive_restarts,
                     had_chunk=chunk is not None)
+                try:
+                    from ..observability.log import global_oplog
+
+                    global_oplog.emit(
+                        "encode_worker_died", level="warn",
+                        worker=slot.idx, pid=slot.pid,
+                        consecutive=slot.consecutive_restarts,
+                        had_chunk=chunk is not None)
+                except Exception:
+                    pass
             if chunk is not None:
                 self._crashed_chunk_locked(chunk)
         if proc is not None:

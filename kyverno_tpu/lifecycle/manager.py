@@ -44,6 +44,18 @@ from ..resilience.retry import RetryPolicy
 from .snapshot import PolicySetSnapshot, policy_key
 
 
+def _oplog(event: str, level: str = "info", **fields) -> None:
+    """Structured operational log (observability/log.py) — lifecycle
+    transitions are exactly the events an operator greps for during an
+    incident; emission must never affect the swap ladder."""
+    try:
+        from ..observability.log import global_oplog
+
+        global_oplog.emit(event, level=level, **fields)
+    except Exception:
+        pass
+
+
 class PolicySetUnavailable(RuntimeError):
     """No compiled policy-set version exists (initial compile failed
     and nothing was ever promoted). Serving layers degrade to the pure
@@ -375,6 +387,9 @@ class PolicySetLifecycleManager:
                     global_tracer.add_event(
                         "policyset_quarantine", policy=key, error=err[:200],
                         attempts=attempts)
+                    _oplog("policy_quarantined", level="warn", policy=key,
+                           error=err[:200], attempts=attempts,
+                           revision=snap.revision)
                 held_all = {k: q.error for k, q in self._quarantine.items()}
             self._publish_quarantine()
             q_idx = {idx_of[k]: err for k, err in held_all.items()
@@ -469,6 +484,10 @@ class PolicySetLifecycleManager:
             target_revision=snap.revision,
             serving_revision=active.revision if active else None,
             error=self._last_error[:200], status="error")
+        _oplog("policyset_rollback", level="error",
+               target_revision=snap.revision,
+               serving_revision=active.revision if active else None,
+               error=self._last_error[:200])
         return active
 
     def _swap(self, snap: PolicySetSnapshot, engine, now: float,
@@ -484,6 +503,8 @@ class PolicySetLifecycleManager:
                 del self._quarantine[k]
                 self.stats["quarantine_exits"] += 1
                 global_tracer.add_event("policyset_quarantine_exit", policy=k)
+                _oplog("policy_quarantine_healed", policy=k,
+                       revision=snap.revision)
             quarantined = tuple(sorted(self._quarantine))
             prior = self._active
             version = PolicySetVersion(snapshot=snap, engine=engine,
@@ -519,6 +540,10 @@ class PolicySetLifecycleManager:
             from_revision=prior.revision if prior else None,
             to_revision=snap.revision, policies=len(snap.policies),
             quarantined=len(quarantined), compile_s=round(compile_s, 4))
+        _oplog("policyset_swap",
+               from_revision=prior.revision if prior else None,
+               to_revision=snap.revision, policies=len(snap.policies),
+               quarantined=len(quarantined), compile_s=round(compile_s, 4))
         return version
 
     def _publish_quarantine(self) -> None:

@@ -614,6 +614,9 @@ class SloTracker:
         self._adm: deque = deque(maxlen=max_samples)  # (t, latency_s)
         self._last_scan: Optional[float] = None
         self._coverage: Optional[float] = None
+        # verdict-integrity samples: (t, diverged 0/1) per shadow-
+        # verification check (observability/verification.py)
+        self._verif: deque = deque(maxlen=max_samples)
         self._hooked = False
 
     def _registry(self):
@@ -647,11 +650,21 @@ class SloTracker:
             self._coverage = coverage
         self.update_gauges()
 
+    def record_verification(self, diverged: bool) -> None:
+        """One shadow-verification check: the verdict-integrity SLO's
+        error budget is ZERO divergences — any diverged sample in a
+        window marks the SLO breached for that window's span."""
+        with self._lock:
+            self._verif.append((self._clock(), 1 if diverged else 0))
+        if diverged:
+            self.update_gauges()
+
     def reset(self) -> None:
         with self._lock:
             self._adm.clear()
             self._last_scan = None
             self._coverage = None
+            self._verif.clear()
 
     # -- read side
 
@@ -673,10 +686,20 @@ class SloTracker:
                          "burn_rate": round(burn, 4)}
         return out
 
+    def _verification_windows(self, now: float) -> Dict[str, Dict[str, int]]:
+        with self._lock:
+            samples = list(self._verif)
+        out: Dict[str, Dict[str, int]] = {}
+        for name, span in self.config.windows.items():
+            win = [d for (t, d) in samples if t >= now - span]
+            out[name] = {"checked": len(win), "divergences": sum(win)}
+        return out
+
     def state(self, now: Optional[float] = None) -> Dict[str, Any]:
         now = self._clock() if now is None else now
         cfg = self.config
         adm = self._admission_windows(now)
+        verif = self._verification_windows(now)
         with self._lock:
             last_scan, coverage = self._last_scan, self._coverage
         freshness = (now - last_scan) if last_scan is not None else None
@@ -690,7 +713,15 @@ class SloTracker:
             breached.append("scan_freshness")
         if not cov_ok:
             breached.append("device_coverage")
+        if any(w["divergences"] for w in verif.values()):
+            # error budget zero: verdicts diverging from the oracle is
+            # never acceptable spend
+            breached.append("verdict_integrity")
         return {
+            "verdict_integrity": {
+                "windows": verif,
+                "ok": "verdict_integrity" not in breached,
+            },
             "admission": {
                 "target_p99_ms": cfg.admission_p99_target_ms,
                 "error_budget": cfg.admission_error_budget,
@@ -714,6 +745,7 @@ class SloTracker:
         try:
             reg = self._registry()
             state = self.state()
+            self._notify_burns(state["breached"])
             for name, w in state["admission"]["windows"].items():
                 reg.slo_admission_p99.set(w["p99_ms"] / 1e3,
                                           {"window": name})
@@ -725,12 +757,40 @@ class SloTracker:
             cov = state["device_coverage"]["ratio"]
             if cov is not None:
                 reg.slo_device_coverage.set(cov)
+            for name, w in state["verdict_integrity"]["windows"].items():
+                reg.slo_verification_divergences.set(
+                    float(w["divergences"]), {"window": name})
             for slo in ("admission_latency", "scan_freshness",
-                        "device_coverage"):
+                        "device_coverage", "verdict_integrity"):
                 reg.slo_breached.set(
                     1.0 if slo in state["breached"] else 0.0, {"slo": slo})
         except Exception:
             pass  # SLO bookkeeping must never break a scrape or request
+
+    def _notify_burns(self, breached) -> None:
+        """A NEWLY burning SLO is an incident moment: spool the flight
+        ring (the last N decisions are the evidence) and emit one
+        structured log event. Repeats while the same SLO keeps burning
+        stay quiet — the gauges carry the ongoing state."""
+        prev = getattr(self, "_last_breached", frozenset())
+        cur = frozenset(breached)
+        self._last_breached = cur
+        new = cur - prev
+        if not new:
+            return
+        try:
+            from .flightrecorder import global_flight
+
+            global_flight.on_slo_burn(sorted(new))
+        except Exception:
+            pass
+        try:
+            from .log import global_oplog
+
+            global_oplog.emit("slo_burn", level="warn",
+                              slos=sorted(new), all_breached=sorted(cur))
+        except Exception:
+            pass
 
 
 global_slo = SloTracker()

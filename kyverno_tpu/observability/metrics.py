@@ -41,12 +41,20 @@ class Counter:
     def __init__(self, name: str, help_: str):
         self.name, self.help = name, help_
         self._values: Dict[LabelKey, float] = {}
+        # OpenMetrics counter exemplars: the LAST exemplar per series
+        # (the verification layer attaches the diverging record's trace
+        # id, so an alert on the counter links straight to the trace)
+        self._exemplars: Dict[LabelKey, Tuple[LabelKey, float, float]] = {}
         self._lock = threading.Lock()
 
-    def inc(self, labels: Optional[Dict[str, str]] = None, value: float = 1.0) -> None:
+    def inc(self, labels: Optional[Dict[str, str]] = None, value: float = 1.0,
+            exemplar: Optional[Dict[str, str]] = None) -> None:
         k = _labels_key(labels)
         with self._lock:
             self._values[k] = self._values.get(k, 0.0) + value
+            if exemplar:
+                self._exemplars[k] = (_labels_key(exemplar), float(value),
+                                      time.time())
 
     def value(self, labels: Optional[Dict[str, str]] = None) -> float:
         """Programmatic read (tests, bench artifacts) — exposition
@@ -60,11 +68,20 @@ class Counter:
         with self._lock:
             return [(dict(k), v) for k, v in sorted(self._values.items())]
 
+    def _exemplar_suffix(self, k: LabelKey) -> str:
+        ex = self._exemplars.get(k)
+        if ex is None:
+            return ""
+        ex_labels, ex_value, ex_ts = ex
+        body = ",".join(f'{lk}="{_escape(lv)}"' for lk, lv in ex_labels)
+        return f" # {{{body}}} {ex_value} {round(ex_ts, 3)}"
+
     def collect(self) -> List[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
         with self._lock:
             for k, v in sorted(self._values.items()):
-                out.append(f"{self.name}{_fmt_labels(k)} {v}")
+                out.append(f"{self.name}{_fmt_labels(k)} {v}"
+                           + self._exemplar_suffix(k))
         return out
 
 
@@ -269,6 +286,42 @@ class MetricsRegistry:
         self.slo_breached = self.gauge(
             "kyverno_slo_breached",
             "1 when the named SLO is currently burning past budget")
+        # flight recorder (observability/flightrecorder.py): the black
+        # box over the admission/scan ladder — captured records by
+        # outcome, head-sampling drops, ring occupancy, auto-spools
+        self.flight_records = self.counter(
+            "kyverno_flight_records_total",
+            "flight-recorder records captured, by outcome "
+            "(ok/error/fallback/shed/confirm/cached/expired)")
+        self.flight_sampled_out = self.counter(
+            "kyverno_flight_sampled_out_total",
+            "decisions not recorded because head-based sampling "
+            "dropped them (interesting outcomes are never dropped)")
+        self.flight_ring_size = self.gauge(
+            "kyverno_flight_ring_records",
+            "flight-recorder records currently held in the ring")
+        self.flight_spools = self.counter(
+            "kyverno_flight_spools_total",
+            "flight-recorder ring spools to --flight-dir, by reason")
+        # continuous shadow verification (observability/verification.py):
+        # sampled oracle re-evaluation of recorded decisions — check
+        # outcomes, bit-exact divergences (exemplar = originating trace
+        # id), and audit-queue pressure
+        self.verification_checks = self.counter(
+            "kyverno_verification_checks_total",
+            "shadow-verification checks by result (match/diverge/error/"
+            "skipped_no_engine/skipped_impure/skipped_overflow)")
+        self.verification_divergence = self.counter(
+            "kyverno_verification_divergence_total",
+            "recorded verdicts that did NOT match the scalar oracle at "
+            "the pinned revision — the bit-identity claim failing")
+        self.verification_queue_depth = self.gauge(
+            "kyverno_verification_queue_depth",
+            "flight records queued for shadow verification")
+        self.slo_verification_divergences = self.gauge(
+            "kyverno_slo_verification_divergences",
+            "verdict-integrity SLO: shadow-verification divergences in "
+            "the rolling window, by window (target: 0)")
         # serving pipeline instruments (serving/batcher.py): queue
         # depth, batch occupancy, flush reasons, shed/expiry counters,
         # and submit-to-verdict latency (p50-p99 read from buckets)

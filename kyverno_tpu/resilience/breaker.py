@@ -149,6 +149,27 @@ class CircuitBreaker:
                 "breaker_transition", breaker=self.name,
                 from_state=frm, to_state=to,
                 consecutive_failures=self._consecutive_failures)
+            # flight recorder: a breaker transition is an incident
+            # moment — spool the last N decisions (the evidence) when a
+            # spool dir is configured, and log it structurally.
+            # Transitions are rare, so the file write under the breaker
+            # lock is acceptable; the cooldown bounds a flapping breaker
+            try:
+                from ..observability.flightrecorder import global_flight
+
+                global_flight.on_breaker_transition(self.name, frm, to)
+            except Exception:
+                pass
+            try:
+                from ..observability.log import global_oplog
+
+                global_oplog.emit(
+                    "breaker_transition",
+                    level="warn" if to == OPEN else "info",
+                    breaker=self.name, from_state=frm, to_state=to,
+                    consecutive_failures=self._consecutive_failures)
+            except Exception:
+                pass
         self._publish_state()
 
     def _publish_state(self) -> None:

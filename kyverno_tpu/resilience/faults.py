@@ -40,6 +40,9 @@ Env syntax (';'-separated site specs)::
     site ':' mode [':' key=value (',' key=value)*]
     keys: p=<float 0..1> | count=<int first-N calls> | delay_s=<float>
           | seed=<int> | match=<substring of the call payload>
+          | flip=1 (corrupt mode only: flip verdict VALUES with the
+            shape intact — the silent wrong answer that passes every
+            shape check and is only caught by shadow verification)
 """
 
 from __future__ import annotations
@@ -96,6 +99,12 @@ class FaultSpec:
     delay_s: float = 0.01           # sleep for mode=delay
     seed: int = 0                   # RNG seed for probability triggers
     match: Optional[str] = None     # only fire when payload contains this
+    # corrupt variant: instead of shape-mangling the result (which the
+    # engine's shape validation CATCHES, exercising the breaker
+    # ladder), flip verdict VALUES in place — a shape-valid wrong
+    # answer, the silent-device-lie failure class only continuous
+    # shadow verification (observability/verification.py) can detect
+    flip: bool = False
     calls: int = 0                  # observed calls (all)
     fired: int = 0                  # calls that triggered
     _rng: Random = field(default_factory=Random, repr=False)
@@ -103,6 +112,9 @@ class FaultSpec:
     def __post_init__(self) -> None:
         if self.mode not in MODES:
             raise FaultConfigError(f"unknown fault mode {self.mode!r}")
+        if self.flip and self.mode != "corrupt":
+            raise FaultConfigError(
+                "flip=1 only modifies corrupt-mode faults")
         if self.p is None and self.count is None:
             self.p = 1.0  # armed with no trigger = always fires
         if self.p is not None and not (0.0 <= self.p <= 1.0):
@@ -142,6 +154,25 @@ def _corrupt(value: Any) -> Any:
     return None
 
 
+def _flip(value: Any) -> Any:
+    """Value-corrupt a verdict table WITHOUT changing its shape: swap
+    PASS(0) <-> FAIL(2) cells. This clears every downstream shape/dtype
+    check — exactly a device silently computing the wrong answer —
+    so it is the fixture for shadow-verification divergence tests."""
+    try:
+        import numpy as np
+
+        if isinstance(value, np.ndarray) and \
+                np.issubdtype(value.dtype, np.integer):
+            out = value.copy()
+            out[value == 0] = 2
+            out[value == 2] = 0
+            return out
+    except ImportError:
+        pass
+    return value
+
+
 class FaultRegistry:
     """Armed faults by site. ``fire()`` is the raise/delay hook placed
     BEFORE the protected operation; ``corrupt()`` filters the
@@ -155,7 +186,8 @@ class FaultRegistry:
 
     def arm(self, site: str, mode: str = "raise", p: Optional[float] = None,
             count: Optional[int] = None, delay_s: float = 0.01,
-            seed: int = 0, match: Optional[str] = None) -> FaultSpec:
+            seed: int = 0, match: Optional[str] = None,
+            flip: bool = False) -> FaultSpec:
         if site not in KNOWN_SITES:
             raise FaultConfigError(
                 f"unknown fault site {site!r} (known: {sorted(KNOWN_SITES)})")
@@ -170,7 +202,7 @@ class FaultRegistry:
                 f"(crashable: {sorted(CRASHABLE_SITES)}) — crashing it "
                 f"would kill the engine, not exercise recovery")
         spec = FaultSpec(site=site, mode=mode, p=p, count=count,
-                         delay_s=delay_s, seed=seed, match=match)
+                         delay_s=delay_s, seed=seed, match=match, flip=flip)
         with self._lock:
             self._armed[site] = spec
         return spec
@@ -216,6 +248,8 @@ class FaultRegistry:
                     kw["seed"] = int(v)
                 elif k == "match":
                     kw["match"] = v
+                elif k == "flip":
+                    kw["flip"] = v.lower() not in ("0", "false", "off", "")
                 else:
                     raise FaultConfigError(f"unknown fault option {k!r}")
             self.arm(site, mode=mode, **kw)
@@ -262,7 +296,7 @@ class FaultRegistry:
         if not triggered:
             return value
         self._count(spec)
-        return _corrupt(value)
+        return _flip(value) if spec.flip else _corrupt(value)
 
     @staticmethod
     def _count(spec: FaultSpec) -> None:

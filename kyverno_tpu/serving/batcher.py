@@ -78,9 +78,17 @@ class AdmissionPipeline:
         metrics: Optional[MetricsRegistry] = None,
         version_provider: Optional[Callable[[], Any]] = None,
         cache_lookup: Optional[Callable[[Any], Any]] = None,
+        flight_hook: Optional[Callable[..., None]] = None,
     ) -> None:
         self._fn = evaluate_fn
         self._scalar = scalar_fallback
+        # flight recorder (observability/flightrecorder.py): called
+        # once per resolved request with (payload, result-or-exception,
+        # path, latency_s, trace_id, timings). Batched requests are
+        # recorded from the FLUSHER thread after every waiter is woken
+        # — like span recording, the black box must not tax request
+        # latency; cached/shed resolutions record at submit()
+        self._flight = flight_hook
         # content-addressed fast path: when the caller supplies a
         # lookup (webhooks/server.py wires the verdict cache), a repeat
         # admission of an identical manifest resolves at submit() —
@@ -133,6 +141,7 @@ class AdmissionPipeline:
                 self.metrics.serving_request_latency.observe(
                     dt, {"path": "cached"})
                 self._record_slo(dt)
+                self._record_flight(payload, cached, "cached", dt, "")
                 return cached
         budget = (deadline_ms if deadline_ms is not None
                   else self.config.deadline_ms) / 1000.0
@@ -162,8 +171,12 @@ class AdmissionPipeline:
                     self.metrics.serving_request_latency.observe(
                         dt, {"path": "shed"}, exemplar=exemplar)
                     self._record_slo(dt)
+                    self._record_flight(payload, out, "shed", dt,
+                                        root.trace_id)
                     return out
                 self.metrics.serving_shed_total.inc({"outcome": "rejected"})
+                self._record_flight(payload, QueueFullError("shed"), "shed",
+                                    time.monotonic() - t0, root.trace_id)
                 raise
             self.metrics.serving_queue_depth.set(self.queue.depth())
             # the deadline governs QUEUE time; only a request that
@@ -185,6 +198,17 @@ class AdmissionPipeline:
             if isinstance(req.result, BaseException):
                 raise req.result
             return req.result
+
+    def _record_flight(self, payload: Any, result: Any, path: str,
+                       latency_s: float, trace_id: str,
+                       timings: Optional[Dict[str, float]] = None) -> None:
+        if self._flight is None:
+            return
+        try:
+            self._flight(payload, result, path, latency_s, trace_id,
+                         timings)
+        except Exception:
+            pass  # the black box must never fail a request
 
     @staticmethod
     def _record_slo(latency_s: float) -> None:
@@ -296,8 +320,12 @@ class AdmissionPipeline:
             if req.deadline <= now:
                 # expired mid-queue: resolve with the error instead of
                 # spending device work on a verdict nobody is waiting for
-                req.resolve(DeadlineExceededError(
-                    "request deadline expired while queued"))
+                err = DeadlineExceededError(
+                    "request deadline expired while queued")
+                req.resolve(err)
+                self._record_flight(
+                    req.payload, err, "batched", now - req.enqueued_at,
+                    req.trace_ctx.trace_id if req.trace_ctx else "")
             else:
                 live.append(req)
         n_expired = len(batch) - len(live)
@@ -358,6 +386,11 @@ class AdmissionPipeline:
             self._record_flush_spans(live, reason, bucket, now, t_eval0,
                                      t_eval1, error=f"{type(e).__name__}: {e}",
                                      revision=pin_rev)
+            for req in live:
+                self._record_flight(
+                    req.payload, e, "batched", t_eval1 - req.enqueued_at,
+                    req.trace_ctx.trace_id if req.trace_ctx else "",
+                    {"eval_s": t_eval1 - t_eval0})
             return
         t_eval1 = time.monotonic()
         self.metrics.serving_flusher_seconds.inc(
@@ -379,6 +412,19 @@ class AdmissionPipeline:
                 global_tracer.record_span(
                     "admission.verdict_dispatch", t_resolve0, t_resolve1,
                     parent=req.trace_ctx, batch_size=len(live))
+        if self._flight is not None:
+            # AFTER the waiters are resolved and the spans recorded:
+            # the flusher thread still holds the dispatch-path thread-
+            # local, so the hook can classify device vs fallback
+            eval_s = t_eval1 - t_eval0
+            for req, result in zip(live, results):
+                self._record_flight(
+                    req.payload, result, "batched",
+                    t_resolve1 - req.enqueued_at,
+                    req.trace_ctx.trace_id if req.trace_ctx else "",
+                    {"queue_wait_s": max(0.0, (req.drained_at or now)
+                                         - req.enqueued_at),
+                     "eval_s": eval_s})
 
     def _record_flush_spans(self, live: List[QueuedRequest], reason: str,
                             bucket: int, drained_at: float,

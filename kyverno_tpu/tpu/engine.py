@@ -562,6 +562,15 @@ class TpuEngine:
                 counts = None
         self._tls.pending_counts = counts
 
+    def confirm_seen(self) -> bool:
+        """Did the last scan() on this thread resolve any pattern-
+        CONFIRM cell? Flight-recorder outcome classification: a batch
+        that exercised the approximate-DFA confirmation ladder is
+        always captured (ISSUE: CONFIRM is an always-capture outcome).
+        Batch-scoped — scan() clears it at entry, assemble() sets it
+        from the device table."""
+        return bool(getattr(self._tls, "confirm_seen", False))
+
     def take_pending_counts(self) -> Optional[np.ndarray]:
         counts = getattr(self._tls, "pending_counts", None)
         self._tls.pending_counts = None
@@ -661,6 +670,7 @@ class TpuEngine:
         way."""
         from .cache import global_verdict_cache as vc
 
+        self._tls.confirm_seen = False  # batch-scoped (see confirm_seen)
         keys = (self.verdict_cache_keys(resources, namespace_labels,
                                         operations, admission_infos)
                 if vc.enabled else None)
@@ -1010,6 +1020,12 @@ class TpuEngine:
         n = len(resources)
         total = np.full((len(self.cps.rules), n), NOT_MATCHED, dtype=np.int32)
         ns_labels = namespace_labels or {}
+        # unconditional assignment, not a sticky set: the pipelined
+        # scan calls assemble() per chunk WITHOUT going through scan()
+        # (the only other place the flag resets), so one CONFIRM cell
+        # must not mark every later chunk/tick as a confirm outcome
+        self._tls.confirm_seen = bool(
+            (np.asarray(device_table) == CONFIRM).any())
 
         # requests whose identity strings carry globs defeat the
         # device's hash-equality userInfo lanes (_set_in matches

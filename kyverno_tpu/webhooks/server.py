@@ -103,6 +103,12 @@ class Handlers:
         self.scalar = ScalarEngine(exceptions=self.exceptions)
         self._rbac_needed: Dict[int, bool] = {}  # per cache revision
         self._lock = threading.Lock()
+        # flight-record support: the flusher stashes its flush's
+        # namespace-labels map here so per-record capture never pays
+        # another O(snapshot) walk (incident paths capture EVERY
+        # record — the walk would run per request exactly when the
+        # system is degraded)
+        self._flight_tls = threading.local()
         # policy-set lifecycle: every cache mutation snapshots +
         # compiles ahead off the request path; serving acquires the
         # last-known-good compiled version (lifecycle/manager.py). The
@@ -129,7 +135,8 @@ class Handlers:
                 config=cfg,
                 metrics=self.metrics,
                 version_provider=self._pin_version,
-                cache_lookup=self._cached_verdict_rows)
+                cache_lookup=self._cached_verdict_rows,
+                flight_hook=self._flight_hook)
 
     # -- versioned engine acquisition (lifecycle/manager.py)
 
@@ -313,12 +320,92 @@ class Handlers:
             pass
         return VerdictRows(rows, revision=rev)
 
+    # -- flight recorder (observability/flightrecorder.py)
+
+    def _flight_hook(self, payload: AdmissionPayload, result: Any,
+                     path: str, latency_s: float, trace_id: str,
+                     timings: Optional[Dict[str, float]] = None) -> None:
+        """Per-resolved-request black-box record builder, called by the
+        serving pipeline (cached/shed at submit, batched from the
+        flusher thread — where the dispatch-path thread-local and the
+        engine's confirm flag are still this flush's truth)."""
+        from ..observability.flightrecorder import global_flight
+        from ..observability.profiling import (PATH_SCALAR_FALLBACK,
+                                               last_dispatch_path)
+
+        if not global_flight.enabled:
+            return
+        error = result if isinstance(result, BaseException) else None
+        rows = result if isinstance(result, list) else None
+        version = getattr(rows, "version", None)
+        engine = version.engine if version is not None else None
+        revision = getattr(rows, "revision", None)
+        confirm = False
+        if path == "batched" and rows is not None:
+            # the dispatch-path thread-local describes the LAST engine
+            # evaluation on this thread: only a request that actually
+            # produced rows may trust it — an expired/errored request
+            # never reached the engine and must not inherit a prior
+            # flush's path
+            if last_dispatch_path() == PATH_SCALAR_FALLBACK:
+                path = "scalar_fallback"
+            if engine is not None:
+                try:
+                    confirm = engine.confirm_seen()
+                except Exception:
+                    confirm = False
+            if version is None:
+                path = "pure_scalar"  # deepest rung: no compiled set
+        # sampling gate FIRST: everything below (the O(snapshot)
+        # namespace-labels walk, userinfo dict) is built only for the
+        # ~1% of decisions actually captured
+        outcome = global_flight.classify(rows, path, error=error,
+                                         confirm=confirm)
+        if not global_flight.should_capture(outcome):
+            return
+        res = payload.old if (payload.operation == "DELETE" and payload.old) \
+            else payload.resource
+        # the flush that produced these rows stashed its ns-labels map
+        # on this thread (_evaluate_padded); submit-side paths (cached
+        # hit, shed) have no flush and walk the snapshot themselves
+        nsmap = getattr(self._flight_tls, "nsmap", None) \
+            if path not in ("cached", "shed") else None
+        if nsmap is None and self.snapshot is not None:
+            try:
+                nsmap = self.snapshot.namespace_labels()
+            except Exception:
+                nsmap = {}
+        ns_labels = (nsmap or {}).get(payload.namespace, {})
+        info = payload.info
+        t = dict(timings or {})
+        t["total_s"] = latency_s
+        global_flight.record_admission(
+            res, rows, path, error=error, engine=engine,
+            revision=revision, namespace=payload.namespace,
+            operation=payload.operation,
+            userinfo={"username": info.username, "uid": info.uid,
+                      "groups": list(info.groups or []),
+                      "roles": list(info.roles or []),
+                      "cluster_roles": list(info.cluster_roles or [])},
+            ns_labels=ns_labels, trace_id=trace_id, timings=t,
+            confirm=confirm, outcome=outcome)
+
     def _evaluate_batch(self, payloads: List[AdmissionPayload]):
         # unpadded MicroBatcher path: same evaluator as the serving
         # pipeline (zero pad slots), so batched and non-batched verdict
         # computation cannot drift. The single _engine() acquisition
-        # below pins one compiled version for this flush too.
-        return self._evaluate_padded(payloads)
+        # below pins one compiled version for this flush too. Flight
+        # records materialize here (the pipeline path records via its
+        # own hook, so the two never double-count).
+        t0 = time.perf_counter()
+        out = self._evaluate_padded(payloads)
+        dt = time.perf_counter() - t0
+        try:
+            for payload, rows in zip(payloads, out):
+                self._flight_hook(payload, rows, "batched", dt, "")
+        except Exception:
+            pass
+        return out
 
     def _evaluate_padded(self, payloads: List[Optional[AdmissionPayload]],
                          pinned: Optional[PolicySetVersion] = None):
@@ -354,6 +441,12 @@ class Handlers:
                                                    set_dispatch_path)
 
             set_dispatch_path(PATH_SCALAR_FALLBACK)
+            try:
+                self._flight_tls.nsmap = (
+                    self.snapshot.namespace_labels()
+                    if self.snapshot else {})
+            except Exception:
+                self._flight_tls.nsmap = {}
             if pinned is None:
                 out = [self._pure_scalar_rows(p) for p in filled[:real_n]]
             else:
@@ -368,6 +461,9 @@ class Handlers:
             for p in filled
         ]
         ns_labels = self.snapshot.namespace_labels() if self.snapshot else {}
+        # ONE walk per flush, reused by every flight record this flush
+        # produces (the hook runs on this same flusher thread)
+        self._flight_tls.nsmap = ns_labels
         result = eng.scan(
             resources,
             ns_labels,
@@ -482,6 +578,8 @@ class Handlers:
                 site: {"mode": spec.mode, "calls": spec.calls,
                        "fired": spec.fired}
                 for site, spec in global_faults.armed().items()},
+            "flight": _flight_state(),
+            "verification": _verification_state(),
             "phase_breakdown": global_profiler.breakdown(),
         }
         if self.pipeline is not None:
@@ -856,6 +954,24 @@ def build_handlers(cache: PolicyCache, snapshot=None, aggregator=None, **kw) -> 
     return Handlers(cache, snapshot, aggregator, **kw)
 
 
+def _flight_state():
+    try:
+        from ..observability.flightrecorder import global_flight
+
+        return global_flight.state()
+    except Exception:
+        return {}
+
+
+def _verification_state():
+    try:
+        from ..observability.verification import global_verifier
+
+        return global_verifier.state()
+    except Exception:
+        return {}
+
+
 def _encode_pool_state():
     """The encoder pool's /debug/state block ({'enabled': False} when
     --encode-workers is 0 — introspection must not start a pool)."""
@@ -931,6 +1047,23 @@ def handle_debug_path(path: str, handlers: Optional[Handlers] = None
                 "application/json"
         doc = global_rule_stats.report(top=top)
         return 200, (json.dumps(doc) + "\n").encode(), "application/json"
+    if route == "/debug/flight":
+        # the flight recorder's ring, newest-last: the last N decisions
+        # with bodies (size-capped), verdict columns, dispatch path,
+        # and trace ids — the incident-forensics surface the spool
+        # files mirror on disk
+        from ..observability.flightrecorder import global_flight
+
+        try:
+            last = int(query.get("last", ["100"])[0])
+        except ValueError:
+            return 400, b'{"error": "last must be an integer"}\n', \
+                "application/json"
+        doc = {"records": global_flight.dump(last=last),
+               "state": global_flight.state(),
+               "verification": _verification_state()}
+        return 200, (json.dumps(doc, default=str) + "\n").encode(), \
+            "application/json"
     if route == "/debug/utilization":
         from ..observability.analytics import global_slo, global_starvation
         from ..observability.metrics import global_registry as _reg
@@ -954,6 +1087,7 @@ def handle_debug_path(path: str, handlers: Optional[Handlers] = None
                             "encode_hit_rate": global_encode_cache.hit_rate()},
             "patterns": _pattern_state(_active_cps(handlers)),
             "encode_pool": _encode_pool_state(),
+            "verification": _verification_state(),
             "slo": global_slo.state(),
             "phase_breakdown": global_profiler.breakdown(),
         }
@@ -1116,6 +1250,10 @@ class AdmissionServer:
         /debug/utilization        feed-starvation ratio, pipeline
                                   overlap, flusher state split, SLO
                                   burn state
+        /debug/flight[?last=N]    flight-recorder ring: the last N
+                                  recorded admission/scan decisions
+                                  (bodies, verdicts, path, trace ids)
+                                  + recorder/verifier state
         /debug/spans              recent spans, one line each (legacy)
         /debug/xla/start?dir=D    start the JAX/XLA profiler trace
         /debug/xla/stop           stop it (trace lands in the dir)

@@ -11,12 +11,12 @@ set -o pipefail
 cd "$(dirname "$0")"
 rc=0
 
-echo "=== leg 1/4: tier-1 (faults disarmed) ==="
+echo "=== leg 1/5: tier-1 (faults disarmed) ==="
 KYVERNO_TPU_FAULTS= JAX_PLATFORMS=cpu timeout -k 10 870 \
   python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors \
   -p no:cacheprovider -p no:xdist -p no:randomly || rc=1
 
-echo "=== leg 2/4: /metrics + /debug/* smoke under load, tpu.dispatch armed ==="
+echo "=== leg 2/5: /metrics + /debug/* smoke under load, tpu.dispatch armed ==="
 KYVERNO_TPU_FAULTS="tpu.dispatch:raise:p=1.0" JAX_PLATFORMS=cpu \
   timeout -k 10 300 python - <<'EOF' || rc=1
 import http.client
@@ -152,7 +152,7 @@ finally:
     cp.stop()
 EOF
 
-echo "=== leg 3/4: policy observatory (rule analytics + starvation + SLO) ==="
+echo "=== leg 3/5: policy observatory (rule analytics + starvation + SLO) ==="
 KYVERNO_TPU_FAULTS= JAX_PLATFORMS=cpu timeout -k 10 300 python - <<'EOF' || rc=1
 import http.client
 import json
@@ -261,7 +261,7 @@ finally:
     cp.stop()
 EOF
 
-echo "=== leg 4/4: device-side string matching (pattern metrics + /scan device cells) ==="
+echo "=== leg 4/5: device-side string matching (pattern metrics + /scan device cells) ==="
 KYVERNO_TPU_FAULTS= JAX_PLATFORMS=cpu timeout -k 10 300 python - <<'EOF' || rc=1
 import http.client
 import json
@@ -350,6 +350,135 @@ try:
     with_cells = [p for p in rules["policies"] if "pattern_cells" in p]
     assert with_cells, rules["policies"]
     print(f"PATTERNS OK: cells={pat['totals']}, bank={pat['bank']}")
+finally:
+    cp.stop()
+EOF
+
+echo "=== leg 5/5: flight recorder + continuous shadow verification ==="
+KYVERNO_TPU_FAULTS= JAX_PLATFORMS=cpu timeout -k 10 300 python - <<'EOF' || rc=1
+import json
+import os
+import tempfile
+
+from kyverno_tpu.api.policy import ClusterPolicy
+from kyverno_tpu.cli.serve import ControlPlane
+
+POLICY = ClusterPolicy.from_dict({
+    "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+    "metadata": {"name": "flight-smoke"},
+    "spec": {"validationFailureAction": "Enforce", "rules": [{
+        "name": "named",
+        "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+        "validate": {"message": "m",
+                     "pattern": {"metadata": {"name": "?*"}}},
+    }]}})
+
+
+def get(port, path):
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    conn.request("GET", path)
+    resp = conn.getresponse()
+    body = resp.read()
+    conn.close()
+    return resp.status, body
+
+
+def post(port, path, body):
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    conn.request("POST", path, body, {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    out = resp.read()
+    conn.close()
+    return resp.status, out
+
+
+def review(i):
+    return json.dumps({
+        "apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview",
+        "request": {"uid": f"u{i}", "operation": "CREATE",
+                    "object": {"apiVersion": "v1", "kind": "Pod",
+                               "metadata": {"name": f"fp{i}",
+                                            "namespace": "d"},
+                               "spec": {"containers": [
+                                   {"name": "c", "image": "nginx"}]}}}})
+
+
+def counter_value(text, family):
+    # strip any exemplar suffix (" # {...} v ts") BEFORE taking the
+    # sample value; sum all matching series
+    vals = [float(l.split(" # ")[0].rsplit(" ", 1)[1])
+            for l in text.splitlines()
+            if l.startswith(family) and not l.startswith("#")]
+    return sum(vals)
+
+
+spool = tempfile.mkdtemp(prefix="flight-gate-")
+cp = ControlPlane([POLICY], port=0, metrics_port=0, batching=True,
+                  flight_sample_rate=1.0, flight_dir=spool,
+                  shadow_verify_rate=1.0)
+cp.start(scan_interval=3600.0)
+adm, met = cp.admission.port, cp.metrics_server.server_address[1]
+try:
+    from kyverno_tpu.observability.verification import global_verifier
+
+    # drive admissions + a background scan with verification at 100%
+    for i in range(8):
+        status, _ = post(adm, "/validate", review(i))
+        assert status == 200, status
+    for i in range(4):
+        pod = json.loads(review(i))["request"]["object"]
+        pod["metadata"]["uid"] = f"fu{i}"
+        assert post(met, "/snapshot/upsert", json.dumps(pod))[0] == 200
+    assert post(met, "/scan", json.dumps({"full": True}))[0] == 200
+    assert global_verifier.drain(timeout=30.0)
+
+    # /debug/flight returns the recorded decisions
+    status, body = get(met, "/debug/flight?last=50")
+    assert status == 200, status
+    doc = json.loads(body)
+    assert len(doc["records"]) >= 8, len(doc["records"])
+    kinds = {r["kind"] for r in doc["records"]}
+    assert "admission" in kinds and "scan" in kinds, kinds
+    assert all(r["verdicts"] for r in doc["records"])
+
+    # clean run: checks happened, divergence counter is 0
+    text = get(met, "/metrics")[1].decode()
+    assert "kyverno_verification_checks_total" in text
+    assert counter_value(text, "kyverno_verification_checks_total"
+                         '{result="match"}') >= 8
+    assert counter_value(
+        text, "kyverno_verification_divergence_total") == 0.0
+
+    # arm a corrupt flip fault: shape-valid WRONG verdicts served —
+    # only the shadow verifier can catch it
+    from kyverno_tpu.resilience.faults import global_faults
+
+    global_faults.arm("tpu.dispatch", mode="corrupt", flip=True)
+    try:
+        for i in range(8, 12):
+            status, _ = post(adm, "/validate", review(i))
+            assert status == 200, status
+    finally:
+        global_faults.disarm()
+    assert global_verifier.drain(timeout=30.0)
+    text = get(met, "/metrics")[1].decode()
+    div = counter_value(text, "kyverno_verification_divergence_total")
+    assert div >= 1, "corrupt dispatch not caught as divergence"
+    # the full record + both verdicts landed in the spool
+    div_file = os.path.join(spool, "divergences.ndjson")
+    assert os.path.exists(div_file), os.listdir(spool)
+    lines = [json.loads(l) for l in open(div_file)]
+    assert lines and lines[0]["kind"] == "divergence"
+    assert lines[0]["record"]["resource"] is not None
+    # verdict-integrity SLO rides /readyz (advisory)
+    ready = json.loads(get(met, "/readyz")[1])
+    assert "verdict_integrity" in ready["slo"]["breached"], ready["slo"]
+    print(f"FLIGHT OK: {len(doc['records'])} records, "
+          f"divergences={div}, spool={sorted(os.listdir(spool))}")
 finally:
     cp.stop()
 EOF

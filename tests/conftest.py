@@ -51,6 +51,24 @@ def _fresh_observatory():
     yield
 
 
+# the flight recorder, shadow verifier, and op log are process-global
+# (like the caches); a test that configures a spool dir or a verify
+# rate must not leak it into the next test's assertions
+@pytest.fixture(autouse=True)
+def _fresh_flight_recorder():
+    from kyverno_tpu.observability.flightrecorder import global_flight
+    from kyverno_tpu.observability.log import global_oplog
+    from kyverno_tpu.observability.verification import global_verifier
+
+    global_verifier.reset()
+    global_flight.reset()
+    global_oplog.reset()
+    yield
+    global_verifier.reset()
+    global_flight.reset()
+    global_oplog.reset()
+
+
 @pytest.fixture
 def no_verdict_cache():
     """Opt-out for tests that count device dispatches on repeat scans

@@ -85,6 +85,31 @@ def test_serve_batching_help(capsys):
         assert flag in out
 
 
+def test_serve_help_covers_flight_flags(capsys):
+    with pytest.raises(SystemExit) as exc:
+        main(["serve", "--help"])
+    assert exc.value.code == 0
+    out = capsys.readouterr().out
+    for flag in ("--flight-sample-rate", "--flight-capacity",
+                 "--flight-dir", "--shadow-verify-rate", "--log-file"):
+        assert flag in out
+
+
+def test_replay_and_flight_dump_help(capsys):
+    with pytest.raises(SystemExit) as exc:
+        main(["replay", "--help"])
+    assert exc.value.code == 0
+    out = capsys.readouterr().out
+    for flag in ("--against", "--json", "--limit"):
+        assert flag in out
+    with pytest.raises(SystemExit) as exc:
+        main(["flight-dump", "--help"])
+    assert exc.value.code == 0
+    out = capsys.readouterr().out
+    for flag in ("--port", "--last", "--json", "--out"):
+        assert flag in out
+
+
 def test_apply_help_covers_observatory_flags(capsys):
     with pytest.raises(SystemExit) as exc:
         main(["apply", "--help"])

@@ -1,0 +1,456 @@
+"""Flight recorder, continuous shadow verification, and offline replay.
+
+The black box over the admission/scan ladder: ring bound + head-based
+sampling, always-capture of interesting outcomes, the shadow verifier
+catching a shape-valid device lie (corrupt flip fault) that every
+other defense misses, bit-identical replay round-trips across the
+device/cached/scalar paths, and spool-on-breaker-transition.
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from kyverno_tpu.api.policy import ClusterPolicy
+from kyverno_tpu.cluster.policycache import PolicyCache
+from kyverno_tpu.cluster.snapshot import ClusterSnapshot
+from kyverno_tpu.observability.flightrecorder import (FlightRecord,
+                                                      global_flight,
+                                                      load_capture)
+from kyverno_tpu.observability.verification import global_verifier
+from kyverno_tpu.resilience.breaker import tpu_breaker
+from kyverno_tpu.resilience.faults import global_faults
+from kyverno_tpu.webhooks.server import Handlers, handle_debug_path
+
+
+def make_policy(name="fr-pol"):
+    return ClusterPolicy.from_dict({
+        "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+        "metadata": {"name": name},
+        "spec": {"validationFailureAction": "Enforce", "rules": [{
+            "name": "named",
+            "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+            "validate": {"message": "m",
+                         "pattern": {"metadata": {"name": "?*"}}},
+        }]}})
+
+
+def review(i, name=None):
+    return {"request": {
+        "uid": f"u{i}", "operation": "CREATE",
+        "object": {"apiVersion": "v1", "kind": "Pod",
+                   "metadata": {"name": name or f"pod-{i}",
+                                "namespace": "d"},
+                   "spec": {"containers": [
+                       {"name": "c", "image": "nginx"}]}}}}
+
+
+@pytest.fixture
+def handlers():
+    cache = PolicyCache()
+    cache.set(make_policy())
+    h = Handlers(cache, ClusterSnapshot(), batching=True)
+    yield h
+    h.pipeline.stop()
+    h.batcher.stop()
+    global_faults.disarm()
+    tpu_breaker().reset()
+
+
+# ---------------------------------------------------------------------------
+# ring bound + sampling
+
+
+def test_ring_bound_and_head_sampling():
+    global_flight.configure(capacity=8, sample_rate=1.0)
+    for i in range(12):
+        global_flight.record_admission(
+            {"kind": "Pod", "metadata": {"name": f"p{i}"}},
+            [(("pol", "r"), 0)], "batched")
+    assert len(global_flight) == 8  # bounded: oldest 4 evicted
+    dump = global_flight.dump(last=5)
+    assert len(dump) == 5
+    # newest-last, and the oldest surviving record is seq 5 (1-based)
+    assert dump[-1]["resource"]["metadata"]["name"] == "p11"
+    assert [d["seq"] for d in dump] == sorted(d["seq"] for d in dump)
+
+    # rate 0: ok outcomes are sampled out (and counted), interesting
+    # outcomes still always capture
+    global_flight.reset()
+    global_flight.configure(capacity=8, sample_rate=0.0)
+    for i in range(5):
+        global_flight.record_admission({}, [(("pol", "r"), 0)], "batched")
+    assert len(global_flight) == 0
+    assert global_flight.state()["stats"]["sampled_out"] == 5
+
+
+def test_always_capture_interesting_outcomes():
+    global_flight.configure(sample_rate=0.0)  # sampling can NEVER drop these
+    # per-rule ERROR in the verdict rows
+    global_flight.record_admission({}, [(("pol", "r"), 4)], "batched")
+    # scalar fallback path (breaker OPEN / dispatch failure)
+    global_flight.record_admission({}, [(("pol", "r"), 0)],
+                                   "scalar_fallback")
+    # shed at the queue high-water mark
+    global_flight.record_admission({}, [(("pol", "r"), 0)], "shed")
+    # pattern-CONFIRM ladder exercised (approximate-DFA hit confirmed)
+    global_flight.record_admission({}, [(("pol", "r"), 0)], "batched",
+                                   confirm=True)
+    # evaluator exception
+    global_flight.record_admission({}, None, "batched",
+                                   error=RuntimeError("boom"))
+    outcomes = [r["outcome"] for r in global_flight.dump(10)]
+    assert outcomes == ["error", "fallback", "shed", "confirm", "error"]
+    # a plain ok outcome at rate 0 is dropped
+    global_flight.record_admission({}, [(("pol", "r"), 0)], "batched")
+    assert len(global_flight) == 5
+
+
+def test_body_cap_truncates_but_keeps_sha():
+    global_flight.configure(sample_rate=1.0, body_cap=64)
+    big = {"kind": "Pod", "metadata": {"name": "x" * 200}}
+    global_flight.record_admission(big, [(("pol", "r"), 0)], "batched")
+    rec = global_flight.dump(1)[0]
+    assert rec["resource"] is None and rec["resource_truncated"] is True
+    assert rec["resource_sha"]  # identity survives the cap
+
+
+# ---------------------------------------------------------------------------
+# serving-path integration: records, paths, /debug/flight
+
+
+def test_admission_records_and_debug_flight(handlers):
+    global_flight.configure(sample_rate=1.0)
+    for i in range(3):
+        out = handlers.validate(review(i))
+        assert out["response"]["allowed"] is True
+    # the flusher records AFTER resolving waiters: give it a beat
+    deadline = time.monotonic() + 5
+    while len(global_flight) < 3 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    recs = global_flight.dump(10)
+    assert len(recs) == 3
+    for rec in recs:
+        assert rec["kind"] == "admission"
+        assert rec["outcome"] == "ok" and rec["path"] == "batched"
+        assert rec["trace_id"]  # pipeline requests carry their trace
+        assert rec["policyset_revision"] is not None
+        assert rec["policyset_key"]
+        assert rec["resource_sha"]
+        assert ["fr-pol", "named", 0] in rec["verdicts"]
+        assert rec["timings"]["total_s"] >= 0
+    # repeat of an identical manifest resolves from the verdict cache
+    # at submit time -> a cached-path record (rate 1.0 captures it)
+    handlers.validate(review(0))
+    deadline = time.monotonic() + 5
+    while len(global_flight) < 4 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert global_flight.dump(1)[0]["path"] == "cached"
+
+    # the debug router serves the ring
+    code, body, ctype = handle_debug_path("/debug/flight?last=2", handlers)
+    assert code == 200 and ctype == "application/json"
+    doc = json.loads(body)
+    assert len(doc["records"]) == 2
+    assert doc["state"]["records"] == 4
+    assert "verification" in doc
+
+
+def test_fallback_records_under_dispatch_fault(handlers):
+    """Breaker-ladder degradation is an always-capture outcome even at
+    sample rate 0 — the interesting path IS the black box's job."""
+    global_flight.configure(sample_rate=0.0)
+    global_faults.arm("tpu.dispatch", mode="raise", p=1.0)
+    try:
+        out = handlers.validate(review(0))
+        assert out["response"]["allowed"] is True  # scalar ladder answers
+    finally:
+        global_faults.disarm()
+    deadline = time.monotonic() + 5
+    while len(global_flight) < 1 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    rec = global_flight.dump(1)[0]
+    assert rec["outcome"] == "fallback"
+    assert rec["path"] == "scalar_fallback"
+    assert rec["breaker"] in ("closed", "open", "half_open")
+
+
+# ---------------------------------------------------------------------------
+# continuous shadow verification
+
+
+def test_shadow_verifier_clean_chaos_run(handlers):
+    """Chaos (dispatch faults p=0.5 -> breaker trips + scalar
+    fallbacks, cache replays from repeats) with 100% verification:
+    every rung must agree with the oracle — zero divergences."""
+    global_flight.configure(sample_rate=1.0)
+    global_verifier.configure(rate=1.0, synchronous=True)
+    global_faults.arm("tpu.dispatch", mode="raise", p=0.5, seed=7)
+    try:
+        for i in range(8):
+            handlers.validate(review(i))
+        for i in range(8):  # repeats: cached submit-time replays
+            handlers.validate(review(i))
+    finally:
+        global_faults.disarm()
+    handlers.pipeline.stop()  # flusher done -> all records offered
+    stats = global_verifier.state()["stats"]
+    assert stats["checked"] >= 8
+    assert stats["divergences"] == 0, stats
+
+
+def test_shadow_verifier_catches_corrupt_dispatch(handlers, tmp_path):
+    """A corrupt flip fault at tpu.dispatch produces a SHAPE-VALID
+    wrong verdict table — it clears device-result validation, the
+    breaker never trips, and the wrong verdict is served. Only the
+    shadow verifier can see it: divergence counted, full record +
+    both verdicts spooled, verdict-integrity SLO burning."""
+    from kyverno_tpu.observability.analytics import global_slo
+    from kyverno_tpu.observability.metrics import global_registry
+
+    spool = tmp_path / "flight"
+    global_flight.configure(sample_rate=1.0, spool_dir=str(spool))
+    global_verifier.configure(rate=1.0, synchronous=True)
+    before = global_registry.verification_divergence.value()
+    global_faults.arm("tpu.dispatch", mode="corrupt", flip=True)
+    try:
+        out = handlers.validate(review(0, name="healthy-pod"))
+        # PASS flipped to FAIL: the Enforce policy now denies — the
+        # served decision is wrong, and nothing in the ladder noticed
+        assert out["response"]["allowed"] is False
+    finally:
+        global_faults.disarm()
+    handlers.pipeline.stop()
+    stats = global_verifier.state()["stats"]
+    assert stats["divergences"] >= 1, stats
+    assert global_registry.verification_divergence.value() >= before + 1
+    # the divergence spool carries the record and both verdict tables
+    div_file = spool / "divergences.ndjson"
+    assert div_file.exists()
+    doc = json.loads(div_file.read_text().splitlines()[0])
+    assert doc["kind"] == "divergence"
+    assert doc["record"]["resource"]["metadata"]["name"] == "healthy-pod"
+    got = {(p, r): c for p, r, c in doc["got"]}
+    exp = {(p, r): c for p, r, c in doc["expected"]}
+    assert got[("fr-pol", "named")] == 2 and exp[("fr-pol", "named")] == 0
+    # verdict-integrity SLO: advisory burn on /readyz
+    assert "verdict_integrity" in global_slo.state()["breached"]
+
+    # offline replay of the spooled divergence reproduces the diff
+    from kyverno_tpu.cli.flight import replay_capture
+
+    records = load_capture(str(div_file))
+    rep = replay_capture(records, [make_policy()], against="both")
+    assert rep["divergent_records"] == 1 and rep["match"] is False
+    cells = rep["diffs"][0]["device"]["cells"]
+    assert cells == [{"policy": "fr-pol", "rule": "named",
+                      "recorded": "fail", "replayed": "pass"}]
+    assert rep["device_vs_scalar_consistent"] is True
+
+
+def test_verifier_skips_impure_engines():
+    """An engine whose evaluation is not a pure function of the record
+    (runtime context I/O) is SKIPPED, visibly — a false divergence
+    alarm would be worse than the blind spot."""
+
+    class FakeEngine:
+        cache_eligible = False
+
+    global_verifier.configure(rate=1.0, synchronous=True)
+    rec = FlightRecord("admission", "ok", "batched",
+                       {"kind": "Pod"}, [(("p", "r"), 0)],
+                       engine=FakeEngine())
+    global_verifier.offer(rec)
+    stats = global_verifier.state()["stats"]
+    assert stats["skipped_impure"] == 1 and stats["checked"] == 0
+
+
+def test_verifier_async_thread_drains():
+    """The background (non-synchronous) mode: offer enqueues, the
+    low-priority thread verifies, drain() observes completion."""
+    cache = PolicyCache()
+    cache.set(make_policy())
+    h = Handlers(cache, ClusterSnapshot(), batching=True)
+    try:
+        global_flight.configure(sample_rate=1.0)
+        global_verifier.configure(rate=1.0, synchronous=False)
+        for i in range(4):
+            h.validate(review(i))
+        deadline = time.monotonic() + 5
+        while len(global_flight) < 4 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert global_verifier.drain(timeout=10.0)
+        stats = global_verifier.state()["stats"]
+        assert stats["checked"] == 4 and stats["divergences"] == 0
+    finally:
+        h.pipeline.stop()
+        h.batcher.stop()
+
+
+# ---------------------------------------------------------------------------
+# offline replay round-trips
+
+
+def test_replay_roundtrip_bit_identical_across_paths(handlers):
+    """One capture spanning the device path, the submit-time cached
+    path, and the breaker-OPEN scalar path replays bit-identically
+    against the same policy set through BOTH replay evaluators."""
+    global_flight.configure(sample_rate=1.0)
+    for i in range(4):
+        handlers.validate(review(i))           # device path
+    handlers.validate(review(0))               # cached path
+    global_faults.arm("tpu.dispatch", mode="raise", p=1.0)
+    try:
+        for i in range(4, 7):
+            handlers.validate(review(i))       # scalar-fallback path
+    finally:
+        global_faults.disarm()
+    handlers.pipeline.stop()
+    assert len(global_flight) == 8
+    paths = {r["path"] for r in global_flight.dump(20)}
+    assert {"batched", "cached", "scalar_fallback"} <= paths
+
+    from kyverno_tpu.cli.flight import replay_capture
+
+    rep = replay_capture(global_flight.dump(20), [make_policy()],
+                         against="both")
+    assert rep["replayed"] == 8
+    assert rep["match"] is True, rep["diffs"]
+    assert rep["device_vs_scalar_consistent"] is True
+
+
+def test_replay_cli_roundtrip_json(tmp_path, capsys):
+    """The replay command end to end: spool -> files -> exit code 0 on
+    a clean round-trip, --json document parseable for artifacts."""
+    import argparse
+    import yaml
+
+    from kyverno_tpu.cli.flight import run_replay
+
+    global_flight.configure(sample_rate=1.0, spool_dir=str(tmp_path))
+    cache = PolicyCache()
+    cache.set(make_policy())
+    h = Handlers(cache, ClusterSnapshot(), batching=True)
+    try:
+        for i in range(3):
+            h.validate(review(i))
+    finally:
+        h.pipeline.stop()
+        h.batcher.stop()
+    capture = global_flight.spool(reason="test", force=True)
+    assert capture and os.path.exists(capture)
+    pol_file = tmp_path / "pol.yaml"
+    pol_file.write_text(yaml.safe_dump(make_policy().raw))
+    args = argparse.Namespace(capture=capture, policies=[str(pol_file)],
+                              against="both", json=True, limit=0)
+    rc = run_replay(args)
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0 and doc["match"] is True and doc["replayed"] == 3
+
+
+# ---------------------------------------------------------------------------
+# spool triggers
+
+
+def test_spool_on_breaker_transition(handlers, tmp_path):
+    spool = tmp_path / "spool"
+    global_flight.configure(sample_rate=1.0, spool_dir=str(spool))
+    handlers.validate(review(0))  # something in the ring to spool
+    global_faults.arm("tpu.dispatch", mode="raise", p=1.0)
+    try:
+        for i in range(1, 5):  # trip the breaker (threshold 3)
+            handlers.validate(review(i))
+    finally:
+        global_faults.disarm()
+    handlers.pipeline.stop()
+    assert tpu_breaker().state == "open"
+    # the spool runs on a detached thread (the transition fires under
+    # the breaker lock): poll briefly for the file
+    deadline = time.monotonic() + 5
+    files = []
+    while time.monotonic() < deadline:
+        files = [f for f in os.listdir(spool) if f.startswith("flight-")] \
+            if spool.exists() else []
+        if files:
+            break
+        time.sleep(0.05)
+    assert files, "breaker transition did not spool the flight ring"
+    assert any("breaker-tpu" in f for f in files)
+    # the spool is a valid NDJSON capture
+    recs = load_capture(str(spool / files[0]))
+    assert recs and all("outcome" in r for r in recs)
+    tpu_breaker().reset()
+
+
+# ---------------------------------------------------------------------------
+# scan-side records
+
+
+def test_scan_chunk_records_and_verification():
+    from kyverno_tpu.cluster import (BackgroundScanService,
+                                     ReportAggregator)
+
+    cache = PolicyCache()
+    cache.set(make_policy())
+    snap = ClusterSnapshot()
+    for i in range(4):
+        snap.upsert({"apiVersion": "v1", "kind": "Pod",
+                     "metadata": {"name": f"sp{i}", "namespace": "d",
+                                  "uid": f"su{i}"},
+                     "spec": {"containers": [{"name": "c",
+                                              "image": "nginx"}]}})
+    svc = BackgroundScanService(snap, cache,
+                                aggregator=ReportAggregator())
+    global_flight.configure(sample_rate=1.0)
+    global_verifier.configure(rate=1.0, synchronous=True)
+    assert svc.scan_once(full=True) == 4
+    recs = [r for r in global_flight.dump(20) if r["kind"] == "scan"]
+    assert len(recs) == 4
+    for rec in recs:
+        assert rec["outcome"] == "ok"
+        assert rec["resource_sha"]
+        assert rec["policyset_key"]
+        assert ["fr-pol", "named", 0] in rec["verdicts"]
+    stats = global_verifier.state()["stats"]
+    assert stats["checked"] == 4 and stats["divergences"] == 0
+
+
+# ---------------------------------------------------------------------------
+# structured operational log
+
+
+def test_oplog_jsonl_and_breaker_event(tmp_path):
+    from kyverno_tpu.observability.log import global_oplog
+    from kyverno_tpu.resilience.breaker import CircuitBreaker
+
+    path = tmp_path / "ops.jsonl"
+    global_oplog.configure(path=str(path), stderr=False)
+    b = CircuitBreaker(name="oplog-test", failure_threshold=2)
+    b.record_failure()
+    b.record_failure()  # -> OPEN
+    global_oplog.emit("custom_event", level="warn", foo="bar")
+    lines = [json.loads(l) for l in path.read_text().splitlines()]
+    events = [l["event"] for l in lines]
+    assert "breaker_transition" in events
+    trans = next(l for l in lines if l["event"] == "breaker_transition")
+    assert trans["breaker"] == "oplog-test"
+    assert trans["from_state"] == "closed" and trans["to_state"] == "open"
+    assert trans["level"] == "warn"
+    custom = next(l for l in lines if l["event"] == "custom_event")
+    assert custom["foo"] == "bar"
+    assert all("ts" in l for l in lines)
+
+
+def test_fault_flip_rejected_outside_corrupt_mode():
+    from kyverno_tpu.resilience.faults import FaultConfigError
+
+    with pytest.raises(FaultConfigError):
+        global_faults.arm("tpu.dispatch", mode="raise", flip=True)
+    # and the env-string spelling parses
+    n = global_faults.arm_from_string("tpu.dispatch:corrupt:flip=1")
+    assert n == 1
+    assert global_faults.armed()["tpu.dispatch"].flip is True
+    global_faults.disarm()

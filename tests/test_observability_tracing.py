@@ -215,7 +215,17 @@ def test_exposition_format_is_scrapeable():
     slo = SloTracker(metrics=reg)
     slo.record_admission(0.004)
     slo.record_scan(coverage=0.97)
+    # verdict-integrity: one diverged check drives the divergence
+    # gauge + breached flag; the counter exemplar carries the trace id
+    slo.record_verification(True)
     reg.feed_starvation.set(0.25)
+    reg.flight_records.inc({"outcome": "fallback"})
+    reg.flight_sampled_out.inc()
+    reg.flight_ring_size.set(3)
+    reg.flight_spools.inc({"reason": "breaker-tpu-closed-open"})
+    reg.verification_checks.inc({"result": "diverge"})
+    reg.verification_divergence.inc(exemplar={"trace_id": "ef" * 16})
+    reg.verification_queue_depth.set(0)
 
     text = reg.exposition()
     # every new family is present (cardinality guard has its own test)
@@ -226,8 +236,18 @@ def test_exposition_format_is_scrapeable():
                 "kyverno_slo_admission_burn_rate",
                 "kyverno_slo_scan_freshness_seconds",
                 "kyverno_slo_device_coverage_ratio", "kyverno_slo_breached",
-                "kyverno_tpu_feed_starvation_ratio"):
+                "kyverno_tpu_feed_starvation_ratio",
+                "kyverno_flight_records_total",
+                "kyverno_flight_sampled_out_total",
+                "kyverno_flight_ring_records", "kyverno_flight_spools_total",
+                "kyverno_verification_checks_total",
+                "kyverno_verification_divergence_total",
+                "kyverno_verification_queue_depth",
+                "kyverno_slo_verification_divergences"):
         assert f"# TYPE {fam} " in text, fam
+    # the divergence counter line carries its trace-id exemplar
+    assert any(l.startswith("kyverno_verification_divergence_total")
+               and " # {" in l for l in text.splitlines())
     assert text.endswith("\n")
     helped, typed = set(), {}
     hist_series = {}
@@ -251,8 +271,12 @@ def test_exposition_format_is_scrapeable():
         assert owner in typed, f"sample before TYPE: {line!r}"
         assert owner in helped, f"sample without HELP: {line!r}"
         if m.group("exemplar"):
-            assert typed[owner] == "histogram", line
-            assert name.endswith("_bucket"), line
+            # OpenMetrics: exemplars attach to histogram buckets and to
+            # counter samples (the divergence counter carries the
+            # diverging record's trace id) — never to gauges
+            assert typed[owner] in ("histogram", "counter"), line
+            if typed[owner] == "histogram":
+                assert name.endswith("_bucket"), line
         if typed.get(base) == "histogram" and name.endswith("_bucket"):
             assert "le" in parsed, line
             key = (base, tuple(sorted((k, v) for k, v in parsed.items()
