@@ -36,6 +36,11 @@ Other configs (run `python bench.py <name>`):
              on vs off (BENCH_ENCODE_RESOURCES / _CHUNK /
              _WORKERS_LIST); the encode-bottleneck roadmap item's
              measured leg
+  --analyze  policy-set static analysis (analysis/): witness synthesis
+             + cross-product anomaly detection over PSS + the seeded
+             anomaly fixtures; reports analysis wall time (cold/warm),
+             witnesses synthesized, witness evals/s, anomaly counts,
+             and device dispatches (BENCH_ANALYZE_TILE)
   --capture FILE  drive the admission leg with the resource bodies of
              a spooled flight capture (flight-dump --out / --flight-dir
              spool) instead of the synthetic snapshot (BENCH_CAPTURE).
@@ -1201,6 +1206,72 @@ def bench_patterns(n_resources=None, tile=2048):
     }
 
 
+def bench_analyze(tile=None):
+    """Policy-set static analysis as a device workload (analysis/):
+    witness synthesis + cross-product anomaly detection over the PSS
+    corpus plus the seeded anomaly fixtures. Measures the cold run
+    (XLA builds at the witness tile buckets) and the warm run — the
+    steady-state cost `serve --analyze-on-swap` pays per hot swap —
+    and asserts every seeded anomaly class is detected (confirmed
+    through the scalar oracle) with the PSS rules adding zero."""
+    import yaml
+
+    from kyverno_tpu.analysis import analyze_engine
+    from kyverno_tpu.api.policy import ClusterPolicy, is_policy_document
+    from kyverno_tpu.policies import load_pss_policies
+    from kyverno_tpu.policy.autogen import expand_policy
+    from kyverno_tpu.tpu.engine import TpuEngine
+
+    if tile is None:
+        tile = int(os.environ.get("BENCH_ANALYZE_TILE", "256"))
+    fixture = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "tests", "golden", "analysis",
+                           "seeded_anomalies.yaml")
+    with open(fixture) as f:
+        seeded = [expand_policy(ClusterPolicy.from_dict(d))
+                  for d in yaml.safe_load_all(f)
+                  if isinstance(d, dict) and is_policy_document(d)]
+    policies = [expand_policy(p) for p in load_pss_policies()] + seeded
+    eng = TpuEngine(policies)
+
+    t0 = time.perf_counter()
+    report = analyze_engine(eng, tile=tile)
+    t_cold = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    report = analyze_engine(eng, tile=tile)  # warm: the per-swap cost
+    t_warm = time.perf_counter() - t0
+
+    counts = report.counts()
+    for kind in ("shadow", "conflict", "redundant", "dead"):
+        assert counts[kind] >= 1, f"seeded {kind} anomaly not detected"
+    st = report.stats
+    assert st["device_dispatches"] >= 1, "witness eval must be batched"
+    assert st.get("refuted", 0) == 0, \
+        f"oracle refuted {st['refuted']} device-classified candidates"
+    import jax
+
+    return {
+        "metric": "witness_evals_per_sec",
+        "value": st["witness_evals_per_s"],
+        "unit": "witness/s",
+        "backend": jax.default_backend(),
+        "rules_total": st["rules_total"],
+        "rules_unanalyzable": st["rules_unanalyzable"],
+        "witnesses": st["witnesses"],
+        "witnesses_by_intent": st["witnesses_by_intent"],
+        "device_dispatches": st["device_dispatches"],
+        "anomalies": counts,
+        "confirm": {"checked": st.get("checked_cells", 0),
+                    "confirmed": st.get("confirmed_cells", 0),
+                    "refuted": st.get("refuted", 0)},
+        "wall_seconds_cold": round(t_cold, 3),
+        "wall_seconds": round(t_warm, 3),
+        "phase_seconds": {k: st.get(f"{k}_s", 0.0)
+                          for k in ("synthesize", "evaluate", "classify",
+                                    "confirm")},
+    }
+
+
 FNS = {
     "scan": lambda: bench_scan(),
     "match": lambda: bench_match(),
@@ -1212,6 +1283,7 @@ FNS = {
     "cached": lambda: bench_cached(),
     "encode_scaling": lambda: bench_encode_scaling(),
     "patterns": lambda: bench_patterns(),
+    "analyze": lambda: bench_analyze(),
 }
 
 
@@ -1441,7 +1513,8 @@ def run_all():
         out["mixed_corpus_coverage"] = {"error": repr(e)[:300]}
     emit(out)
     for name in ("match", "overlay", "apply", "admission", "fallback",
-                 "cached", "encode_scaling", "patterns", "churn"):
+                 "cached", "encode_scaling", "patterns", "analyze",
+                 "churn"):
         if only and name not in only:
             continue
         t0 = time.perf_counter()
@@ -1521,6 +1594,8 @@ def main():
         config = "cached"
     if config == "--patterns":  # flag spelling of the patterns config
         config = "patterns"
+    if config == "--analyze":  # flag spelling of the analyze config
+        config = "analyze"
     if config in ("capture", "--capture"):
         # replay a spooled flight capture as the admission workload:
         # `python bench.py --capture FILE` (kyverno-tpu flight-dump

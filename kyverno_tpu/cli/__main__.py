@@ -9,6 +9,7 @@ from __future__ import annotations
 import argparse
 import sys
 
+from . import analyze as analyze_cmd
 from . import apply as apply_cmd
 from . import chainsaw as chainsaw_cmd
 from . import flight as flight_cmd
@@ -48,6 +49,7 @@ def build_parser() -> argparse.ArgumentParser:
     )
     sub = parser.add_subparsers(dest="command", required=True)
     apply_cmd.add_parser(sub)
+    analyze_cmd.add_parser(sub)
     jp_cmd.add_parser(sub)
     test_cmd.add_parser(sub)
     serve_cmd.add_parser(sub)

@@ -225,17 +225,22 @@ def replay_capture(records: List[Dict[str, Any]], policies: list,
             cols = []
             for rec, op, info in zip(usable, operations, infos):
                 ns = rec.get("namespace") or ""
+                # live_n=0: replayed columns must not re-ingest into
+                # the rule-stats observatory (in-process callers — the
+                # bench verification rollup — share the global
+                # accumulator with the capture's own run)
                 res = eng._scan_uncached([rec["resource"]],
                                          {ns: rec.get("ns_labels") or {}},
                                          operations=[op],
-                                         admission_infos=[info])
+                                         admission_infos=[info],
+                                         live_n=0)
                 cols.append(dict(zip(
                     res.rules, (int(c) for c in res.verdicts[:, 0]))))
             per_mode["device"] = cols
         else:
             res = eng._scan_uncached(resources, nsmap,
                                      operations=operations,
-                                     admission_infos=infos)
+                                     admission_infos=infos, live_n=0)
             per_mode["device"] = [
                 dict(zip(res.rules,
                          (int(c) for c in res.verdicts[:, ci])))

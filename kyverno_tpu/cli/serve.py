@@ -147,6 +147,14 @@ def add_parser(sub: argparse._SubParsersAction) -> None:
                         "restarts, SLO burns, divergences) to PATH as "
                         "JSONL; without it events go to stderr in human "
                         "format")
+    p.add_argument("--analyze-on-swap", action="store_true",
+                   help="run policy-set static analysis (witness "
+                        "synthesis + shadow/conflict/redundant/dead "
+                        "detection, scalar-oracle confirmed) on the "
+                        "compile-ahead worker after every successful "
+                        "hot swap; findings land on the op log, "
+                        "kyverno_analysis_* metrics, /debug/analysis, "
+                        "and the /debug/rules never-fired correlation")
     p.add_argument("--dfa-state-budget", type=int, default=None, metavar="N",
                    help="per-pattern DFA state budget for device-side "
                         "string matching: exact tables up to N states, "
@@ -164,7 +172,8 @@ class ControlPlane:
                  batch_config=None, request_timeout_s=10.0,
                  policy_watch=None, reload_interval=2.0,
                  flight_sample_rate=None, flight_capacity=None,
-                 flight_dir=None, shadow_verify_rate=None):
+                 flight_dir=None, shadow_verify_rate=None,
+                 analyze_on_swap=False):
         # flight recorder + shadow verifier are process-global (like
         # the caches); only explicitly-passed knobs are applied so a
         # test-configured recorder survives ControlPlane construction
@@ -220,6 +229,13 @@ class ControlPlane:
         # reconciliation ride every cache mutation so hot-reloaded
         # policies also refresh the materialized admission plumbing
         self.lifecycle = self.handlers.lifecycle
+        if analyze_on_swap:
+            # the compile-ahead worker lints each promoted version off
+            # the request path (lifecycle/manager.py run_lint)
+            from ..analysis import global_analysis
+
+            global_analysis.lint_enabled = True
+            self.lifecycle.analyze_on_swap = True
         self.cache.subscribe(self._on_policy_change)
         self.watcher = None
         if policy_watch:
@@ -430,7 +446,10 @@ def run(args: argparse.Namespace) -> int:
                       flight_sample_rate=args.flight_sample_rate,
                       flight_capacity=args.flight_capacity,
                       flight_dir=args.flight_dir,
-                      shadow_verify_rate=args.shadow_verify_rate)
+                      shadow_verify_rate=args.shadow_verify_rate,
+                      analyze_on_swap=args.analyze_on_swap)
+    if args.analyze_on_swap:
+        global_oplog.emit("analyze_on_swap_enabled")
     if args.policy_watch:
         global_oplog.emit("policy_watch_enabled", dir=args.policy_watch,
                           interval_s=args.reload_interval)

@@ -174,6 +174,18 @@ class PolicySetLifecycleManager:
         self._wake = threading.Event()
         self._stopped = threading.Event()
         self._worker: Optional[threading.Thread] = None
+        # compile-ahead lint (analysis/): after a successful swap the
+        # worker runs static analysis on the ACTIVE engine — no
+        # recompile, no XLA warm beyond the tile shape buckets — and
+        # publishes anomalies via the OpLog / kyverno_analysis_*
+        # metrics / /debug/analysis. Probing-style priority: the lint
+        # runs strictly AFTER reconcile returns (the swap is already
+        # atomic and served) and aborts between tiles the moment a new
+        # mutation wakes the worker, so a large set's analysis never
+        # delays the next swap either.
+        self.analyze_on_swap = False
+        self.lint_tile = 128
+        self._linted_key: Optional[Tuple[str, Tuple[str, ...]]] = None
         # True while _bisect single-policy probe compiles run (always
         # under _compile_lock): compile_fns use it to skip work that
         # only the version being promoted needs (e.g. XLA warm-up)
@@ -250,6 +262,12 @@ class PolicySetLifecycleManager:
                 # reconcile records its own failures; the worker thread
                 # must survive anything (a dead worker = silent staleness)
                 pass
+            if self.analyze_on_swap:
+                try:
+                    self.run_lint()
+                except Exception:
+                    pass  # the lint is advisory; it must never kill
+                    # the compile-ahead worker
 
     # -- serving-side acquisition
 
@@ -550,6 +568,63 @@ class PolicySetLifecycleManager:
         with self._lock:
             n = len(self._quarantine)
         self.metrics.policyset_quarantined.set(n)
+
+    # -- compile-ahead lint (analysis/)
+
+    def run_lint(self, force: bool = False) -> Optional[Any]:
+        """Static analysis of the ACTIVE version's already-compiled
+        engine (no recompile — the engine IS the artifact the swap
+        promoted; its XLA programs are already warm from serving).
+        Idempotent per (content hash, quarantine set); ``force``
+        re-runs regardless. Returns the AnalysisReport, or None when
+        nothing is active, the version was already linted, or a
+        pending policy-set change preempted the run (the worker's next
+        wake retries — the linted key is only recorded on success)."""
+        version = self._active
+        if version is None:
+            return None
+        key = (version.snapshot.content_hash, version.quarantined)
+        if not force and key == self._linted_key:
+            return None
+        from ..analysis import global_analysis, run_analysis
+
+        global_analysis.lint_enabled = True
+
+        def should_abort() -> bool:
+            # a pending policy-set change preempts the lint: the cache
+            # revision moving past the linted snapshot is the signal (a
+            # raw _wake check would wedge sync-mode callers — nothing
+            # clears the event without a worker)
+            try:
+                stale = self.cache.revision != version.snapshot.revision
+            except Exception:
+                stale = False
+            return (self._stopped.is_set() or self._active is not version
+                    or stale)
+
+        t0 = time.monotonic()
+        with global_tracer.span("policyset.lint", revision=version.revision,
+                                policies=len(version.policies)):
+            report = run_analysis(version.engine, tile=self.lint_tile,
+                                  should_abort=should_abort)
+        if report is None:
+            # preempted between tiles: the mutation that aborted us
+            # already set _wake, so the worker loops straight back into
+            # reconcile and re-lints whatever version wins
+            return None
+        self._linted_key = key
+        self.stats["lints"] = self.stats.get("lints", 0) + 1
+        for a in report.anomalies:
+            _oplog("policy_anomaly", level="warn", kind=a.kind,
+                   policy=a.policy, rule=a.rule,
+                   other=(f"{a.other_policy}/{a.other_rule}"
+                          if a.other_policy or a.other_rule else ""),
+                   detail=a.detail[:200], revision=version.revision)
+        _oplog("policyset_lint", revision=version.revision,
+               witnesses=report.stats.get("witnesses", 0),
+               anomalies=report.counts(),
+               wall_s=round(time.monotonic() - t0, 3))
+        return report
 
     # -- introspection
 

@@ -87,6 +87,23 @@ def policy_spec_hash(policy: Any) -> str:
     return hashlib.sha256(payload.encode()).hexdigest()[:16]
 
 
+def _static_status(policy: str, rule: str) -> Dict[str, Any]:
+    """The static-analysis correlation for one never-fired rule: once
+    the lifecycle lint has run, a never-fired /debug/rules entry says
+    WHY — ``static: "dead"`` (can never fire), ``static:
+    "shadowed_by"`` + ``by`` (another rule decides first), or
+    ``static: "ok"`` (just no traffic yet). Empty before any analysis
+    (or when the rule's match shape was not synthesizable), so the
+    field's absence itself means "no static evidence". Lazy import:
+    analysis/ pulls engine machinery this module must not load."""
+    try:
+        from ..analysis import global_analysis
+
+        return global_analysis.static_for(policy, rule) or {}
+    except Exception:
+        return {}
+
+
 class RuleIdent(NamedTuple):
     """Stable identity of one rule row in a compiled set."""
 
@@ -237,7 +254,8 @@ class RuleStatsAccumulator:
             "never_fired": [
                 {"policy": r["policy"], "rule": r["rule"],
                  "policy_hash": r["policy_hash"], "age_s": r["age_s"],
-                 "on_device": r["on_device"], "evals": r["evals"]}
+                 "on_device": r["on_device"], "evals": r["evals"],
+                 **_static_status(r["policy"], r["rule"])}
                 for r in never],
             "policies": self.policy_aggregates(),
         }

@@ -550,7 +550,16 @@ class FlightRecorder:
             daemon=True, name="flight-spool").start()
 
     def on_slo_burn(self, slos: Sequence[str]) -> None:
-        self.spool(reason="slo-" + "-".join(sorted(slos)))
+        # DETACHED like the breaker spool: the burn is observed by
+        # whatever thread refreshed the SLO gauges — including the
+        # /metrics scrape via the collect hook — and serializing the
+        # whole ring inline would time out the scrape at incident onset
+        if not self.spool_dir:
+            return
+        threading.Thread(
+            target=self.spool,
+            kwargs={"reason": "slo-" + "-".join(sorted(slos))},
+            daemon=True, name="flight-spool").start()
 
 
 global_flight = FlightRecorder()

@@ -322,6 +322,26 @@ class MetricsRegistry:
             "kyverno_slo_verification_divergences",
             "verdict-integrity SLO: shadow-verification divergences in "
             "the rolling window, by window (target: 0)")
+        # policy-set static analysis (analysis/): witness-synthesis +
+        # cross-product anomaly detection — lint run outcomes, the last
+        # completed report's anomaly counts by kind, corpus size, and
+        # the per-phase wall split (synthesize/evaluate/classify/
+        # confirm) so a slow lint is attributable at a glance
+        self.analysis_runs = self.counter(
+            "kyverno_analysis_runs_total",
+            "static-analysis runs by outcome (ok/aborted/error)")
+        self.analysis_anomalies = self.gauge(
+            "kyverno_analysis_anomalies",
+            "confirmed anomalies in the last completed analysis, by "
+            "kind (shadow/conflict/redundant/dead)")
+        self.analysis_witnesses = self.gauge(
+            "kyverno_analysis_witnesses",
+            "synthesized witness resources evaluated by the last "
+            "completed analysis")
+        self.analysis_wall_seconds = self.gauge(
+            "kyverno_analysis_wall_seconds",
+            "wall seconds of the last completed analysis, by phase "
+            "(synthesize/evaluate/classify/confirm)")
         # serving pipeline instruments (serving/batcher.py): queue
         # depth, batch occupancy, flush reasons, shed/expiry counters,
         # and submit-to-verdict latency (p50-p99 read from buckets)

@@ -1047,6 +1047,15 @@ def handle_debug_path(path: str, handlers: Optional[Handlers] = None
                 "application/json"
         doc = global_rule_stats.report(top=top)
         return 200, (json.dumps(doc) + "\n").encode(), "application/json"
+    if route == "/debug/analysis":
+        # the last completed policy-set static analysis (analysis/):
+        # confirmed anomalies, per-rule static status, witness/phase
+        # stats, and lint-run accounting — populated by the lifecycle
+        # lint (`serve --analyze-on-swap`) or any run_analysis caller
+        from ..analysis import global_analysis
+
+        doc = global_analysis.report_dict()
+        return 200, (json.dumps(doc) + "\n").encode(), "application/json"
     if route == "/debug/flight":
         # the flight recorder's ring, newest-last: the last N decisions
         # with bodies (size-capped), verdict columns, dispatch path,
@@ -1250,6 +1259,9 @@ class AdmissionServer:
         /debug/utilization        feed-starvation ratio, pipeline
                                   overlap, flusher state split, SLO
                                   burn state
+        /debug/analysis           policy-set static analysis: confirmed
+                                  anomalies, per-rule static status,
+                                  witness stats, lint-run accounting
         /debug/flight[?last=N]    flight-recorder ring: the last N
                                   recorded admission/scan decisions
                                   (bodies, verdicts, path, trace ids)

@@ -1,0 +1,169 @@
+#!/bin/bash
+# Static-analysis gate: tier-1 must hold, then `kyverno-tpu analyze`
+# must detect every seeded anomaly class on the golden fixture corpus
+# (and report zero on the clean reference corpus, with --fail-on exit
+# codes honored), then a serve control plane with --analyze-on-swap
+# must publish the lint through /debug/analysis, the /debug/rules
+# static correlation, and parseable kyverno_analysis_* metric families
+# — without the lint delaying a policy hot swap.
+#
+# Usage: ./scripts_analyze_gate.sh
+set -o pipefail
+cd "$(dirname "$0")"
+rc=0
+
+echo "=== leg 1/3: tier-1 ==="
+KYVERNO_TPU_FAULTS= JAX_PLATFORMS=cpu timeout -k 10 870 \
+  python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors \
+  -p no:cacheprovider -p no:xdist -p no:randomly || rc=1
+
+echo "=== leg 2/3: analyze CLI on the golden corpora ==="
+KYVERNO_TPU_FAULTS= JAX_PLATFORMS=cpu timeout -k 10 300 python - <<'EOF' || rc=1
+import json
+import subprocess
+import sys
+
+SEEDED = "tests/golden/analysis/seeded_anomalies.yaml"
+CLEAN = "tests/golden/analysis/clean_corpus.yaml"
+
+
+def analyze(*args):
+    p = subprocess.run([sys.executable, "-m", "kyverno_tpu.cli",
+                        "analyze", *args],
+                       capture_output=True, text=True, timeout=240)
+    return p.returncode, p.stdout
+
+
+code, out = analyze(SEEDED, "--json")
+assert code == 0, (code, out)
+doc = json.loads(out.strip().splitlines()[-1])
+counts = doc["counts"]
+for kind in ("shadow", "conflict", "redundant", "dead"):
+    assert counts[kind] >= 1, f"seeded {kind} not detected: {counts}"
+pairs = {(a["kind"], a["policy"], a["rule"]) for a in doc["anomalies"]}
+assert ("shadow", "shadowed-web", "web-nonroot") in pairs, pairs
+assert ("dead", "dead-prod", "dead-rule") in pairs, pairs
+assert all(a["confirmed"] for a in doc["anomalies"]), \
+    "unconfirmed anomaly surfaced"
+assert doc["stats"]["device_dispatches"] >= 1
+assert doc["stats"]["refuted"] == 0
+
+code, out = analyze(CLEAN, "--json")
+assert code == 0, (code, out)
+clean = json.loads(out.strip().splitlines()[-1])
+assert clean["counts"] == {"shadow": 0, "conflict": 0,
+                           "redundant": 0, "dead": 0}, clean["counts"]
+
+# --fail-on exit codes: matching kind -> 1, non-matching -> 0
+assert analyze(SEEDED, "--fail-on", "shadow")[0] == 1
+assert analyze(CLEAN, "--fail-on", "any")[0] == 0
+assert analyze(CLEAN, "--fail-on", "bogus")[0] == 2
+print(f"ANALYZE CLI OK: seeded={counts}, "
+      f"witnesses={doc['stats']['witnesses']}, "
+      f"dispatches={doc['stats']['device_dispatches']}")
+EOF
+
+echo "=== leg 3/3: serve --analyze-on-swap lint + metric families ==="
+KYVERNO_TPU_FAULTS= JAX_PLATFORMS=cpu timeout -k 10 300 python - <<'EOF' || rc=1
+import http.client
+import json
+import re
+import time
+
+import yaml
+
+from kyverno_tpu.api.policy import ClusterPolicy
+from kyverno_tpu.cli.serve import ControlPlane
+
+METRIC_LINE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? [0-9.eE+-]+|NaN"
+    r"( # \{[^{}]*\} [0-9.eE+-]+( [0-9.eE+-]+)?)?$")
+
+
+def get(port, path):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    conn.request("GET", path)
+    resp = conn.getresponse()
+    body = resp.read()
+    conn.close()
+    return resp.status, body
+
+
+with open("tests/golden/analysis/seeded_anomalies.yaml") as f:
+    policies = [ClusterPolicy.from_dict(d) for d in yaml.safe_load_all(f)
+                if isinstance(d, dict)]
+
+cp = ControlPlane(policies, port=0, metrics_port=0, analyze_on_swap=True)
+cp.start(scan_interval=3600.0)
+met = cp.metrics_server.server_address[1]
+try:
+    # the worker lints the initial version; wait for the report
+    deadline = time.monotonic() + 240
+    doc = None
+    while time.monotonic() < deadline:
+        status, body = get(met, "/debug/analysis")
+        assert status == 200, status
+        doc = json.loads(body)
+        if doc.get("analyzed"):
+            break
+        time.sleep(0.25)
+    assert doc and doc["analyzed"], doc
+    counts = doc["counts"]
+    for kind in ("shadow", "conflict", "redundant", "dead"):
+        assert counts[kind] >= 1, counts
+    assert doc["runs"]["ok"] >= 1
+
+    # /debug/rules: the statically-dead never-fired rule says WHY
+    rules = json.loads(get(met, "/debug/rules?top=5")[1])
+    never = {(r["policy"], r["rule"]): r for r in rules["never_fired"]}
+    assert never[("dead-prod", "dead-rule")].get("static") == "dead", \
+        never.get(("dead-prod", "dead-rule"))
+    sh = never[("shadowed-web", "web-nonroot")]
+    assert sh.get("static") == "shadowed_by" and "by" in sh, sh
+
+    # kyverno_analysis_* families present, populated, and parseable
+    text = get(met, "/metrics")[1].decode()
+    for line in text.splitlines():
+        if line.startswith("#") or not line:
+            continue
+        assert METRIC_LINE.match(line), f"unparseable: {line!r}"
+    for fam in ("kyverno_analysis_runs_total", "kyverno_analysis_anomalies",
+                "kyverno_analysis_witnesses",
+                "kyverno_analysis_wall_seconds"):
+        assert fam in text, f"{fam} missing from /metrics"
+    shadow = [l for l in text.splitlines()
+              if l.startswith('kyverno_analysis_anomalies{kind="shadow"}')]
+    assert shadow and float(shadow[0].rsplit(" ", 1)[1]) >= 1, shadow
+
+    # a hot swap is NOT delayed by the lint: mutate a policy and time
+    # the swap itself (the lint re-runs afterwards, off this path)
+    lifecycle = cp.lifecycle
+    rev0 = lifecycle.active.revision
+    doc2 = yaml.safe_load(open(
+        "tests/golden/analysis/clean_corpus.yaml").read().split("---")[0])
+    t0 = time.monotonic()
+    cp.cache.set(ClusterPolicy.from_dict(doc2))
+    deadline = time.monotonic() + 120
+    while lifecycle.active.revision == rev0 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    swap_s = time.monotonic() - t0
+    assert lifecycle.active.revision != rev0, "swap never landed"
+    # and the new version gets linted too
+    deadline = time.monotonic() + 240
+    while time.monotonic() < deadline:
+        if lifecycle.stats.get("lints", 0) >= 2:
+            break
+        time.sleep(0.25)
+    assert lifecycle.stats.get("lints", 0) >= 2, lifecycle.stats
+    print(f"LINT OK: anomalies={counts}, swap_s={swap_s:.2f}, "
+          f"lints={lifecycle.stats['lints']}")
+finally:
+    cp.stop()
+EOF
+
+if [ "$rc" -eq 0 ]; then
+  echo "ANALYZE GATE: all legs passed"
+else
+  echo "ANALYZE GATE: FAILURES (see above)"
+fi
+exit $rc

@@ -51,6 +51,19 @@ def _fresh_observatory():
     yield
 
 
+# the static-analysis state (analysis/analyzer.py) caches the last
+# completed report process-wide for /debug/analysis and the
+# /debug/rules correlation; tests seeding anomalies must not leak
+# their reports (or lint-run counters) into each other's assertions
+@pytest.fixture(autouse=True)
+def _fresh_analysis_state():
+    from kyverno_tpu.analysis import global_analysis
+
+    global_analysis.reset()
+    yield
+    global_analysis.reset()
+
+
 # the flight recorder, shadow verifier, and op log are process-global
 # (like the caches); a test that configures a spool dir or a verify
 # rate must not leak it into the next test's assertions

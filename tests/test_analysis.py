@@ -1,0 +1,485 @@
+"""Policy-set static analysis (analysis/): witness synthesis,
+cross-product anomaly detection on the batched device path, the
+scalar-oracle confirm ladder, the lifecycle lint, and the debug
+surfaces.
+
+The golden fixture corpora live in tests/golden/analysis/: one file
+seeding every anomaly class (each asserted detected), one clean
+reference corpus (asserted anomaly-free — the false-positive gate for
+the over-approximating witness synthesizer)."""
+
+import math
+import os
+import time
+
+import numpy as np
+import pytest
+import yaml
+
+from kyverno_tpu.analysis import (ANOMALY_KINDS, AnalysisState, Anomaly,
+                                  analyze_engine, global_analysis,
+                                  run_analysis)
+from kyverno_tpu.analysis.analyzer import FAIL, confirm, evaluate_corpus
+from kyverno_tpu.analysis.witness import (glob_counterexample, glob_instance,
+                                          deny_assignments, satisfy_leaf,
+                                          synthesize, violate_leaf)
+from kyverno_tpu.api.policy import ClusterPolicy
+from kyverno_tpu.policy.autogen import expand_policy
+from kyverno_tpu.tpu.engine import TpuEngine
+
+GOLDEN = os.path.join(os.path.dirname(__file__), "golden", "analysis")
+
+
+def _load(name):
+    with open(os.path.join(GOLDEN, name)) as f:
+        return [expand_policy(ClusterPolicy.from_dict(d))
+                for d in yaml.safe_load_all(f) if isinstance(d, dict)]
+
+
+@pytest.fixture(scope="module")
+def seeded_engine():
+    return TpuEngine(_load("seeded_anomalies.yaml"))
+
+
+@pytest.fixture(scope="module")
+def seeded_report(seeded_engine):
+    return analyze_engine(seeded_engine)
+
+
+@pytest.fixture(scope="module")
+def clean_engine():
+    return TpuEngine(_load("clean_corpus.yaml"))
+
+
+@pytest.fixture(scope="module")
+def clean_report(clean_engine):
+    return analyze_engine(clean_engine)
+
+
+def _find(report, kind, policy, rule):
+    return [a for a in report.anomalies
+            if a.kind == kind and a.policy == policy and a.rule == rule]
+
+
+# ---------------------------------------------------------------------------
+# seeded anomaly corpus: every class detected, every finding confirmed
+
+
+def test_seeded_shadow_detected(seeded_report):
+    hits = _find(seeded_report, "shadow", "shadowed-web", "web-nonroot")
+    assert hits, "seeded shadow pair not detected"
+    a = hits[0]
+    assert (a.other_policy, a.other_rule) == ("base-nonroot",
+                                              "require-nonroot")
+    assert a.confirmed
+
+
+def test_seeded_conflict_detected(seeded_report):
+    hits = _find(seeded_report, "conflict", "strict-nonroot",
+                 "strict-nonroot")
+    others = {(a.other_policy, a.other_rule) for a in hits}
+    # the Enforce rule conflicts with each Audit twin policing the
+    # same violations; the anomaly is attributed to the Enforce side
+    assert ("base-nonroot", "require-nonroot") in others
+    assert all(a.confirmed for a in hits)
+
+
+def test_seeded_redundant_detected(seeded_report):
+    hits = [a for a in seeded_report.anomalies if a.kind == "redundant"]
+    pairs = {frozenset([(a.policy, a.rule),
+                        (a.other_policy, a.other_rule)]) for a in hits}
+    assert frozenset([("base-nonroot", "require-nonroot"),
+                      ("copy-nonroot", "copy-nonroot")]) in pairs
+    assert all(a.confirmed for a in hits)
+
+
+def test_seeded_dead_detected(seeded_report):
+    hits = _find(seeded_report, "dead", "dead-prod", "dead-rule")
+    assert hits and hits[0].confirmed
+    rows = {(r["policy"], r["rule"]): r for r in seeded_report.rules}
+    assert rows[("dead-prod", "dead-rule")]["status"] == "dead"
+    assert rows[("shadowed-web", "web-nonroot")]["status"] == "shadowed_by"
+    assert rows[("shadowed-web", "web-nonroot")]["by"] == \
+        "base-nonroot/require-nonroot"
+    assert rows[("base-nonroot", "require-nonroot")]["status"] == "ok"
+
+
+def test_every_surfaced_anomaly_is_confirmed(seeded_report):
+    assert seeded_report.anomalies
+    assert all(a.confirmed for a in seeded_report.anomalies)
+    assert seeded_report.stats["confirmed_cells"] > 0
+
+
+# ---------------------------------------------------------------------------
+# clean reference corpus: zero false positives
+
+
+def test_clean_corpus_is_anomaly_free(clean_report):
+    assert clean_report.counts() == {k: 0 for k in ANOMALY_KINDS}
+    assert clean_report.stats["witnesses"] > 0
+    assert clean_report.stats["rules_unanalyzable"] == 0
+    assert all(r["status"] == "ok" for r in clean_report.rules)
+
+
+def _one_rule_policy(name, match, exclude=None):
+    rule = {"name": "r", "match": match,
+            "validate": {"message": "m",
+                         "pattern": {"metadata": {"name": "*"}}}}
+    if exclude is not None:
+        rule["exclude"] = exclude
+    return ClusterPolicy.from_dict({
+        "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+        "metadata": {"name": name}, "spec": {"rules": [rule]}})
+
+
+def test_multi_kind_rule_with_one_kind_excluded_is_not_dead():
+    # match [Pod, Service] / exclude Pod fires on every Service: each
+    # kind (and operation) in a multi-valued filter gets its own
+    # skeleton, so a live later entry defeats the dead classification
+    live = _one_rule_policy(
+        "multi-kind",
+        {"any": [{"resources": {"kinds": ["Pod", "Service"]}}]},
+        {"any": [{"resources": {"kinds": ["Pod"]}}]})
+    assert analyze_engine(TpuEngine([live])).counts()["dead"] == 0
+
+    live_op = _one_rule_policy(
+        "multi-op",
+        {"any": [{"resources": {"kinds": ["Pod"],
+                                "operations": ["CREATE", "UPDATE"]}}]},
+        {"any": [{"resources": {"kinds": ["Pod"],
+                                "operations": ["CREATE"]}}]})
+    assert analyze_engine(TpuEngine([live_op])).counts()["dead"] == 0
+
+    # every kind excluded: genuinely dead, still caught
+    dead = _one_rule_policy(
+        "multi-kind-dead",
+        {"any": [{"resources": {"kinds": ["Pod", "Service"]}}]},
+        {"any": [{"resources": {"kinds": ["Pod"]}},
+                 {"resources": {"kinds": ["Service"]}}]})
+    report = analyze_engine(TpuEngine([dead]))
+    assert report.counts()["dead"] == 1
+    assert report.anomalies[0].confirmed
+
+
+# ---------------------------------------------------------------------------
+# the evaluation is batched device work, not per-witness scalar loops
+
+
+def test_witness_evaluation_is_batched(seeded_engine, monkeypatch):
+    corpus, _per_rule = synthesize(seeded_engine.cps)
+    assert len(corpus) > 8
+    calls = {"n": 0}
+    real = seeded_engine._scan_uncached
+
+    def counting(*args, **kwargs):
+        calls["n"] += 1
+        return real(*args, **kwargs)
+
+    monkeypatch.setattr(seeded_engine, "_scan_uncached", counting)
+    table, dispatches = evaluate_corpus(seeded_engine, corpus, tile=256)
+    assert table.shape == (len(seeded_engine.cps.rules), len(corpus))
+    assert calls["n"] == dispatches
+    assert dispatches <= math.ceil(len(corpus) / 256) + 2
+    assert dispatches < len(corpus)  # the whole point
+
+
+def test_synthetic_traffic_stays_out_of_rule_stats(clean_engine):
+    from kyverno_tpu.observability.analytics import global_rule_stats
+
+    global_rule_stats.register(clean_engine.rule_idents())
+    corpus, _ = synthesize(clean_engine.cps)
+    evaluate_corpus(clean_engine, corpus, tile=256)
+    rows = global_rule_stats.rule_rows()
+    assert rows and all(r["evals"] == 0 for r in rows), \
+        "witness evals leaked into the observatory (live_n=0 contract)"
+
+
+# ---------------------------------------------------------------------------
+# confirm ladder: the oracle can refute, never invent
+
+
+def test_confirm_refutes_fabricated_anomaly(clean_engine):
+    corpus, _ = synthesize(clean_engine.cps)
+    table, _ = evaluate_corpus(clean_engine, corpus, tile=256)
+    rules = clean_engine.cps.rules
+    # claim rule 0 FAILs a witness the device says it passes/skips
+    row0 = table[0]
+    wi = int(np.nonzero(row0 != FAIL)[0][0])
+    fake = Anomaly(kind="shadow", policy=rules[0].policy_name,
+                   rule=rules[0].rule_name,
+                   other_policy=rules[1].policy_name,
+                   other_rule=rules[1].rule_name, evidence=[wi])
+    kept, stats = confirm(clean_engine, [fake], table, corpus)
+    assert kept == []
+    assert stats["refuted"] == 1
+
+
+def test_confirm_keeps_oracle_backed_anomaly(seeded_engine, seeded_report):
+    # re-confirming the real report's anomalies is a no-op: the oracle
+    # agrees with the device on every supporting cell
+    assert all(a.confirmed for a in seeded_report.anomalies)
+    assert seeded_report.stats["refuted"] == 0
+
+
+# ---------------------------------------------------------------------------
+# witness synthesis units (host-side, no device)
+
+
+def test_glob_instance_and_counterexample_roundtrip():
+    from kyverno_tpu.utils.wildcard import match as wild_match
+
+    for pat in ("web-*", "?x", "exact", "a*b", "ns-?-*"):
+        inst = glob_instance(pat)
+        assert inst is not None and wild_match(pat, inst)
+        ce = glob_counterexample(pat)
+        assert ce is not None and not wild_match(pat, ce)
+
+
+def test_dfa_boundary_values_agree_with_both_oracles():
+    from kyverno_tpu.analysis.witness import dfa_boundary_values
+    from kyverno_tpu.tpu.dfa import compile_glob
+    from kyverno_tpu.utils.wildcard import match as wild_match
+
+    for pat in ("web-*", "a?c", "ns-*-x"):
+        vals = dfa_boundary_values(pat)
+        assert vals, pat
+        dfa = compile_glob(pat)
+        for v in vals:
+            # every probe's label is exact: compiled table walk and
+            # scalar glob matcher agree at this value
+            assert dfa.match_str(v) == wild_match(pat, v), (pat, v)
+
+
+def test_leaf_satisfy_and_violate_verified_by_oracle():
+    from kyverno_tpu.engine.pattern import validate as leaf_validate
+
+    for pat in ("<5", "ClusterIP|NodePort", True, "false", 8080, "!root"):
+        sat = satisfy_leaf(pat)
+        assert leaf_validate(sat, pat), (pat, sat)
+        bad = violate_leaf(pat)
+        assert not leaf_validate(bad, pat), (pat, bad)
+
+
+def test_deny_assignments_drive_conditions():
+    conds = {"all": [
+        {"key": "{{ request.object.spec.replicas }}",
+         "operator": "GreaterThan", "value": 3}]}
+    tru = deny_assignments(conds, True)
+    assert tru == [(("spec", "replicas"), 4)]
+    fls = deny_assignments(conds, False)
+    assert fls == [(("spec", "replicas"), 2)]
+    # outside the modeled subset -> None, never a guess
+    assert deny_assignments(
+        {"all": [{"key": "{{ foo.bar }}", "operator": "Equals",
+                  "value": "x"}]}, True) is None
+
+
+def test_match_skeletons_verified_by_host_matcher():
+    from kyverno_tpu.analysis.witness import match_skeletons
+    from kyverno_tpu.engine.match import matches_resource_description
+
+    rule = ClusterPolicy.from_dict({
+        "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+        "metadata": {"name": "p"},
+        "spec": {"rules": [{
+            "name": "r",
+            "match": {"any": [{"resources": {
+                "kinds": ["Pod"], "names": ["web-*"],
+                "namespaces": ["team-*"]}}]},
+            "validate": {"pattern": {"spec": {"hostNetwork": "false"}}},
+        }]}}).get_rules()[0]
+    skels, _cands, exhaustive = match_skeletons(rule)
+    assert skels and exhaustive
+    sk = skels[0]
+    assert matches_resource_description(sk.resource, rule, sk.info,
+                                        {}, operation="CREATE") == []
+    assert sk.resource["metadata"]["name"].startswith("web-")
+
+
+# ---------------------------------------------------------------------------
+# global state, metrics, debug surfaces
+
+
+def test_analysis_state_static_for_and_reset(seeded_report):
+    state = AnalysisState()
+    state.set_report(seeded_report)
+    assert state.static_for("dead-prod", "dead-rule") == {"static": "dead"}
+    got = state.static_for("shadowed-web", "web-nonroot")
+    assert got == {"static": "shadowed_by",
+                   "by": "base-nonroot/require-nonroot"}
+    assert state.static_for("base-nonroot", "require-nonroot") == \
+        {"static": "ok"}
+    assert state.static_for("nope", "nope") is None
+    doc = state.report_dict()
+    assert doc["analyzed"] and doc["counts"]["dead"] >= 1
+    state.reset()
+    assert state.report is None
+    assert state.report_dict()["analyzed"] is False
+
+
+def test_debug_rules_never_fired_static_correlation(seeded_engine,
+                                                    seeded_report):
+    from kyverno_tpu.observability.analytics import global_rule_stats
+
+    global_rule_stats.register(seeded_engine.rule_idents())
+    global_analysis.set_report(seeded_report)
+    report = global_rule_stats.report(top=5)
+    never = {(r["policy"], r["rule"]): r for r in report["never_fired"]}
+    assert never[("dead-prod", "dead-rule")]["static"] == "dead"
+    sh = never[("shadowed-web", "web-nonroot")]
+    assert sh["static"] == "shadowed_by"
+    assert sh["by"] == "base-nonroot/require-nonroot"
+    # no-traffic-yet rules say so explicitly once the lint has run
+    assert never[("base-nonroot", "require-nonroot")]["static"] == "ok"
+
+
+def test_debug_analysis_endpoint(seeded_report):
+    import json as _json
+
+    from kyverno_tpu.webhooks.server import handle_debug_path
+
+    code, body, ctype = handle_debug_path("/debug/analysis")
+    assert code == 200 and ctype == "application/json"
+    doc = _json.loads(body)
+    assert doc["analyzed"] is False
+    global_analysis.set_report(seeded_report)
+    global_analysis.record_run("ok")
+    code, body, _ = handle_debug_path("/debug/analysis")
+    doc = _json.loads(body)
+    assert doc["analyzed"] is True
+    assert doc["counts"]["shadow"] >= 1
+    assert doc["runs"]["ok"] == 1
+    assert any(a["kind"] == "dead" for a in doc["anomalies"])
+
+
+def test_analysis_metrics_published(seeded_report):
+    from kyverno_tpu.observability.metrics import global_registry as reg
+
+    runs_before = reg.analysis_runs.value({"outcome": "ok"})
+    global_analysis.set_report(seeded_report)
+    global_analysis.record_run("ok")
+    assert reg.analysis_runs.value({"outcome": "ok"}) == runs_before + 1
+    assert reg.analysis_anomalies.value({"kind": "shadow"}) >= 1
+    assert reg.analysis_anomalies.value({"kind": "dead"}) >= 1
+    assert reg.analysis_witnesses.value() == \
+        seeded_report.stats["witnesses"]
+    assert reg.analysis_wall_seconds.value({"phase": "evaluate"}) >= 0.0
+    text = reg.exposition()
+    for fam in ("kyverno_analysis_runs_total", "kyverno_analysis_anomalies",
+                "kyverno_analysis_witnesses",
+                "kyverno_analysis_wall_seconds"):
+        assert f"# TYPE {fam}" in text
+
+
+# ---------------------------------------------------------------------------
+# lifecycle lint: compile-ahead analysis off the request path
+
+
+def _tiny_policies():
+    return [ClusterPolicy.from_dict({
+        "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+        "metadata": {"name": name},
+        "spec": {"validationFailureAction": "Audit", "rules": [{
+            "name": "r",
+            "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+            "validate": {"message": "m",
+                         "pattern": {"spec": {"hostNetwork": "false"}}},
+        }]}}) for name in ("lint-a", "lint-b")]
+
+
+def test_lifecycle_lint_reuses_active_engine_and_is_idempotent():
+    from kyverno_tpu.cluster import PolicyCache
+    from kyverno_tpu.lifecycle import PolicySetLifecycleManager
+
+    cache = PolicyCache()
+    for p in _tiny_policies():
+        cache.set(p)
+    mgr = PolicySetLifecycleManager(cache)
+    version = mgr.acquire()
+    compiles_before = mgr.stats["compiles"]
+    # the swap path itself never lints (probing-style priority: the
+    # lint runs strictly after reconcile, on the worker)
+    assert global_analysis.report is None
+
+    report = mgr.run_lint()
+    assert report is not None and report.stats["witnesses"] > 0
+    # the already-compiled active engine was reused: zero new compiles
+    assert mgr.stats["compiles"] == compiles_before
+    assert mgr.stats["lints"] == 1
+    assert global_analysis.report is report
+    assert global_analysis.lint_enabled
+    # identical tuple (identical redundant twins) detected live
+    assert any(a.kind == "redundant" for a in report.anomalies)
+
+    # idempotent per (content hash, quarantine): no re-lint
+    assert mgr.run_lint() is None
+    assert mgr.stats["lints"] == 1
+    assert mgr.run_lint(force=True) is not None
+    assert mgr.stats["lints"] == 2
+
+    # a policy-set change re-arms the lint; reuse the same engine shape
+    cache.unset("lint-b")
+    mgr.acquire()
+    report2 = mgr.run_lint()
+    assert report2 is not None
+    assert not any(a.kind == "redundant" for a in report2.anomalies)
+
+
+def test_lifecycle_lint_preempted_by_pending_change():
+    from kyverno_tpu.cluster import PolicyCache
+    from kyverno_tpu.lifecycle import PolicySetLifecycleManager
+
+    cache = PolicyCache()
+    for p in _tiny_policies():
+        cache.set(p)
+    mgr = PolicySetLifecycleManager(cache)
+    mgr.acquire()
+    # a mutation lands AFTER the swap but BEFORE the lint: the lint
+    # must yield to the pending recompile, not analyze a stale version
+    extra = ClusterPolicy.from_dict({
+        "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+        "metadata": {"name": "lint-late"},
+        "spec": {"rules": [{
+            "name": "r",
+            "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+            "validate": {"pattern": {"spec": {"hostPID": "false"}}}}]}})
+    cache.set(extra)
+    assert mgr.run_lint() is None
+    assert global_analysis.report is None
+    assert global_analysis.runs["aborted"] == 1
+    mgr.acquire()  # reconciles to the new revision
+    assert mgr.run_lint() is not None  # retried at the fresh version
+
+
+def test_lifecycle_worker_lints_after_swap():
+    from kyverno_tpu.cluster import PolicyCache
+    from kyverno_tpu.lifecycle import PolicySetLifecycleManager
+
+    cache = PolicyCache()
+    for p in _tiny_policies():
+        cache.set(p)
+    mgr = PolicySetLifecycleManager(cache)
+    mgr.analyze_on_swap = True
+    mgr.start()
+    try:
+        deadline = time.monotonic() + 60
+        while (global_analysis.report is None
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        assert global_analysis.report is not None
+        assert mgr.stats.get("lints", 0) >= 1
+    finally:
+        mgr.stop()
+
+
+def test_run_analysis_records_error_outcome(clean_engine, monkeypatch):
+    state = AnalysisState()
+
+    def boom(*a, **k):
+        raise RuntimeError("synthesizer exploded")
+
+    monkeypatch.setattr("kyverno_tpu.analysis.analyzer.synthesize", boom)
+    with pytest.raises(RuntimeError):
+        run_analysis(clean_engine, state=state)
+    assert state.runs["error"] == 1
+    assert state.report is None

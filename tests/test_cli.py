@@ -81,7 +81,7 @@ def test_serve_batching_help(capsys):
                  "--policy-watch", "--reload-interval",
                  "--slo-admission-p99-ms", "--slo-admission-budget",
                  "--slo-scan-freshness-s", "--slo-device-coverage-floor",
-                 "--rule-metrics-top-k"):
+                 "--rule-metrics-top-k", "--analyze-on-swap"):
         assert flag in out
 
 
@@ -116,6 +116,78 @@ def test_apply_help_covers_observatory_flags(capsys):
     assert exc.value.code == 0
     out = capsys.readouterr().out
     assert "--rule-stats" in out and "--profile" in out
+
+
+def test_analyze_help(capsys):
+    with pytest.raises(SystemExit) as exc:
+        main(["analyze", "--help"])
+    assert exc.value.code == 0
+    out = capsys.readouterr().out
+    for flag in ("--json", "--fail-on", "--tile"):
+        assert flag in out
+
+
+REDUNDANT_PAIR = """
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata: {name: twin-a}
+spec:
+  validationFailureAction: Audit
+  rules:
+    - name: no-host-net
+      match: {any: [{resources: {kinds: [Pod]}}]}
+      validate: {message: m, pattern: {spec: {hostNetwork: "false"}}}
+---
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata: {name: twin-b}
+spec:
+  validationFailureAction: Audit
+  rules:
+    - name: no-host-net-too
+      match: {any: [{resources: {kinds: [Pod]}}]}
+      validate: {message: m, pattern: {spec: {hostNetwork: "false"}}}
+"""
+
+
+@pytest.fixture
+def redundant_pair_file(tmp_path):
+    f = tmp_path / "twins.yaml"
+    f.write_text(REDUNDANT_PAIR)
+    return str(f)
+
+
+def test_analyze_json_and_fail_on_exit_codes(redundant_pair_file, capsys):
+    # without --fail-on, anomalies are reported but the run succeeds
+    rc = main(["analyze", redundant_pair_file, "--json"])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0
+    assert out["counts"]["redundant"] >= 1
+    assert all(a["confirmed"] for a in out["anomalies"])
+    assert out["stats"]["device_dispatches"] >= 1
+
+    # --fail-on matching a confirmed anomaly kind -> exit 1
+    rc = main(["analyze", redundant_pair_file, "--fail-on", "redundant"])
+    assert rc == 1
+    # --fail-on kinds that did NOT surface -> exit 0
+    rc = main(["analyze", redundant_pair_file,
+               "--fail-on", "shadow,conflict"])
+    assert rc == 0
+    capsys.readouterr()
+
+
+def test_analyze_usage_errors(tmp_path, capsys):
+    # unknown --fail-on kind fails before any compile
+    f = tmp_path / "p.yaml"
+    f.write_text(REDUNDANT_PAIR)
+    with pytest.raises(SystemExit) as exc:
+        main(["analyze", str(f), "--fail-on", "bogus"])
+    assert exc.value.code == 2
+    # no policies in the input -> exit 2
+    empty = tmp_path / "empty.yaml"
+    empty.write_text("apiVersion: v1\nkind: Pod\nmetadata: {name: x}\n")
+    assert main(["analyze", str(empty)]) == 2
+    capsys.readouterr()
 
 
 def test_top_help(capsys):

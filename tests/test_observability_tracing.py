@@ -226,6 +226,12 @@ def test_exposition_format_is_scrapeable():
     reg.verification_checks.inc({"result": "diverge"})
     reg.verification_divergence.inc(exemplar={"trace_id": "ef" * 16})
     reg.verification_queue_depth.set(0)
+    # static-analysis families (analysis/): run outcomes, last report's
+    # anomaly counts by kind, corpus size, per-phase wall
+    reg.analysis_runs.inc({"outcome": "ok"})
+    reg.analysis_anomalies.set(2, {"kind": "shadow"})
+    reg.analysis_witnesses.set(46)
+    reg.analysis_wall_seconds.set(0.5, {"phase": "evaluate"})
 
     text = reg.exposition()
     # every new family is present (cardinality guard has its own test)
@@ -243,7 +249,10 @@ def test_exposition_format_is_scrapeable():
                 "kyverno_verification_checks_total",
                 "kyverno_verification_divergence_total",
                 "kyverno_verification_queue_depth",
-                "kyverno_slo_verification_divergences"):
+                "kyverno_slo_verification_divergences",
+                "kyverno_analysis_runs_total", "kyverno_analysis_anomalies",
+                "kyverno_analysis_witnesses",
+                "kyverno_analysis_wall_seconds"):
         assert f"# TYPE {fam} " in text, fam
     # the divergence counter line carries its trace-id exemplar
     assert any(l.startswith("kyverno_verification_divergence_total")
