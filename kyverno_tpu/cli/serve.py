@@ -56,6 +56,57 @@ def add_parser(sub: argparse._SubParsersAction) -> None:
     p.add_argument("--shed-mode", choices=["scalar", "fail"], default="scalar",
                    help="shed overload to the scalar engine, or fail the "
                         "request per the webhook path's failurePolicy")
+    # admission scheduling (serving/scheduler.py): per-class weighted
+    # fair queuing, bulk coalescing, hedged dispatch, and the
+    # burn-driven shed ladder — the engine degrades BY CLASS under
+    # overload instead of uniformly
+    p.add_argument("--class-weights", default=None,
+                   metavar="critical=8,default=4,bulk=1",
+                   help="weighted-fair share per priority tier; each "
+                        "(tenant x operation x priority) class is its own "
+                        "flow weighted by its tier")
+    p.add_argument("--bulk-max-wait-ms", type=float, default=50.0,
+                   help="bulk coalescing window: bulk requests wait up to "
+                        "this long to fill whole shape buckets instead of "
+                        "fragmenting every flush (they still top flushes "
+                        "up to their padded bucket for free)")
+    p.add_argument("--hedge-threshold", type=float, default=0.25,
+                   help="hedged scalar dispatch: once a dispatched "
+                        "request's remaining deadline budget falls below "
+                        "this fraction while its device batch is in "
+                        "flight, race the scalar oracle against the batch "
+                        "(first bit-identical result wins; 0 disables)")
+    p.add_argument("--shed-burn-bulk", type=float, default=1.0,
+                   help="admission-SLO burn rate above which the BULK "
+                        "class sheds at submit (0 disables); bulk always "
+                        "sheds first")
+    p.add_argument("--shed-burn-default", type=float, default=3.0,
+                   help="burn rate above which the DEFAULT class sheds "
+                        "too (0 disables); the critical class is never "
+                        "burn-shed")
+    p.add_argument("--bulk-share", type=float, default=0.5,
+                   help="fraction of the queue the bulk class may occupy "
+                        "before it sheds")
+    p.add_argument("--critical-reserve", type=float, default=0.1,
+                   help="top fraction of the queue reserved for the "
+                        "critical class")
+    p.add_argument("--bulk-shed-mode", choices=["scalar", "fail"],
+                   default=None,
+                   help="shed mode override for the bulk class "
+                        "(default: --shed-mode); 'fail' resolves shed "
+                        "bulk per failurePolicy instead of spending "
+                        "scalar work on traffic being shed")
+    p.add_argument("--bulk-users", default=None,
+                   metavar="GLOB[,GLOB...]",
+                   help="usernames classified into the bulk tier "
+                        "(default: system:node:*,system:serviceaccount:"
+                        "kube-system:*)")
+    p.add_argument("--critical-users", default=None,
+                   metavar="GLOB[,GLOB...]",
+                   help="usernames classified into the critical tier "
+                        "(default: none; identity globs are the only "
+                        "promotion path — the policies.kyverno.io/priority "
+                        "resource annotation may only demote)")
     p.add_argument("--request-timeout-s", type=float, default=10.0,
                    help="per-request time budget; an overrun resolves per "
                         "the webhook path's failurePolicy, never a 500")
@@ -173,7 +224,7 @@ class ControlPlane:
                  policy_watch=None, reload_interval=2.0,
                  flight_sample_rate=None, flight_capacity=None,
                  flight_dir=None, shadow_verify_rate=None,
-                 analyze_on_swap=False):
+                 analyze_on_swap=False, classify_config=None):
         # flight recorder + shadow verifier are process-global (like
         # the caches); only explicitly-passed knobs are applied so a
         # test-configured recorder survives ControlPlane construction
@@ -223,7 +274,8 @@ class ControlPlane:
             self.cache, self.snapshot, self.aggregator,
             configuration=self.configuration, toggles=self.toggles,
             batching=batching, batch_config=batch_config,
-            request_timeout_s=request_timeout_s)
+            request_timeout_s=request_timeout_s,
+            classify_config=classify_config)
         # policy-set lifecycle: the compile-ahead worker owns recompiles
         # from here on (started in start()); webhook-config and VAP
         # reconciliation ride every cache mutation so hot-reloaded
@@ -419,15 +471,40 @@ def run(args: argparse.Namespace) -> int:
         configuration.load(doc.get("data") or doc)
     toggles = Toggles(engine=args.engine) if args.engine else Toggles()
     batch_config = None
+    classify_config = None
     if args.batching:
-        from ..serving import BatchConfig
+        from ..serving import BatchConfig, ClassifyConfig, parse_class_weights
 
         batch_config = BatchConfig(
             max_batch_size=args.max_batch_size,
             max_wait_ms=args.max_wait_ms,
             deadline_ms=args.deadline_ms,
             high_water=args.queue_high_water,
-            shed_mode=args.shed_mode)
+            shed_mode=args.shed_mode,
+            bulk_max_wait_ms=args.bulk_max_wait_ms,
+            hedge_threshold=args.hedge_threshold,
+            shed_burn_bulk=args.shed_burn_bulk,
+            shed_burn_default=args.shed_burn_default,
+            bulk_share=args.bulk_share,
+            critical_reserve=args.critical_reserve,
+            bulk_shed_mode=args.bulk_shed_mode)
+        if args.class_weights:
+            try:
+                batch_config.class_weights = \
+                    parse_class_weights(args.class_weights)
+            except ValueError as e:
+                print(f"bad --class-weights: {e}", file=sys.stderr)
+                return 2
+        classify_kw = {}
+        if args.bulk_users is not None:
+            classify_kw["bulk_users"] = tuple(
+                u.strip() for u in args.bulk_users.split(",") if u.strip())
+        if args.critical_users is not None:
+            classify_kw["critical_users"] = tuple(
+                u.strip() for u in args.critical_users.split(",")
+                if u.strip())
+        if classify_kw:
+            classify_config = ClassifyConfig(**classify_kw)
     exporter = None
     if args.trace_export:
         from ..observability.tracing import (OTLPJsonFileExporter,
@@ -447,7 +524,8 @@ def run(args: argparse.Namespace) -> int:
                       flight_capacity=args.flight_capacity,
                       flight_dir=args.flight_dir,
                       shadow_verify_rate=args.shadow_verify_rate,
-                      analyze_on_swap=args.analyze_on_swap)
+                      analyze_on_swap=args.analyze_on_swap,
+                      classify_config=classify_config)
     if args.analyze_on_swap:
         global_oplog.emit("analyze_on_swap_enabled")
     if args.policy_watch:

@@ -629,7 +629,14 @@ class SloTracker:
         self._clock = clock
         self._metrics = metrics
         self._lock = threading.Lock()
-        self._adm: deque = deque(maxlen=max_samples)  # (t, latency_s)
+        # (t, latency_s, class) — class is the scheduling priority tier
+        # (serving/scheduler.py), "default" for unclassified callers,
+        # so the windows split per class without unbounded cardinality
+        self._adm: deque = deque(maxlen=max_samples)
+        # burn-rate cache for the serving shed ladder: submit() reads
+        # the burn signal per request, so the read must not walk the
+        # whole sample window each time
+        self._burn_cache: Tuple[float, float] = (-1e9, 0.0)
         self._last_scan: Optional[float] = None
         self._coverage: Optional[float] = None
         # verdict-integrity samples: (t, diverged 0/1) per shadow-
@@ -652,9 +659,37 @@ class SloTracker:
 
     # -- write side
 
-    def record_admission(self, latency_s: float) -> None:
+    def record_admission(self, latency_s: float,
+                         cls: Optional[str] = None) -> None:
         with self._lock:
-            self._adm.append((self._clock(), latency_s))
+            self._adm.append((self._clock(), latency_s, cls or "default"))
+
+    def admission_burn_fast(self, max_age_s: float = 0.25) -> float:
+        """Cached short-window admission burn rate — the signal the
+        serving pipeline's burn-driven shed ladder reads per submit().
+        Recomputed at most every ``max_age_s``; between refreshes the
+        ladder sees a trailing value, which is fine — burn is a
+        windowed rate, not an instantaneous one."""
+        now = self._clock()
+        cached_at, cached = self._burn_cache
+        if now - cached_at < max_age_s:
+            return cached
+        cfg = self.config
+        span = min(cfg.windows.values()) if cfg.windows else 300.0
+        target_s = cfg.admission_p99_target_ms / 1000.0
+        budget = max(cfg.admission_error_budget, 1e-9)
+        cutoff = now - span
+        n = slow = 0
+        with self._lock:
+            for t, l, _c in reversed(self._adm):
+                if t < cutoff:
+                    break
+                n += 1
+                if l > target_s:
+                    slow += 1
+        burn = (slow / n) / budget if n else 0.0
+        self._burn_cache = (now, burn)
+        return burn
 
     def record_scan(self, coverage: Optional[float] = None) -> None:
         with self._lock:
@@ -683,8 +718,20 @@ class SloTracker:
             self._last_scan = None
             self._coverage = None
             self._verif.clear()
+            self._burn_cache = (-1e9, 0.0)
 
     # -- read side
+
+    @staticmethod
+    def _window_stats(lat: List[float], target_s: float,
+                      budget: float) -> Dict[str, Any]:
+        n = len(lat)
+        slow = sum(1 for l in lat if l > target_s)
+        p99 = float(np.percentile(np.asarray(lat), 99)) if lat else 0.0
+        burn = (slow / n) / budget if n else 0.0
+        return {"requests": n, "slow": slow,
+                "p99_ms": round(p99 * 1e3, 3),
+                "burn_rate": round(burn, 4)}
 
     def _admission_windows(self, now: float) -> Dict[str, Dict[str, Any]]:
         cfg = self.config
@@ -694,14 +741,17 @@ class SloTracker:
             samples = list(self._adm)
         out: Dict[str, Dict[str, Any]] = {}
         for name, span in cfg.windows.items():
-            lat = [l for (t, l) in samples if t >= now - span]
-            n = len(lat)
-            slow = sum(1 for l in lat if l > target_s)
-            p99 = float(np.percentile(np.asarray(lat), 99)) if lat else 0.0
-            burn = (slow / n) / budget if n else 0.0
-            out[name] = {"requests": n, "slow": slow,
-                         "p99_ms": round(p99 * 1e3, 3),
-                         "burn_rate": round(burn, 4)}
+            win = [(l, c) for (t, l, c) in samples if t >= now - span]
+            w = self._window_stats([l for l, _ in win], target_s, budget)
+            # per-class split (serving scheduling classes): the shed
+            # ladder degrades bulk first, and these windows are how an
+            # operator verifies the critical class really stayed flat
+            by_class: Dict[str, Dict[str, Any]] = {}
+            for c in sorted({c for _, c in win}):
+                by_class[c] = self._window_stats(
+                    [l for l, cc in win if cc == c], target_s, budget)
+            w["by_class"] = by_class
+            out[name] = w
         return out
 
     def _verification_windows(self, now: float) -> Dict[str, Dict[str, int]]:
@@ -768,6 +818,11 @@ class SloTracker:
                 reg.slo_admission_p99.set(w["p99_ms"] / 1e3,
                                           {"window": name})
                 reg.slo_admission_burn.set(w["burn_rate"], {"window": name})
+                for cls, cw in w.get("by_class", {}).items():
+                    reg.slo_admission_p99.set(
+                        cw["p99_ms"] / 1e3, {"window": name, "class": cls})
+                    reg.slo_admission_burn.set(
+                        cw["burn_rate"], {"window": name, "class": cls})
             fresh = state["scan_freshness"]
             if fresh["seconds_since_scan"] is not None:
                 reg.slo_scan_freshness.set(fresh["seconds_since_scan"])

@@ -11,8 +11,8 @@ path and breaker state, the full verdict column, the trace id, and
 phase timings — with head-based sampling:
 
 - outcomes in ``ALWAYS_CAPTURE`` (error / scalar fallback / pattern
-  CONFIRM / shed / expired) are captured unconditionally — the rare
-  paths are exactly the ones an incident needs;
+  CONFIRM / shed / expired / hedged race) are captured unconditionally
+  — the rare paths are exactly the ones an incident needs;
 - everything else (ok, cached) is captured at ``sample_rate`` (the
   ``serve --flight-sample-rate`` knob, default 1%), so the recorder's
   hot-path cost is one outcome classification + one RNG draw.
@@ -44,7 +44,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 # outcomes captured regardless of the sample rate
 ALWAYS_CAPTURE = frozenset({"error", "fallback", "shed", "confirm",
-                            "expired"})
+                            "expired", "hedged"})
 
 OUTCOME_OK = "ok"
 OUTCOME_ERROR = "error"
@@ -53,6 +53,11 @@ OUTCOME_SHED = "shed"
 OUTCOME_CONFIRM = "confirm"
 OUTCOME_CACHED = "cached"
 OUTCOME_EXPIRED = "expired"
+# a hedged scalar dispatch raced an in-flight device batch; the record
+# path names the winner ("hedged_scalar" / "hedged_device") and the
+# race always captures — bit-identity under racing is exactly the
+# claim the audit trail exists to witness
+OUTCOME_HEDGED = "hedged"
 
 # verdict code mirror (tpu/evaluator.py order; this module must stay
 # importable without jax, like the rest of observability/)
@@ -275,6 +280,8 @@ class FlightRecorder:
             return OUTCOME_ERROR
         if path == "shed":
             return OUTCOME_SHED
+        if path.startswith("hedged"):
+            return OUTCOME_HEDGED
         if path in ("scalar_fallback", "pure_scalar"):
             return OUTCOME_FALLBACK
         if confirm:

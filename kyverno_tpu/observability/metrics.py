@@ -367,6 +367,25 @@ class MetricsRegistry:
         self.serving_request_latency = self.histogram(
             "kyverno_serving_request_latency_seconds",
             "admission submit-to-verdict latency")
+        # admission scheduling (serving/scheduler.py + queue.py): the
+        # per-class view of the pipeline — queue pressure by priority
+        # tier, request resolutions by class and path, and the hedged
+        # scalar-vs-device races by winner. The class label is the
+        # PRIORITY TIER (critical/default/bulk), never the tenant —
+        # tenant-level fairness stays internal so label cardinality is
+        # bounded at three no matter how many namespaces submit
+        self.serving_class_queue_depth = self.gauge(
+            "kyverno_serving_class_queue_depth",
+            "admission requests waiting in the batching queue, by "
+            "priority class")
+        self.serving_class_requests = self.counter(
+            "kyverno_serving_class_requests_total",
+            "admission requests by priority class and resolution "
+            "outcome (batched/cached/hedged/shed/expired)")
+        self.serving_hedge = self.counter(
+            "kyverno_serving_hedge_total",
+            "hedged scalar dispatches racing an in-flight device batch, "
+            "by winner (scalar/device/device_error/expired/error)")
         # resilience layer (resilience/): breaker state machine, scalar
         # fallback routing, retry outcomes, injected faults
         self.breaker_state = self.gauge(

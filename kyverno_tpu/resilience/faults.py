@@ -8,6 +8,10 @@ the failure physically happens:
     context.image_data  the imageRegistry context backend
     gctx.refresh        the GlobalContext external-API poll (entry.py)
     serving.flush       the admission pipeline's batch evaluation
+    serving.hedge       the hedged scalar dispatch racing an in-flight
+                        device batch (serving/batcher.py) — a raise
+                        here degrades the hedge to plain waiting, a
+                        delay makes the device win the race
     policyset.compile   the lifecycle manager's compile-ahead lowering
                         (full-set compiles AND per-policy bisect probes)
     encode.pool_dispatch  the encoder pool's supervisor-side chunk
@@ -59,14 +63,15 @@ SITE_CONTEXT_API_CALL = "context.api_call"
 SITE_CONTEXT_IMAGE_DATA = "context.image_data"
 SITE_GCTX_REFRESH = "gctx.refresh"
 SITE_SERVING_FLUSH = "serving.flush"
+SITE_SERVING_HEDGE = "serving.hedge"
 SITE_POLICYSET_COMPILE = "policyset.compile"
 SITE_ENCODE_POOL_DISPATCH = "encode.pool_dispatch"
 SITE_ENCODE_WORKER = "encode.worker"
 
 KNOWN_SITES = frozenset({
     SITE_TPU_DISPATCH, SITE_CONTEXT_API_CALL, SITE_CONTEXT_IMAGE_DATA,
-    SITE_GCTX_REFRESH, SITE_SERVING_FLUSH, SITE_POLICYSET_COMPILE,
-    SITE_ENCODE_POOL_DISPATCH, SITE_ENCODE_WORKER,
+    SITE_GCTX_REFRESH, SITE_SERVING_FLUSH, SITE_SERVING_HEDGE,
+    SITE_POLICYSET_COMPILE, SITE_ENCODE_POOL_DISPATCH, SITE_ENCODE_WORKER,
 })
 
 MODES = ("raise", "delay", "corrupt", "crash")

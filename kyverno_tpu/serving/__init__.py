@@ -11,13 +11,19 @@ from .batcher import AdmissionPipeline, BatchConfig
 from .dispatch import resource_verdicts
 from .queue import (AdmissionQueue, DeadlineExceededError, QueuedRequest,
                     QueueFullError)
+from .scheduler import (ClassifyConfig, RequestClass, classify_request,
+                        parse_class_weights)
 
 __all__ = [
     "AdmissionPipeline",
     "AdmissionQueue",
     "BatchConfig",
+    "ClassifyConfig",
     "DeadlineExceededError",
     "QueueFullError",
     "QueuedRequest",
+    "RequestClass",
+    "classify_request",
+    "parse_class_weights",
     "resource_verdicts",
 ]

@@ -29,8 +29,9 @@ from ..cluster.reports import ReportAggregator, ReportResult
 from ..cluster.snapshot import ClusterSnapshot, resource_uid
 from ..engine.engine import Engine as ScalarEngine
 from ..engine.match import RequestInfo
-from ..serving import (AdmissionPipeline, BatchConfig, DeadlineExceededError,
-                       resource_verdicts)
+from ..serving import (AdmissionPipeline, BatchConfig, ClassifyConfig,
+                       DeadlineExceededError, QueueFullError,
+                       classify_request, resource_verdicts)
 from ..tpu.engine import (TpuEngine, VERDICT_NAMES, _scalar_rule_verdicts,
                           build_scan_context)
 from ..tpu.evaluator import ERROR, FAIL, NOT_MATCHED
@@ -54,14 +55,20 @@ class VerdictRows(list):
 
 
 class AdmissionPayload:
-    __slots__ = ("resource", "operation", "info", "namespace", "old")
+    __slots__ = ("resource", "operation", "info", "namespace", "old",
+                 "dry_run")
 
-    def __init__(self, resource, operation, info, namespace, old=None):
+    def __init__(self, resource, operation, info, namespace, old=None,
+                 dry_run=False):
         self.resource = resource
         self.operation = operation
         self.info = info
         self.namespace = namespace
         self.old = old
+        # AdmissionReview.request.dryRun: rescan storms replay with
+        # dryRun=true, so the scheduler classifies them into the bulk
+        # tier (serving/scheduler.py)
+        self.dry_run = dry_run
 
 
 class Handlers:
@@ -83,6 +90,7 @@ class Handlers:
         batching: bool = False,
         batch_config: Optional[BatchConfig] = None,
         request_timeout_s: float = 10.0,
+        classify_config: Optional[ClassifyConfig] = None,
     ) -> None:
         self.cache = cache
         self.snapshot = snapshot
@@ -122,6 +130,11 @@ class Handlers:
         # the validate path — shape-bucketed padding, deadline-aware
         # flushing, and high-water shedding (serving/batcher.py)
         self.pipeline: Optional[AdmissionPipeline] = None
+        # class extraction (serving/scheduler.py): every validate
+        # request is classified from its AdmissionReview metadata —
+        # username globs, dryRun, groups, the priority annotation —
+        # and the pipeline schedules/sheds by that class
+        self.classify_config = classify_config or ClassifyConfig()
         if batching:
             cfg = batch_config or BatchConfig(
                 max_batch_size=max_batch, max_wait_ms=max_wait_ms)
@@ -129,6 +142,15 @@ class Handlers:
             # agree on the dispatched shape (no double padding, no
             # surprise recompiles) — the engine is the single source
             cfg.min_bucket = TpuEngine.MIN_BUCKET
+            # the critical_reserve headroom only makes sense when some
+            # request can actually classify critical; with no promotion
+            # path configured (no --critical-users globs, annotation
+            # promotion off) the reserve would just cut effective queue
+            # capacity by its fraction — every request shed at
+            # (1-reserve)*high_water against slots nothing can use
+            if (not self.classify_config.critical_users
+                    and not self.classify_config.trust_annotation_critical):
+                cfg.critical_reserve = 0.0
             self.pipeline = AdmissionPipeline(
                 self._evaluate_padded,
                 scalar_fallback=self._scalar_verdict_rows,
@@ -136,7 +158,11 @@ class Handlers:
                 metrics=self.metrics,
                 version_provider=self._pin_version,
                 cache_lookup=self._cached_verdict_rows,
-                flight_hook=self._flight_hook)
+                flight_hook=self._flight_hook,
+                # hedged dispatch evaluates at the PINNED revision of
+                # the flush it races, so the race is bit-identical
+                # even while a hot swap lands mid-flight
+                hedge_fn=self._scalar_verdict_rows)
 
     # -- versioned engine acquisition (lifecycle/manager.py)
 
@@ -640,6 +666,18 @@ class Handlers:
                 or bool(getattr(self.toggles, "force_failure_policy_ignore",
                                 False)))
 
+    def _loaded_policies_all_ignore(self) -> bool:
+        """True when no loaded policy's failurePolicy is Fail — the
+        bare webhook path's shed/expiry resolution: with no Fail policy
+        in the set (including an EMPTY set, which evaluated normally
+        would allow) there is nothing a deny would protect."""
+        try:
+            _, policies = self.cache.snapshot()
+        except Exception:
+            return False
+        return all((p.spec.failure_policy or "Fail") == "Ignore"
+                   for p in policies)
+
     def validate(self, review: Dict[str, Any], failure_policy: str = "all",
                  policy_key=None) -> Dict[str, Any]:
         from ..resilience.retry import Deadline
@@ -680,15 +718,33 @@ class Handlers:
                 # only strands the connection
                 queue_ms = min(remaining * 1000.0,
                                self.pipeline.config.deadline_ms)
+                cls = classify_request(
+                    self.classify_config, operation=payload.operation,
+                    username=payload.info.username,
+                    namespace=payload.namespace,
+                    groups=payload.info.groups,
+                    dry_run=payload.dry_run, resource=payload.resource)
                 verdicts = self.pipeline.submit(
                     payload, deadline_ms=queue_ms,
                     eval_grace_s=min(self.pipeline.config.eval_grace_s,
-                                     max(0.0, remaining - queue_ms / 1000.0)))
+                                     max(0.0, remaining - queue_ms / 1000.0)),
+                    cls=cls)
             else:
                 verdicts = self.batcher.submit(payload, timeout=remaining)
         except Exception as e:
-            return _response(req, self._fail_open(failure_policy),
-                             f"evaluation error: {e}")
+            allowed = self._fail_open(failure_policy)
+            if not allowed and failure_policy == "all" and \
+                    isinstance(e, (QueueFullError, DeadlineExceededError)):
+                # per-class failurePolicy resolution: a shed or expiry
+                # is an ADMISSION-CONTROL decision, not an engine
+                # error. On the bare ("all") path — which carries no
+                # class filter of its own — resolve it per the
+                # failurePolicy of the policies that WOULD have
+                # evaluated: an all-Ignore set allows, any Fail policy
+                # keeps the deny. The /fail and /ignore paths already
+                # said what their class wants.
+                allowed = self._loaded_policies_all_ignore()
+            return _response(req, allowed, f"evaluation error: {e}")
         served = getattr(verdicts, "version", None)
         if served is not None:
             # recompute the class filter from the SERVED version: the
@@ -936,6 +992,7 @@ def _payload_from_request(req: Dict[str, Any], snapshot=None,
         info=info,
         namespace=req.get("namespace", ""),
         old=req.get("oldObject"),
+        dry_run=bool(req.get("dryRun")),
     )
 
 

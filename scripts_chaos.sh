@@ -12,29 +12,29 @@ set -o pipefail
 cd "$(dirname "$0")"
 rc=0
 
-echo "=== leg 1/5: tier-1 (faults disarmed) ==="
+echo "=== leg 1/6: tier-1 (faults disarmed) ==="
 KYVERNO_TPU_FAULTS= JAX_PLATFORMS=cpu timeout -k 10 870 \
   python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors \
   -p no:cacheprovider -p no:xdist -p no:randomly || rc=1
 
-echo "=== leg 2/5: slow chaos + resilience suites (tests arm faults) ==="
+echo "=== leg 2/6: slow chaos + resilience suites (tests arm faults) ==="
 KYVERNO_TPU_FAULTS= JAX_PLATFORMS=cpu timeout -k 10 870 \
   python -m pytest tests/test_chaos_load.py tests/test_resilience.py \
   tests/test_serving_load.py -q -p no:cacheprovider || rc=1
 
-echo "=== leg 3/5: serving suite under ambient env-armed faults ==="
+echo "=== leg 3/6: serving suite under ambient env-armed faults ==="
 KYVERNO_TPU_FAULTS="${AMBIENT_FAULTS:-tpu.dispatch:raise:p=0.3,seed=7}" \
   JAX_PLATFORMS=cpu timeout -k 10 870 \
   python -m pytest tests/test_serving.py tests/test_resilience.py -q \
   -p no:cacheprovider || rc=1
 
-echo "=== leg 4/5: policy churn — 64-thread load + 50ms mutator ==="
+echo "=== leg 4/6: policy churn — 64-thread load + 50ms mutator ==="
 # zero dropped requests, batch-pinned revisions, verdicts bit-identical
 # to the scalar oracle at the revision that served them
 KYVERNO_TPU_FAULTS= JAX_PLATFORMS=cpu timeout -k 10 870 \
   python -m pytest tests/test_policy_churn.py -q -p no:cacheprovider || rc=1
 
-echo "=== leg 5/5: encoder pool — worker kills, poison bisect, breaker ==="
+echo "=== leg 5/6: encoder pool — worker kills, poison bisect, breaker ==="
 # pool-enabled scans with encode.worker faults armed (crash/delay) plus
 # direct SIGKILLs of busy workers: verdicts must stay bit-identical to
 # the in-process encode, no scan aborts, the pool self-heals (restarts
@@ -45,6 +45,21 @@ KYVERNO_TPU_FAULTS= JAX_PLATFORMS=cpu timeout -k 10 870 \
 KYVERNO_TPU_FAULTS="${AMBIENT_ENCODE_FAULTS:-encode.worker:delay:p=0.2,delay_s=0.05,seed=11}" \
   JAX_PLATFORMS=cpu timeout -k 10 870 \
   python -m pytest tests/test_encode_pool.py -q -p no:cacheprovider || rc=1
+
+echo "=== leg 6/6: admission scheduling — bulk flood + critical trickle ==="
+# mixed-traffic overload with tpu.dispatch p=0.3 faults armed BY THE
+# TEST: every critical request decided correctly (scalar-oracle
+# parity), critical p99 flat (inside the flush envelope), the bulk
+# class shed FIRST and alone, zero verdict divergence across shed/
+# hedged/batched paths with the shadow verifier at rate 1.0. The
+# second pass re-runs the same overload scenario under ambient hedge
+# delay faults — a slowed (or lost) hedge race must never make a
+# request worse than plain waiting on its device batch.
+KYVERNO_TPU_FAULTS= JAX_PLATFORMS=cpu timeout -k 10 870 \
+  python -m pytest tests/test_sched_load.py -q -p no:cacheprovider || rc=1
+KYVERNO_TPU_FAULTS="${AMBIENT_HEDGE_FAULTS:-serving.hedge:delay:p=0.5,delay_s=0.1,seed=3}" \
+  JAX_PLATFORMS=cpu timeout -k 10 870 \
+  python -m pytest tests/test_sched_load.py -q -p no:cacheprovider || rc=1
 
 if [ "$rc" -eq 0 ]; then
   echo "CHAOS GATE: all legs passed"

@@ -81,7 +81,13 @@ def test_serve_batching_help(capsys):
                  "--policy-watch", "--reload-interval",
                  "--slo-admission-p99-ms", "--slo-admission-budget",
                  "--slo-scan-freshness-s", "--slo-device-coverage-floor",
-                 "--rule-metrics-top-k", "--analyze-on-swap"):
+                 "--rule-metrics-top-k", "--analyze-on-swap",
+                 # admission scheduling (serving/scheduler.py)
+                 "--class-weights", "--bulk-max-wait-ms",
+                 "--hedge-threshold", "--shed-burn-bulk",
+                 "--shed-burn-default", "--bulk-share",
+                 "--critical-reserve", "--bulk-shed-mode",
+                 "--bulk-users", "--critical-users"):
         assert flag in out
 
 

@@ -205,6 +205,14 @@ def test_exposition_format_is_scrapeable():
     reg.serving_request_latency.observe(
         99.0, exemplar={"trace_id": "cd" * 16})  # +Inf bucket exemplar
     reg.serving_queue_depth.set(7)
+    # admission scheduling families: per-class depth/outcomes, hedged
+    # race winners, class+reason-labeled sheds, class-split SLO gauges
+    reg.serving_class_queue_depth.set(2, {"class": "bulk"})
+    reg.serving_class_requests.inc({"class": "critical",
+                                    "outcome": "batched"})
+    reg.serving_hedge.inc({"winner": "device"})
+    reg.serving_shed_total.inc({"outcome": "rejected", "class": "bulk",
+                                "reason": "burn"})
     # the observatory families: rule analytics (scrape-time collector,
     # label-escaping policy names included) + SLO/starvation gauges
     acc = RuleStatsAccumulator(clock=lambda: 0.0)
@@ -214,6 +222,7 @@ def test_exposition_format_is_scrapeable():
     reg.rule_stats.accumulator = acc
     slo = SloTracker(metrics=reg)
     slo.record_admission(0.004)
+    slo.record_admission(0.2, cls="bulk")  # class-labeled SLO series
     slo.record_scan(coverage=0.97)
     # verdict-integrity: one diverged check drives the divergence
     # gauge + breached flag; the counter exemplar carries the trace id
@@ -252,8 +261,13 @@ def test_exposition_format_is_scrapeable():
                 "kyverno_slo_verification_divergences",
                 "kyverno_analysis_runs_total", "kyverno_analysis_anomalies",
                 "kyverno_analysis_witnesses",
-                "kyverno_analysis_wall_seconds"):
+                "kyverno_analysis_wall_seconds",
+                "kyverno_serving_class_queue_depth",
+                "kyverno_serving_class_requests_total",
+                "kyverno_serving_hedge_total"):
         assert f"# TYPE {fam} " in text, fam
+    # per-class SLO burn series render alongside the aggregate ones
+    assert 'kyverno_slo_admission_burn_rate{class="bulk",window=' in text
     # the divergence counter line carries its trace-id exemplar
     assert any(l.startswith("kyverno_verification_divergence_total")
                and " # {" in l for l in text.splitlines())

@@ -1,0 +1,165 @@
+"""Mixed-traffic overload test (slow tier): a bulk flood plus a
+latency-critical trickle through the real batching Handlers, with
+tpu.dispatch faults armed at p=0.3 and 100% shadow verification.
+
+The overload contract under chaos:
+- every critical request gets a correct verdict (matches the scalar
+  oracle) and none of them are shed or expired;
+- shedding hits the BULK class first (and only it, at these sizes);
+- zero verdict divergence across shed, hedged, and batched paths —
+  the shadow verifier is the referee.
+"""
+
+import concurrent.futures
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from kyverno_tpu.serving import BatchConfig, ClassifyConfig
+from tests.test_serving import DEVICE_POLICY, HOST_POLICY, _pod
+
+pytestmark = pytest.mark.slow
+
+N_BULK_THREADS = 24
+BULK_PER_THREAD = 16
+N_CRIT = 60
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults_and_breaker():
+    # the TPU breaker is process-wide: 30% dispatch faults trip it
+    # OPEN, and without a reset every later test in the process would
+    # silently run on the scalar-fallback path
+    from kyverno_tpu.resilience import global_faults, tpu_breaker
+
+    global_faults.disarm()
+    tpu_breaker().reset()
+    yield
+    global_faults.disarm()
+    tpu_breaker().reset()
+
+
+def _review(resource, uid, username):
+    return {"apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview",
+            "request": {"uid": uid, "operation": "CREATE",
+                        "namespace": "default", "object": resource,
+                        "userInfo": {"username": username}}}
+
+
+def _mk_batched_handlers():
+    from kyverno_tpu.api.policy import ClusterPolicy
+    from kyverno_tpu.cluster import PolicyCache
+    from kyverno_tpu.webhooks import build_handlers
+
+    cache = PolicyCache()
+    cache.set(ClusterPolicy.from_dict(DEVICE_POLICY))
+    cache.set(ClusterPolicy.from_dict(HOST_POLICY))
+    return build_handlers(
+        cache, batching=True,
+        batch_config=BatchConfig(
+            max_batch_size=16, max_wait_ms=5.0, min_bucket=16,
+            high_water=24, bulk_share=0.5, critical_reserve=0.1,
+            bulk_max_wait_ms=40.0, hedge_threshold=0.25,
+            bulk_shed_mode="fail",
+            # burn thresholds off: this test pins the shed cause to the
+            # class queue share so the bulk-first assertion is exact
+            shed_burn_bulk=0.0, shed_burn_default=0.0),
+        classify_config=ClassifyConfig(critical_users=("alice*",)))
+
+
+def test_mixed_traffic_critical_protected_under_dispatch_faults(
+        no_verdict_cache):
+    from kyverno_tpu.observability.flightrecorder import global_flight
+    from kyverno_tpu.observability.verification import global_verifier
+    from kyverno_tpu.resilience.faults import global_faults
+
+    global_flight.configure(sample_rate=1.0)
+    global_verifier.configure(rate=1.0)
+    handlers = _mk_batched_handlers()
+    # warm the jit cache before arming chaos so the flood measures
+    # scheduling, not compilation
+    warm = handlers.validate(_review(_pod("warm", False), "w0", "alice"))
+    assert warm["response"]["allowed"] is True
+
+    global_faults.arm("tpu.dispatch", mode="raise", p=0.3, seed=7)
+    crit_results = {}
+    crit_lat = []
+    crit_lock = threading.Lock()
+    stop_flood = threading.Event()
+
+    def bulk_worker(tid):
+        # kubelet-storm shape: classified bulk via the username glob
+        for i in range(BULK_PER_THREAD):
+            if stop_flood.is_set():
+                return
+            handlers.validate(_review(
+                _pod(f"bulk-{tid}-{i}", i % 2 == 0), f"b{tid}-{i}",
+                f"system:node:worker-{tid}"))
+
+    def crit_worker():
+        # latency-critical trickle: paced user applies
+        for i in range(N_CRIT):
+            r = _review(_pod(f"crit-{i}", i % 2 == 0), f"c{i}", "alice")
+            t0 = time.perf_counter()
+            out = handlers.validate(r)
+            dt = time.perf_counter() - t0
+            with crit_lock:
+                crit_results[f"c{i}"] = (r, out)
+                crit_lat.append(dt)
+            time.sleep(0.005)
+
+    try:
+        with concurrent.futures.ThreadPoolExecutor(
+                max_workers=N_BULK_THREADS + 1) as ex:
+            flood = [ex.submit(bulk_worker, t)
+                     for t in range(N_BULK_THREADS)]
+            crit = ex.submit(crit_worker)
+            crit.result(timeout=300)
+            stop_flood.set()
+            for f in flood:
+                f.result(timeout=300)
+    finally:
+        stop_flood.set()
+        global_faults.disarm("tpu.dispatch")
+    stats = handlers.pipeline.state()["stats"]
+    handlers.pipeline.stop()
+    handlers.batcher.stop()
+
+    # every critical request decided, correctly (vs the scalar oracle),
+    # and the critical class was never shed or expired
+    from tests.test_serving import _mk_handlers
+
+    scalar = _mk_handlers(batching=False, engine="scalar")
+    for uid, (r, got) in crit_results.items():
+        want = scalar.validate(r)
+        assert got["response"]["allowed"] == want["response"]["allowed"], uid
+        assert "evaluation error" not in str(
+            got["response"].get("status", "")), uid
+    scalar.batcher.stop()
+    assert len(crit_results) == N_CRIT
+    by_class = stats["by_class"]
+    assert by_class.get("critical", {}).get("shed", 0) == 0
+    assert by_class.get("critical", {}).get("expired", 0) == 0
+    # overload landed on the bulk class first — and at these sizes,
+    # only on it
+    assert by_class.get("bulk", {}).get("shed", 0) > 0, by_class
+    assert by_class.get("default", {}).get("shed", 0) == 0
+
+    # critical p99 stays inside the flush envelope — the flood and the
+    # injected dispatch faults never starved the trickle into its
+    # deadline (the webhook budget is 10s; "flat" here means orders of
+    # magnitude under it)
+    p99 = float(np.percentile(np.asarray(crit_lat), 99))
+    assert p99 < 2.0, f"critical p99 {p99:.3f}s"
+
+    # zero verdict divergence across every path the chaos run exercised
+    global_verifier.drain(timeout=60.0)
+    vstats = global_verifier.state()["stats"]
+    assert vstats.get("checked", 0) > 0
+    assert vstats.get("divergences", 0) == 0
+    flight_outcomes = global_flight.state()["stats"]["by_outcome"]
+    # the fault storm forced fallbacks into the ring (always-capture)
+    assert flight_outcomes.get("fallback", 0) + \
+        flight_outcomes.get("shed", 0) > 0

@@ -1,0 +1,708 @@
+"""Admission scheduling: class extraction, weighted-fair queuing,
+deadline-aware flush composition, bulk coalescing, burn-driven
+shedding, hedged scalar dispatch, and priority-ordered shutdown drain
+(fake evaluators — no device)."""
+
+import concurrent.futures
+import threading
+import time
+
+import pytest
+
+from kyverno_tpu.serving import (AdmissionPipeline, AdmissionQueue,
+                                 BatchConfig, ClassifyConfig, QueueFullError,
+                                 RequestClass, classify_request,
+                                 parse_class_weights)
+
+CRIT = RequestClass("t1", "CREATE", "critical")
+DFLT = RequestClass("t1", "CREATE", "default")
+BULK = RequestClass("t1", "CREATE", "bulk")
+
+
+def far(seconds=60.0):
+    return time.monotonic() + seconds
+
+
+# ---------------------------------------------------------------------------
+# class extraction (serving/scheduler.py)
+
+
+def test_classify_defaults():
+    cfg = ClassifyConfig()
+    assert classify_request(cfg, operation="CREATE", username="alice",
+                            namespace="apps").priority == "default"
+    assert classify_request(cfg, username="system:node:worker-1",
+                            namespace="ns").priority == "bulk"
+    assert classify_request(
+        cfg, username="system:serviceaccount:kube-system:gc",
+    ).priority == "bulk"
+    assert classify_request(cfg, username="alice",
+                            dry_run=True).priority == "bulk"
+    assert classify_request(cfg, username="alice",
+                            groups=["system:nodes"]).priority == "bulk"
+
+
+def test_classify_annotation_and_user_globs():
+    cfg = ClassifyConfig(critical_users=("deploy-bot*",))
+    assert classify_request(cfg, username="deploy-bot-7").priority == "critical"
+    res_crit = {"metadata": {"annotations":
+                             {"policies.kyverno.io/priority": "critical"}}}
+    # the annotation is requester-controlled: a self-stamped "critical"
+    # must NOT promote past the overload ladder by default...
+    assert classify_request(cfg, username="system:node:n1",
+                            resource=res_crit).priority == "bulk"
+    assert classify_request(cfg, username="alice",
+                            resource=res_crit).priority == "default"
+    # ...unless the operator opted in
+    trusting = ClassifyConfig(trust_annotation_critical=True)
+    assert classify_request(trusting, username="alice",
+                            resource=res_crit).priority == "critical"
+    # self-DEMOTION is always honored (harming yourself is allowed)...
+    res_bulk = {"metadata": {"annotations":
+                             {"policies.kyverno.io/priority": "bulk"}}}
+    assert classify_request(cfg, username="alice",
+                            resource=res_bulk).priority == "bulk"
+    # ...but never of a --critical-users identity: the annotation lives
+    # on the OBJECT (authored by whoever last wrote it), so honoring it
+    # against trusted identity would let anyone who can annotate demote
+    # someone else's critical traffic into the shed ladder
+    assert classify_request(cfg, username="deploy-bot-7",
+                            resource=res_bulk).priority == "critical"
+    # unknown annotation values are ignored, not trusted
+    res_bad = {"metadata": {"annotations":
+                            {"policies.kyverno.io/priority": "turbo"}}}
+    assert classify_request(cfg, username="alice",
+                            resource=res_bad).priority == "default"
+    # tenant falls back username -> _cluster for cluster-scoped requests
+    assert classify_request(cfg, username="alice").tenant == "alice"
+    assert classify_request(cfg).tenant == "_cluster"
+
+
+def test_parse_class_weights():
+    w = parse_class_weights("bulk=2,critical=16")
+    assert w["bulk"] == 2.0 and w["critical"] == 16.0 and w["default"] == 4.0
+    with pytest.raises(ValueError):
+        parse_class_weights("turbo=1")
+    with pytest.raises(ValueError):
+        parse_class_weights("bulk=0")
+
+
+# ---------------------------------------------------------------------------
+# weighted-fair drain composition (serving/queue.py)
+
+
+def _sched_cfg(**kw):
+    kw.setdefault("min_bucket", 1)
+    kw.setdefault("max_wait_ms", 2.0)
+    return BatchConfig(**kw)
+
+
+def test_wfq_default_outranks_backlogged_bulk():
+    cfg = _sched_cfg()
+    q = AdmissionQueue(high_water=100, config=cfg)
+    bulk = [q.put(f"b{i}", far(), cls=BULK) for i in range(2)]
+    dflt = [q.put(f"d{i}", far(), cls=DFLT) for i in range(3)]
+    with q.cv:
+        batch = q.drain(4, config=cfg)
+    # defaults (weight 4) drain ahead of the earlier-arrived bulk
+    # backlog; the 4th slot is a bulk top-up to the shape bucket —
+    # a free rider on a slot that would have been padding
+    assert [r.payload for r in batch] == ["d0", "d1", "d2", "b0"]
+    assert q.last_drain_info["bulk_topup"] == 1
+    assert bulk[1].dispatched is False and dflt[0].dispatched is True
+
+
+def test_wfq_interleaves_tenants_within_tier():
+    cfg = _sched_cfg(min_bucket=16)
+    q = AdmissionQueue(high_water=100, config=cfg)
+    a = RequestClass("tenant-a", "CREATE", "default")
+    b = RequestClass("tenant-b", "CREATE", "default")
+    for i in range(3):
+        q.put(f"a{i}", far(), cls=a)
+    for i in range(3):
+        q.put(f"b{i}", far(), cls=b)
+    with q.cv:
+        batch = q.drain(6, config=cfg)
+    # equal-weight flows interleave by virtual finish time instead of
+    # strict arrival order (tenant-a would otherwise starve tenant-b)
+    assert [r.payload for r in batch] == ["a0", "b0", "a1", "b1", "a2", "b2"]
+
+
+def test_urgent_deadline_rides_next_flush_regardless_of_class():
+    cfg = _sched_cfg(urgent_ms=50.0, bulk_max_wait_ms=60_000.0)
+    q = AdmissionQueue(high_water=100, config=cfg)
+    q.put("d0", far(), cls=DFLT)
+    urgent_bulk = q.put("b-urgent", time.monotonic() + 0.02, cls=BULK)
+    q.put("b-later", far(), cls=BULK)
+    with q.cv:
+        batch = q.drain(2, config=cfg)
+    # the deadline-imminent bulk entry rides FIRST even though bulk is
+    # young and its coalescing timer is an hour out
+    assert batch[0] is urgent_bulk
+    assert [r.payload for r in batch] == ["b-urgent", "d0"]
+    assert q.last_drain_info["urgent"] == 1
+
+
+def test_bulk_coalesces_until_mature_or_full():
+    cfg = _sched_cfg(min_bucket=1, bulk_max_wait_ms=60_000.0)
+    q = AdmissionQueue(high_water=100, config=cfg)
+    for i in range(3):
+        q.put(f"b{i}", far(), cls=BULK)
+    with q.cv:
+        batch = q.drain(8, config=cfg)
+    # nothing else queued and the window has not matured: bulk holds
+    assert batch == [] and q.depth() == 3
+    # a full batch of bulk is mature by size
+    for i in range(3, 8):
+        q.put(f"b{i}", far(), cls=BULK)
+    with q.cv:
+        batch = q.drain(8, config=cfg)
+    assert len(batch) == 8 and q.last_drain_info["bulk_mature"] is True
+
+
+def test_pipeline_bulk_flushes_on_its_own_window():
+    done = []
+    p = AdmissionPipeline(
+        lambda payloads: [("ok", x) for x in payloads if x is not None],
+        config=_sched_cfg(max_batch_size=8, max_wait_ms=2.0,
+                          bulk_max_wait_ms=150.0))
+    try:
+        t0 = time.monotonic()
+        out = p.submit("b1", cls=BULK)
+        dt_bulk = time.monotonic() - t0
+        assert out == ("ok", "b1")
+        t0 = time.monotonic()
+        p.submit("d1", cls=DFLT)
+        dt_dflt = time.monotonic() - t0
+    finally:
+        p.stop()
+    # bulk coalesced for its own (longer) window; default rode the
+    # 2ms timer
+    assert dt_bulk >= 0.1, dt_bulk
+    assert dt_dflt < 0.1, dt_dflt
+    assert p.stats["flush_reasons"].get("bulk_timer", 0) == 1
+    assert p.stats["by_class"]["bulk"]["evaluated"] == 1
+    assert p.stats["by_class"]["default"]["evaluated"] == 1
+
+
+# ---------------------------------------------------------------------------
+# burn-driven shed ladder + class queue shares
+
+
+def test_burn_shed_bulk_first_default_later_critical_never():
+    burn = {"v": 0.0}
+    calls = []
+
+    def scalar(payload):
+        calls.append(payload)
+        return ("scalar", payload)
+
+    p = AdmissionPipeline(
+        lambda payloads: [("ok", x) for x in payloads if x is not None],
+        scalar_fallback=scalar,
+        config=_sched_cfg(max_batch_size=4, shed_burn_bulk=1.0,
+                          shed_burn_default=3.0),
+        burn_provider=lambda: burn["v"])
+    try:
+        burn["v"] = 2.0  # past the bulk rung, under the default rung
+        assert p.submit("b1", cls=BULK) == ("scalar", "b1")
+        assert p.submit("d1", cls=DFLT) == ("ok", "d1")
+        burn["v"] = 5.0  # past the default rung too
+        assert p.submit("d2", cls=DFLT) == ("scalar", "d2")
+        assert p.submit("c1", cls=CRIT) == ("ok", "c1")  # never burn-shed
+    finally:
+        p.stop()
+    assert p.stats["by_class"]["bulk"]["shed"] == 1
+    assert p.stats["by_class"]["default"]["shed"] == 1
+    assert p.stats["by_class"].get("critical", {}).get("shed", 0) == 0
+    assert calls == ["b1", "d2"]
+
+
+def test_bulk_shed_mode_fail_overrides_global_scalar():
+    p = AdmissionPipeline(
+        lambda payloads: [("ok", x) for x in payloads if x is not None],
+        scalar_fallback=lambda payload: ("scalar", payload),
+        config=_sched_cfg(shed_mode="scalar", bulk_shed_mode="fail",
+                          shed_burn_bulk=1.0),
+        burn_provider=lambda: 9.0)
+    try:
+        with pytest.raises(QueueFullError, match="class=bulk"):
+            p.submit("b1", cls=BULK)
+    finally:
+        p.stop()
+
+
+def test_bulk_queue_share_sheds_bulk_while_default_enqueues():
+    started = threading.Event()
+    release = threading.Event()
+
+    def gated(payloads):
+        started.set()
+        release.wait(10)
+        return [("ok", x) for x in payloads if x is not None]
+
+    cfg = _sched_cfg(max_batch_size=1, high_water=10, bulk_share=0.2,
+                     critical_reserve=0.0, bulk_max_wait_ms=60_000.0,
+                     bulk_shed_mode="fail")
+    p = AdmissionPipeline(gated, config=cfg)
+    with concurrent.futures.ThreadPoolExecutor(max_workers=8) as ex:
+        f0 = ex.submit(p.submit, "d0", None, None, DFLT)
+        assert started.wait(5)  # flusher busy; queue now accumulates
+        futs = [ex.submit(p.submit, f"b{i}", None, None, BULK)
+                for i in range(2)]
+        time.sleep(0.05)
+        assert p.queue.depth_by_class().get("bulk") == 2
+        with pytest.raises(QueueFullError, match="queue share"):
+            p.submit("b-over", cls=BULK)  # bulk capped at 0.2 * 10 = 2
+        f_d = ex.submit(p.submit, "d1", None, None, DFLT)  # default fine
+        time.sleep(0.05)
+        release.set()
+        assert f0.result(10) == ("ok", "d0")
+        assert f_d.result(10) == ("ok", "d1")
+        for f in futs:
+            assert f.result(10)[0] == "ok"
+    p.stop()
+    assert p.stats["by_class"]["bulk"]["shed"] == 1
+
+
+# ---------------------------------------------------------------------------
+# shutdown drains priority order (satellite regression)
+
+
+def test_stop_drains_critical_before_bulk():
+    wedged = threading.Event()
+    release = threading.Event()
+
+    def stuck(payloads):
+        wedged.set()
+        release.wait(30)
+        return [("batched", x) for x in payloads if x is not None]
+
+    order = []
+
+    def scalar(payload):
+        order.append(payload)
+        return ("scalar", payload)
+
+    p = AdmissionPipeline(
+        stuck, scalar_fallback=scalar,
+        config=_sched_cfg(max_batch_size=1, eval_grace_s=0.2,
+                          bulk_max_wait_ms=60_000.0))
+    results = {}
+    threads = []
+
+    def run(name, cls):
+        results[name] = p.submit(name, 60_000, None, cls)
+
+    threads.append(threading.Thread(target=run, args=("r0", DFLT)))
+    threads[0].start()
+    assert wedged.wait(5)  # r0 in flight on the stuck evaluator
+    # queued strictly bulk-before-critical: the drain must invert it
+    for name, cls in (("b1", BULK), ("b2", BULK), ("c1", CRIT),
+                      ("d1", DFLT)):
+        t = threading.Thread(target=run, args=(name, cls))
+        t.start()
+        threads.append(t)
+        time.sleep(0.02)
+    time.sleep(0.1)
+    p.stop()  # join times out (0.2s); priority-ordered drain kicks in
+    release.set()
+    for t in threads:
+        t.join(timeout=10)
+        assert not t.is_alive()
+    assert order == ["c1", "d1", "b1", "b2"]
+    assert results["c1"] == ("scalar", "c1")
+    assert results["r0"] == ("batched", "r0")
+    assert p.queue.depth() == 0
+
+
+# ---------------------------------------------------------------------------
+# hedged scalar dispatch: both race orders, bit-identical, no double
+# resolution, flight ring labels the winning path
+
+
+def _flight_capture(records):
+    def hook(payload, result, path, latency_s, trace_id, timings=None):
+        records.append((payload, result, path))
+    return hook
+
+
+def test_hedge_scalar_wins_when_device_batch_stalls():
+    release = threading.Event()
+
+    def slow_eval(payloads):
+        release.wait(5)
+        return [("rows", x) for x in payloads if x is not None]
+
+    records = []
+    p = AdmissionPipeline(
+        slow_eval,
+        scalar_fallback=lambda payload: ("rows", payload),
+        hedge_fn=lambda payload, version: ("rows", payload),
+        config=_sched_cfg(max_batch_size=1, hedge_threshold=0.5),
+        flight_hook=_flight_capture(records))
+    try:
+        t0 = time.monotonic()
+        out = p.submit("r1", deadline_ms=600.0)
+        dt = time.monotonic() - t0
+    finally:
+        release.set()
+        p.stop()
+    # the hedge resolved it (bit-identical rows) well before the
+    # wedged device batch would have
+    assert out == ("rows", "r1")
+    assert dt < 0.6
+    assert p.stats["hedges"] == 1
+    assert p.stats["hedge_wins_scalar"] == 1
+    assert p.stats["hedge_wins_device"] == 0
+    # the flusher's late (discarded) resolution recorded the race with
+    # the winning path labeled
+    paths = [path for _, _, path in records]
+    assert "hedged_scalar" in paths
+    # the request resolved exactly once: the served result survived the
+    # device batch's later resolve attempt
+    assert ("r1", ("rows", "r1"), "hedged_scalar") in [
+        (pl, res, path) for pl, res, path in records]
+
+
+def test_hedge_device_wins_when_scalar_is_slow():
+    # deadline 2s, threshold 0.9 -> the hedge fires ~0.2s in; the
+    # device lands at ~0.3s while the slow oracle is still running
+    def timed_eval(payloads):
+        time.sleep(0.3)
+        return [("rows", x) for x in payloads if x is not None]
+
+    def slow_hedge(payload, version):
+        time.sleep(0.6)
+        return ("rows", payload)
+
+    records = []
+    p = AdmissionPipeline(
+        timed_eval,
+        scalar_fallback=lambda payload: ("rows", payload),
+        hedge_fn=slow_hedge,
+        config=_sched_cfg(max_batch_size=1, hedge_threshold=0.9),
+        flight_hook=_flight_capture(records))
+    try:
+        out = p.submit("r1", deadline_ms=2000.0)
+    finally:
+        p.stop()
+    assert out == ("rows", "r1")
+    assert p.stats["hedges"] == 1
+    assert p.stats["hedge_wins_device"] == 1
+    assert p.stats["hedge_wins_scalar"] == 0
+    # exactly ONE record for the hedged request — the losing hedge's
+    # race record labeled with the winner; the flush suppresses its
+    # own "batched" record so the ring (and the shadow verifier's
+    # denominators) never count the request twice
+    paths = [path for pl, _, path in records if pl == "r1"]
+    assert paths == ["hedged_device"]
+
+
+def test_hedge_fault_site_degrades_to_waiting():
+    from kyverno_tpu.resilience.faults import global_faults
+
+    def timed_eval(payloads):
+        time.sleep(0.4)  # slow enough that the hedge point is reached
+        return [("rows", x) for x in payloads if x is not None]
+
+    global_faults.arm("serving.hedge", mode="raise")
+    try:
+        p = AdmissionPipeline(
+            timed_eval, scalar_fallback=lambda payload: ("rows", payload),
+            config=_sched_cfg(max_batch_size=1, hedge_threshold=0.9))
+        try:
+            out = p.submit("r1", deadline_ms=2000.0)
+        finally:
+            p.stop()
+    finally:
+        global_faults.disarm("serving.hedge")
+    # the injected hedge failure cost nothing: the device batch
+    # resolved the request normally
+    assert out == ("rows", "r1")
+    assert p.stats["hedges"] == 1
+    assert p.stats["hedge_errors"] == 1
+    assert p.stats["hedge_wins_scalar"] == 0
+
+
+def test_slow_hedge_never_extends_wait_past_deadline():
+    """Time spent inside the hedge race comes out of the request's own
+    budget: a glacial oracle must not hold the caller for the full
+    pre-hedge remainder ON TOP of the hedge duration."""
+    from kyverno_tpu.serving import DeadlineExceededError
+
+    release = threading.Event()
+
+    def wedged(payloads):
+        release.wait(10)
+        return [("rows", x) for x in payloads if x is not None]
+
+    def glacial_hedge(payload, version):
+        time.sleep(2.0)  # overruns the 1s deadline all by itself
+        raise RuntimeError("oracle fell over")
+
+    p = AdmissionPipeline(
+        wedged, scalar_fallback=lambda payload: ("rows", payload),
+        hedge_fn=glacial_hedge,
+        config=_sched_cfg(max_batch_size=1, hedge_threshold=0.9))
+    t0 = time.monotonic()
+    try:
+        with pytest.raises(DeadlineExceededError):
+            p.submit("r1", deadline_ms=1000.0, eval_grace_s=0.2)
+        elapsed = time.monotonic() - t0
+    finally:
+        release.set()
+        p.stop()
+    # hedge point ~0.1s + 2.0s hedge + 0.2s grace ~= 2.3s; the old
+    # fixed-remainder wait would add the untouched 0.9s budget on top
+    assert elapsed < 2.7, elapsed
+    assert p.stats["hedge_errors"] == 1
+
+
+def test_hedged_outcome_always_captures():
+    from kyverno_tpu.observability.flightrecorder import (ALWAYS_CAPTURE,
+                                                          global_flight)
+
+    assert "hedged" in ALWAYS_CAPTURE
+    assert global_flight.classify(None, "hedged_scalar") == "hedged"
+    assert global_flight.classify(None, "hedged_device") == "hedged"
+    assert global_flight.classify(None, "hedged_device_error") == "hedged"
+
+
+def test_hedge_lost_to_evaluator_error_counts_device_error():
+    """The flush resolving with an evaluator ERROR before the oracle
+    finishes is not a device win: the accounting and the flight record
+    must say device_error, not a bit-identical race that never ran."""
+    def failing_eval(payloads):
+        time.sleep(0.25)  # past the hedge point, before the oracle ends
+        raise RuntimeError("device batch failed")
+
+    def slow_hedge(payload, version):
+        time.sleep(0.6)
+        return ("rows", payload)
+
+    records = []
+    p = AdmissionPipeline(
+        failing_eval, scalar_fallback=lambda payload: ("rows", payload),
+        hedge_fn=slow_hedge,
+        config=_sched_cfg(max_batch_size=1, hedge_threshold=0.9),
+        flight_hook=_flight_capture(records))
+    try:
+        with pytest.raises(RuntimeError):
+            p.submit("r1", deadline_ms=2000.0)
+    finally:
+        p.stop()
+    assert p.stats["hedges"] == 1
+    assert p.stats["hedge_lost_to_error"] == 1
+    assert p.stats["hedge_wins_device"] == 0
+    assert p.stats["hedge_wins_scalar"] == 0
+    # exactly one record, labeled with the truth and carrying the error
+    entries = [(res, path) for pl, res, path in records if pl == "r1"]
+    assert len(entries) == 1
+    res, path = entries[0]
+    assert path == "hedged_device_error"
+    assert isinstance(res, RuntimeError)
+
+
+def test_hedge_arms_after_late_dispatch():
+    """The hedge condition is continuous: a request still QUEUED when
+    its threshold trips (queue wait ate the budget — the overload case
+    hedging exists for) must still race once the flush picks it up."""
+    def slow_eval(payloads):
+        time.sleep(0.5)
+        return [("rows", x) for x in payloads if x is not None]
+
+    p = AdmissionPipeline(
+        slow_eval, scalar_fallback=lambda payload: ("rows", payload),
+        hedge_fn=lambda payload, version: ("rows", payload),
+        config=_sched_cfg(max_batch_size=1, hedge_threshold=0.7))
+    results = {}
+    try:
+        t = threading.Thread(
+            target=lambda: results.update(r1=p.submit("r1",
+                                                      deadline_ms=3000.0)))
+        t.start()
+        time.sleep(0.1)  # r1's flush in flight; flusher busy ~0.5s
+        # r2's hedge point (~0.24s in) arrives while it is still queued
+        # behind r1's batch; it is dispatched at ~0.5s with ~0.4s budget
+        # left against a 0.5s device batch — only a re-armed hedge wins
+        t0 = time.monotonic()
+        results["r2"] = p.submit("r2", deadline_ms=800.0)
+        dt = time.monotonic() - t0
+        t.join(timeout=10)
+    finally:
+        p.stop()
+    assert results["r1"] == ("rows", "r1")
+    assert results["r2"] == ("rows", "r2")
+    assert dt < 0.8, dt
+    assert p.stats["hedges"] == 1
+    assert p.stats["hedge_wins_scalar"] == 1
+
+
+def test_expired_drain_respects_prior_hedge_resolution():
+    """A drained past-deadline request a hedge already resolved keeps
+    the hedge's outcome — the flush must not also count it expired
+    (one outcome per request)."""
+    from kyverno_tpu.observability.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    p = AdmissionPipeline(
+        lambda payloads: [("rows", x) for x in payloads if x is not None],
+        config=_sched_cfg(max_batch_size=4), metrics=reg)
+    try:
+        now = time.monotonic()
+        req = p.queue.put("r1", deadline=now - 1.0, now=now - 2.0, cls=DFLT)
+        with p.queue.cv:
+            batch = p.queue.drain(4, config=p.config)
+        assert req in batch
+        # a hedge race resolved it before _process ran
+        assert req.resolve(("rows", "r1"), winner="hedge_scalar")
+        p._process(batch, "timer")
+    finally:
+        p.stop()
+    assert reg.serving_class_requests.value(
+        {"class": "default", "outcome": "expired"}) == 0
+    assert p.stats["expired"] == 0
+    assert p.stats["by_class"]["default"]["expired"] == 0
+    assert req.result == ("rows", "r1")
+
+
+def test_parse_class_weights_rejects_nan_and_inf():
+    with pytest.raises(ValueError):
+        parse_class_weights("bulk=nan")
+    with pytest.raises(ValueError):
+        parse_class_weights("bulk=inf")
+    # library-built dicts degrade to the default weight, never NaN tags
+    from kyverno_tpu.serving.scheduler import class_weight
+
+    assert class_weight({"bulk": float("nan")}, BULK) == 4.0
+    assert class_weight({"bulk": float("inf")}, BULK) == 4.0
+
+
+def test_critical_reserve_inert_without_critical_path():
+    """With no promotion path to the critical tier configured, the
+    reserve must not silently cut effective queue capacity."""
+    from kyverno_tpu.api.policy import ClusterPolicy
+    from kyverno_tpu.cluster import PolicyCache
+    from kyverno_tpu.webhooks import build_handlers
+
+    policy = {"apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+              "metadata": {"name": "p"},
+              "spec": {"rules": [{"name": "r",
+                                  "match": {"any": [{"resources":
+                                                     {"kinds": ["Pod"]}}]},
+                                  "validate": {"message": "m",
+                                               "pattern": {"metadata":
+                                                           {"name": "?*"}}}}]}}
+    cache = PolicyCache()
+    cache.set(ClusterPolicy.from_dict(policy))
+    # default classify config: nothing can ever classify critical
+    h = build_handlers(cache, batching=True,
+                       batch_config=BatchConfig(critical_reserve=0.1))
+    try:
+        assert h.pipeline.config.critical_reserve == 0.0
+    finally:
+        h.pipeline.stop()
+    # an operator-configured promotion path keeps the reserve
+    h2 = build_handlers(cache, batching=True,
+                        batch_config=BatchConfig(critical_reserve=0.1),
+                        classify_config=ClassifyConfig(
+                            critical_users=("deploy-bot*",)))
+    try:
+        assert h2.pipeline.config.critical_reserve == 0.1
+    finally:
+        h2.pipeline.stop()
+
+
+# ---------------------------------------------------------------------------
+# per-class SLO windows + the cached burn accessor
+# (observability/analytics.py)
+
+
+def test_slo_per_class_windows_and_gauges():
+    from kyverno_tpu.observability.analytics import SloTracker
+    from kyverno_tpu.observability.metrics import MetricsRegistry
+
+    clock = {"t": 1000.0}
+    reg = MetricsRegistry()
+    slo = SloTracker(metrics=reg, clock=lambda: clock["t"])
+    slo.config.admission_p99_target_ms = 50.0
+    slo.config.admission_error_budget = 0.01
+    for _ in range(10):
+        slo.record_admission(0.005, cls="critical")
+    for _ in range(10):
+        slo.record_admission(0.5, cls="bulk")
+    state = slo.state()
+    w = state["admission"]["windows"]["5m"]
+    assert w["requests"] == 20 and w["slow"] == 10
+    assert w["by_class"]["critical"]["slow"] == 0
+    assert w["by_class"]["bulk"]["slow"] == 10
+    assert w["by_class"]["bulk"]["burn_rate"] > 1.0
+    slo.update_gauges()
+    assert reg.slo_admission_burn.value({"window": "5m",
+                                         "class": "bulk"}) > 1.0
+    assert reg.slo_admission_burn.value({"window": "5m",
+                                         "class": "critical"}) == 0.0
+
+
+def test_admission_burn_fast_cached():
+    from kyverno_tpu.observability.analytics import SloTracker
+
+    clock = {"t": 1000.0}
+    slo = SloTracker(clock=lambda: clock["t"])
+    slo.config.admission_p99_target_ms = 50.0
+    slo.config.admission_error_budget = 0.01
+    for _ in range(10):
+        slo.record_admission(0.005)
+    for _ in range(10):
+        slo.record_admission(0.5)
+    burn = slo.admission_burn_fast()
+    assert burn == pytest.approx((10 / 20) / 0.01)
+    # cached: new samples inside max_age do not change the reading...
+    for _ in range(100):
+        slo.record_admission(0.5)
+    assert slo.admission_burn_fast() == burn
+    # ...until the cache ages out
+    clock["t"] += 1.0
+    assert slo.admission_burn_fast() > burn
+
+
+# ---------------------------------------------------------------------------
+# per-class metric families are exposed
+
+
+def test_class_metric_families_in_exposition():
+    from kyverno_tpu.observability.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    reg.serving_class_queue_depth.set(3, {"class": "bulk"})
+    reg.serving_class_requests.inc({"class": "critical",
+                                    "outcome": "batched"})
+    reg.serving_hedge.inc({"winner": "scalar"})
+    reg.serving_shed_total.inc({"outcome": "rejected", "class": "bulk",
+                                "reason": "burn"})
+    text = reg.exposition()
+    assert 'kyverno_serving_class_queue_depth{class="bulk"} 3' in text
+    assert 'kyverno_serving_class_requests_total{class="critical"' in text
+    assert 'kyverno_serving_hedge_total{winner="scalar"} 1' in text
+    assert 'reason="burn"' in text
+
+
+def test_pipeline_publishes_class_metrics_and_state():
+    from kyverno_tpu.observability.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    p = AdmissionPipeline(
+        lambda payloads: [("ok", x) for x in payloads if x is not None],
+        config=_sched_cfg(max_batch_size=4), metrics=reg)
+    try:
+        p.submit("c1", cls=CRIT)
+        p.submit("d1", cls=DFLT)
+    finally:
+        p.stop()
+    assert reg.serving_class_requests.value(
+        {"class": "critical", "outcome": "batched"}) == 1
+    state = p.state()
+    assert state["stats"]["by_class"]["critical"]["evaluated"] == 1
+    assert "class_weights" in state["config"]
+    assert "queue_depth_by_class" in state
