@@ -39,6 +39,12 @@ Other configs (run `python bench.py <name>`):
              same snapshot scanned uncached, cache-cold (inserting),
              and cache-warm (serving columns from the LRU); records
              the hit rate and speedup (BENCH_CACHED_RESOURCES)
+  --columnar  columnar resource store (cluster/columnar.py): cold
+             segment-encode vs warm pure-gather rescan feed rates,
+             full-JSON-walk / diff-segment counts per leg (warm
+             asserted zero), watch-diff re-encode rate, and a
+             store-on vs store-off verdict shadow check
+             (BENCH_COLUMNAR_RESOURCES)
   encode_scaling  supervised encoder-pool throughput at 1/2/4 worker
              processes + pipelined-scan feed-starvation with the pool
              on vs off (BENCH_ENCODE_RESOURCES / _CHUNK /
@@ -1485,6 +1491,141 @@ def bench_analyze(tile=None):
     }
 
 
+def bench_columnar(n_resources=None, tile=1024):
+    """Columnar-store feed (cluster/columnar.py): cold segment-encode
+    into the store vs warm pure-gather rescan, full-JSON-walk and
+    diff-segment counts per leg, the watch-diff re-encode rate, and a
+    store-on vs store-off verdict shadow check (the fresh-encode
+    oracle). Acceptance: the warm leg does ZERO walks and ZERO segment
+    encodes and feeds >= 5x the single-thread vectorized python
+    baseline."""
+    import copy
+
+    import numpy as np
+
+    import kyverno_tpu.native as native_mod
+    from kyverno_tpu.cluster.columnar import (configure_store, get_store,
+                                              reset_store, subtree_hash)
+    from kyverno_tpu.observability.metrics import global_registry as reg
+    from kyverno_tpu.parallel.sharding import ShardedScanner
+    from kyverno_tpu.policies import load_pss_policies
+    from kyverno_tpu.policy.autogen import expand_policy
+    from kyverno_tpu.tpu.cache import resource_content_hash
+    from kyverno_tpu.tpu.flatten import encode_resources_vocab
+
+    if n_resources is None:
+        n_resources = int(os.environ.get("BENCH_COLUMNAR_RESOURCES", "4000"))
+    policies = [expand_policy(p) for p in load_pss_policies()]
+    resources = make_snapshot(n_resources, seed=33)
+    tiles = [resources[i:i + tile] for i in range(0, n_resources, tile)]
+    reset_store()
+    sc = ShardedScanner(policies)
+    cfg, bp, kbp = sc.cps.encode_cfg, sc.cps.byte_paths, sc.cps.key_byte_paths
+
+    # PR 7 single-thread vectorized baseline: the python fast path
+    # (the ~1.7k res/s point); the native C walk reported alongside
+    real_load = native_mod.load
+    native_mod.load = lambda: None
+    try:
+        t0 = time.perf_counter()
+        for t in tiles:
+            encode_resources_vocab(t, cfg, bp, kbp)
+        t_python = time.perf_counter() - t0
+    finally:
+        native_mod.load = real_load
+    t_native = None
+    if real_load() is not None:
+        t0 = time.perf_counter()
+        for t in tiles:
+            encode_resources_vocab(t, cfg, bp, kbp)
+        t_native = time.perf_counter() - t0
+
+    store = configure_store(enabled=True)
+    # the scan path keys gathers off the snapshot's STORED hashes
+    # (cluster/scanner.py threads them through the pipeline), so the
+    # timed legs get them precomputed exactly like a real rescan
+    tile_hashes = [[resource_content_hash(r) for r in t] for t in tiles]
+    walks0 = reg.encode_json_walks.value()
+    segs0 = reg.encode_diff_segments.value()
+    t0 = time.perf_counter()
+    for t, th in zip(tiles, tile_hashes):
+        store.encode_vocab(t, cfg, bp, kbp, hashes=th)
+    t_cold = time.perf_counter() - t0
+    cold_walks = reg.encode_json_walks.value() - walks0
+    cold_segs = reg.encode_diff_segments.value() - segs0
+
+    walks1 = reg.encode_json_walks.value()
+    segs1 = reg.encode_diff_segments.value()
+    t0 = time.perf_counter()
+    for t, th in zip(tiles, tile_hashes):
+        store.encode_vocab(t, cfg, bp, kbp, hashes=th)
+    t_warm = time.perf_counter() - t0
+    warm_walks = reg.encode_json_walks.value() - walks1
+    warm_segs = reg.encode_diff_segments.value() - segs1
+
+    # watch-diff leg: establish per-uid segments for 10% of the
+    # snapshot, edit one subtree each, re-encode incrementally
+    subset = list(range(0, n_resources, 10))
+    for i in subset:
+        r = resources[i]
+        store.warm(cfg, bp, kbp, r, resource_content_hash(r),
+                   uid=f"bench-{i}",
+                   subhashes={k: subtree_hash(v) for k, v in r.items()})
+    edited = []
+    for i in subset:
+        r = copy.deepcopy(resources[i])
+        r["metadata"].setdefault("labels", {})["edited"] = "1"
+        edited.append((i, r))
+    segs2 = reg.encode_diff_segments.value()
+    reused0 = reg.columnar_segments_reused.value()
+    t0 = time.perf_counter()
+    for i, r in edited:
+        store.warm(cfg, bp, kbp, r, resource_content_hash(r),
+                   uid=f"bench-{i}",
+                   subhashes={k: subtree_hash(v) for k, v in r.items()})
+    t_diff = time.perf_counter() - t0
+    diff_segs = reg.encode_diff_segments.value() - segs2
+    diff_reused = reg.columnar_segments_reused.value() - reused0
+
+    # shadow check: store-path verdicts vs the fresh-encode oracle
+    shadow = resources[: min(512, n_resources)]
+    reset_store()
+    off = ShardedScanner(policies).scan(shadow)
+    configure_store(enabled=True)
+    on = ShardedScanner(policies).scan(shadow)
+    bit_identical = bool(off.rules == on.rules
+                         and np.array_equal(off.verdicts, on.verdicts))
+    state = get_store().state()
+    reset_store()
+    speedup = t_python / max(t_warm, 1e-9)
+    out = {
+        "metric": "columnar_warm_feed_speedup",
+        "value": round(speedup, 2),
+        "unit": "x vs single-thread vectorized python encode",
+        "vs_baseline": round(speedup, 2),
+        "resources": n_resources,
+        "python_encode_res_per_s": round(n_resources / max(t_python, 1e-9), 1),
+        "cold_store_res_per_s": round(n_resources / max(t_cold, 1e-9), 1),
+        "warm_store_res_per_s": round(n_resources / max(t_warm, 1e-9), 1),
+        "diff_reencode_res_per_s": round(len(edited) / max(t_diff, 1e-9), 1),
+        "cold_walks": cold_walks,
+        "cold_segments": cold_segs,
+        "warm_walks": warm_walks,
+        "warm_segments": warm_segs,
+        "diff_segments_per_edit": round(diff_segs / max(len(edited), 1), 2),
+        "diff_segments_reused": diff_reused,
+        "store_rows": state["tables"][0]["rows"] if state["tables"] else 0,
+        "bit_identical": bit_identical,
+    }
+    if t_native is not None:
+        out["native_encode_res_per_s"] = round(
+            n_resources / max(t_native, 1e-9), 1)
+    assert warm_walks == 0 and warm_segs == 0, \
+        "warm columnar rescan performed feed work"
+    assert bit_identical, "columnar verdicts diverged from fresh encode"
+    return out
+
+
 FNS = {
     "scan": lambda: bench_scan(),
     "match": lambda: bench_match(),
@@ -1495,6 +1636,7 @@ FNS = {
     "fallback": lambda: bench_fallback(),
     "churn": lambda: bench_churn(),
     "cached": lambda: bench_cached(),
+    "columnar": lambda: bench_columnar(),
     "encode_scaling": lambda: bench_encode_scaling(),
     "patterns": lambda: bench_patterns(),
     "analyze": lambda: bench_analyze(),
@@ -1729,8 +1871,8 @@ def run_all():
         out["mixed_corpus_coverage"] = {"error": repr(e)[:300]}
     emit(out)
     for name in ("match", "overlay", "apply", "admission", "mixed_traffic",
-                 "fallback", "cached", "encode_scaling", "patterns",
-                 "analyze", "churn"):
+                 "fallback", "cached", "columnar", "encode_scaling",
+                 "patterns", "analyze", "churn"):
         if only and name not in only:
             continue
         t0 = time.perf_counter()
@@ -1814,6 +1956,8 @@ def main():
         config = "analyze"
     if config == "--mixed-traffic":  # flag spelling of mixed_traffic
         config = "mixed_traffic"
+    if config == "--columnar":  # flag spelling of the columnar config
+        config = "columnar"
     if config in ("capture", "--capture"):
         # replay a spooled flight capture as the admission workload:
         # `python bench.py --capture FILE` (kyverno-tpu flight-dump

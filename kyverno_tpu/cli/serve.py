@@ -139,6 +139,26 @@ def add_parser(sub: argparse._SubParsersAction) -> None:
                    help="encode-row LRU capacity in entries "
                         "(default $KYVERNO_TPU_ENCODE_CACHE or 8192; "
                         "0 disables)")
+    # columnar resource store (cluster/columnar.py): encoded rows are
+    # the system of record between watch event and device batch —
+    # rescans gather pre-flattened lanes, watch upserts re-encode only
+    # the touched top-level subtrees
+    p.add_argument("--columnar-dir", default=None, metavar="DIR",
+                   help="back the columnar row store onto mmap files "
+                        "under DIR so restarts (and other processes "
+                        "mapping the same directory) share warm rows "
+                        "zero-copy (default $KYVERNO_TPU_COLUMNAR_DIR "
+                        "or in-memory only)")
+    p.add_argument("--no-columnar", action="store_true",
+                   help="disable the columnar row store entirely: "
+                        "every rescan re-walks resource JSON (the "
+                        "pre-PR-13 feed path)")
+    p.add_argument("--columnar-entries", type=int, default=None,
+                   metavar="N",
+                   help="live encoded-resource entries kept per encode "
+                        "path before LRU eviction + arena compaction "
+                        "(default $KYVERNO_TPU_COLUMNAR_ENTRIES or "
+                        "131072)")
     # supervised multiprocess encode pool (encode/pool.py): scales the
     # device feed past one Python process, with crash/hang supervision,
     # poison-resource quarantine, and an encode-pool breaker that
@@ -346,6 +366,14 @@ class ControlPlane:
         from ..encode import shutdown_pool
 
         shutdown_pool()
+        from ..cluster.columnar import get_store
+
+        store = get_store()
+        if store is not None:
+            try:
+                store.sync()  # flush mmap arenas for the next process
+            except Exception:
+                pass
         self._cleanup_on_shutdown(self.snapshot, self.lease_store)
 
 
@@ -457,6 +485,16 @@ def run(args: argparse.Namespace) -> int:
     xla_dir = enable_xla_compile_cache(args.xla_cache_dir)
     if xla_dir:
         global_oplog.emit("xla_cache_enabled", dir=xla_dir)
+    # columnar row store ON by default for serve (in-memory unless
+    # --columnar-dir): encoded rows — not JSON — feed the device
+    from ..cluster.columnar import configure_store
+
+    store = configure_store(directory=args.columnar_dir,
+                            enabled=not args.no_columnar,
+                            capacity=args.columnar_entries)
+    if store is not None:
+        global_oplog.emit("columnar_store_enabled",
+                          dir=store.dir or "(memory)")
     # the encoder pool spawns BEFORE any compile: worker interpreters
     # come up (JAX-free) while the parent pays the XLA build
     from ..encode import configure_pool

@@ -85,6 +85,14 @@ class BackgroundScanService:
                 self._scanned.pop(uid, None)
                 self._dirty.discard(uid)
             self.aggregator.drop(uid)
+            try:
+                from .columnar import get_store
+
+                store = get_store()
+                if store is not None:
+                    store.forget_uid(uid)
+            except Exception:
+                pass
             # a deleted Namespace invalidates members too (the uid no
             # longer resolves, so derive the name from the uid key)
             if '/Namespace:' in uid:
@@ -306,11 +314,29 @@ class BackgroundScanService:
                                            source="cached")
             eng.record_pattern_replay(len(hit_entries))
         if miss:
-            chunks, chunk_keys = [], []
+            # columnar feed: diff-encode what actually moved BEFORE
+            # chunk assembly — a watch upsert re-encodes only its
+            # touched top-level subtrees against the uid's stored
+            # segments, so the pipelined encode below is pure gather
+            from .columnar import get_store
+
+            store = get_store()
+            if store is not None and store.enabled:
+                cfg = eng.cps.encode_cfg
+                bp, kbp = eng.cps.byte_paths, eng.cps.key_byte_paths
+                for uid, res, h in miss:
+                    try:
+                        store.warm(cfg, bp, kbp, res, h, uid=uid,
+                                   subhashes=self.snapshot.subhashes_of(uid))
+                    except Exception:
+                        break  # store trouble: the encoder still works
+            chunks, chunk_keys, chunk_hashes = [], [], []
             for start in range(0, len(miss), self.batch_size):
                 chunks.append([r for (_, r, _) in
                                miss[start:start + self.batch_size]])
                 chunk_keys.append(miss_keys[start:start + self.batch_size])
+                chunk_hashes.append([h for (_, _, h) in
+                                     miss[start:start + self.batch_size]])
 
             reported = set()
 
@@ -330,7 +356,8 @@ class BackgroundScanService:
             # k-1 both overlap chunk k's device execution
             try:
                 pstats = pipe.scan_chunks(chunks, ns_labels,
-                                          on_result=on_result)
+                                          on_result=on_result,
+                                          content_hashes=chunk_hashes)
                 self.stats["pipeline_overlap_ratio"] = \
                     pstats["overlap_ratio"]
                 # the supervised encode pool (encode/pool.py) feeds the
@@ -366,6 +393,14 @@ class BackgroundScanService:
         self.stats["scans"] += 1
         self.stats["resources_scanned"] += total
         self._record_slo(eng)
+        try:
+            from .columnar import get_store
+
+            store = get_store()
+            if store is not None:
+                store.sync()  # persist mmap arenas once per tick
+        except Exception:
+            pass
         return total
 
     def _record_slo(self, eng) -> None:

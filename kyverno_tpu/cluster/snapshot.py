@@ -39,8 +39,35 @@ class ClusterSnapshot:
         self._resources: Dict[str, Dict[str, Any]] = {}
         self._hashes: Dict[str, str] = {}
         self._subscribers: List[Callable[[str, str], None]] = []
+        # namespace -> labels index, maintained incrementally at
+        # upsert/delete: namespace_labels() is called per scan tick AND
+        # per admission flush, so it must not walk every resource
+        self._ns_labels: Dict[str, Dict[str, str]] = {}
+        self._ns_uids: Dict[str, str] = {}  # uid -> indexed ns name
+        self._ns_owner: Dict[str, str] = {}  # ns name -> owning uid
+        # per-resource top-level subtree hashes, computed lazily for
+        # the columnar store's watch-diff encode (cluster/columnar.py)
+        # and invalidated by content-hash movement
+        self._subhash_cache: Dict[str, Tuple[str, Dict[str, str]]] = {}
 
     # -- mutation (watch events)
+
+    def _index_namespace(self, uid: str, resource: Dict[str, Any]) -> None:
+        """Caller holds the lock. Ownership check: a namespace can be
+        recreated under a new uid before the old uid's delete event
+        arrives (watch relist) — only the CURRENT owner's removal may
+        drop the index entry, or the late delete would wipe the live
+        namespace's labels."""
+        old_name = self._ns_uids.pop(uid, None)
+        if old_name is not None and self._ns_owner.get(old_name) == uid:
+            self._ns_labels.pop(old_name, None)
+            self._ns_owner.pop(old_name, None)
+        if resource.get("kind") == "Namespace":
+            meta = resource.get("metadata") or {}
+            name = meta.get("name", "")
+            self._ns_labels[name] = dict(meta.get("labels") or {})
+            self._ns_uids[uid] = name
+            self._ns_owner[name] = uid
 
     def upsert(self, resource: Dict[str, Any]) -> str:
         uid = resource_uid(resource)
@@ -49,6 +76,9 @@ class ClusterSnapshot:
             changed = self._hashes.get(uid) != h
             self._resources[uid] = resource
             self._hashes[uid] = h
+            self._index_namespace(uid, resource)
+            if changed:
+                self._subhash_cache.pop(uid, None)
         if changed:
             self._notify(uid, "upsert")
         return uid
@@ -58,6 +88,11 @@ class ClusterSnapshot:
         with self._lock:
             self._resources.pop(uid, None)
             self._hashes.pop(uid, None)
+            self._subhash_cache.pop(uid, None)
+            name = self._ns_uids.pop(uid, None)
+            if name is not None and self._ns_owner.get(name) == uid:
+                self._ns_labels.pop(name, None)
+                self._ns_owner.pop(name, None)
         self._notify(uid, "delete")
 
     def _notify(self, uid: str, change: str) -> None:
@@ -92,13 +127,38 @@ class ClusterSnapshot:
                     for uid in self._resources]
 
     def namespace_labels(self) -> Dict[str, Dict[str, str]]:
-        out: Dict[str, Dict[str, str]] = {}
+        """namespace -> labels from the incrementally-maintained index
+        (O(namespaces), not O(resources) — this runs every scan tick
+        and every admission flush). Returns copies: callers may stash
+        the maps across a later upsert."""
         with self._lock:
-            for res in self._resources.values():
-                if res.get("kind") == "Namespace":
-                    meta = res.get("metadata") or {}
-                    out[meta.get("name", "")] = dict(meta.get("labels") or {})
-        return out
+            return {name: dict(labels)
+                    for name, labels in self._ns_labels.items()}
+
+    def subhashes_of(self, uid: str) -> Dict[str, str]:
+        """Per-top-level-key content hashes of the resource — the
+        flatten-path-level diff units the columnar store splices by
+        (the ONE shared formula, columnar.subtree_hash — segment reuse
+        keys on these matching exactly). Computed lazily (zero cost
+        when the store is off) and cached against the resource's
+        content hash."""
+        from .columnar import subtree_hash
+
+        with self._lock:
+            res = self._resources.get(uid)
+            if res is None or not isinstance(res, dict):
+                return {}
+            h = self._hashes[uid]
+            cached = self._subhash_cache.get(uid)
+            if cached is not None and cached[0] == h:
+                return cached[1]
+            subs: Dict[str, str] = {}
+            for k, v in res.items():
+                sh = subtree_hash(v)
+                if sh is not None:  # unhashable subtree: always re-encoded
+                    subs[str(k)] = sh
+            self._subhash_cache[uid] = (h, subs)
+            return subs
 
     def __len__(self) -> int:
         with self._lock:

@@ -202,6 +202,49 @@ class MetricsRegistry:
         self.encode_cache_evictions = self.counter(
             "kyverno_tpu_encode_cache_evictions_total",
             "encode-row cache entries evicted at the LRU bound")
+        # columnar resource store (cluster/columnar.py): encoded rows —
+        # not JSON — are the system of record between watch event and
+        # device batch. The walk counter is the feed-work gate metric:
+        # an unchanged-resource rescan with the store warm must move
+        # NEITHER the full-walk nor the diff-segment counter
+        # (scripts_columnar_gate.sh asserts exactly that).
+        self.encode_json_walks = self.counter(
+            "kyverno_tpu_encode_json_walks_total",
+            "full JSON flatten walks performed by the row encoders "
+            "(pad resources excluded)")
+        self.encode_diff_segments = self.counter(
+            "kyverno_tpu_encode_diff_segments_total",
+            "top-level subtree segment encodes on the incremental "
+            "watch-diff path")
+        self.columnar_store = self.counter(
+            "kyverno_tpu_columnar_store_total",
+            "columnar row-store lookups by outcome (hit/miss)")
+        self.columnar_segments_reused = self.counter(
+            "kyverno_tpu_columnar_segments_reused_total",
+            "unchanged top-level subtrees spliced from stored segments "
+            "instead of re-encoded during a watch-diff encode")
+        self.columnar_gather_rows = self.counter(
+            "kyverno_tpu_columnar_gather_rows_total",
+            "encoded rows assembled into device batches by vectorized "
+            "per-lane gather from the columnar store")
+        self.columnar_store_entries = self.gauge(
+            "kyverno_tpu_columnar_store_entries",
+            "live encoded-resource entries across all columnar tables")
+        self.columnar_store_rows = self.gauge(
+            "kyverno_tpu_columnar_store_rows",
+            "encoded lane rows resident in the columnar store arenas "
+            "(live + not-yet-compacted dead)")
+        self.columnar_store_bytes = self.gauge(
+            "kyverno_tpu_columnar_store_bytes",
+            "bytes held by the columnar store arenas (or mapped from "
+            "disk when mmap-backed)")
+        self.columnar_rebuilds = self.counter(
+            "kyverno_tpu_columnar_rebuilds_total",
+            "columnar mmap tables discarded at load (truncated/corrupt/"
+            "mismatched) and rebuilt empty")
+        self.columnar_compactions = self.counter(
+            "kyverno_tpu_columnar_compactions_total",
+            "columnar arena compactions reclaiming dead rows")
         # device-side string matching (tpu/dfa.py): pattern-bearing
         # cells by resolution path — device (DFA verdict stood),
         # confirm (approximate/byte-sensitive hit confirmed by the

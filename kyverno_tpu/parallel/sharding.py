@@ -155,12 +155,31 @@ class ShardedScanner:
         d = self.n_devices
         return ((n + d - 1) // d) * d
 
-    def encode(self, resources, namespace_labels=None, operations=None):
+    def encode(self, resources, namespace_labels=None, operations=None,
+               content_hashes=None):
         # the ONE vocab-encode body, shared with the encoder-pool
         # workers (encode/tasks.py run_vocab drives the same function
         # against the shipped profile) so pooled and in-process encodes
         # cannot drift
+        from ..cluster.columnar import get_store
         from ..encode.tasks import encode_vocab_host
+
+        store = get_store()
+        if store is not None and store.enabled:
+            # columnar feed: rows gather from the store (misses
+            # segment-encode into it) instead of re-walking JSON. The
+            # caller-provided content hashes skip re-serializing
+            # unchanged bodies; pad resources hash on the fly.
+            hashes = list(content_hashes or [])
+
+            def encoder(res, cfg, bp, kbp):
+                return store.encode_vocab(res, cfg, bp, kbp,
+                                          hashes=hashes[: len(res)])
+        else:
+            # late-bound through THIS module so a patched
+            # sharding.encode_resources_vocab still intercepts
+            def encoder(*a, **kw):
+                return encode_resources_vocab(*a, **kw)
 
         host, n, buckets = encode_vocab_host(
             resources, namespace_labels, operations,
@@ -170,9 +189,7 @@ class ShardedScanner:
             getattr(self, "_used_keys", None),
             self.n_devices,
             (self._vbucket, self._sbucket, self._rbucket),
-            # late-bound through THIS module so a patched
-            # sharding.encode_resources_vocab still intercepts
-            encoder=lambda *a, **kw: encode_resources_vocab(*a, **kw))
+            encoder=encoder)
         self._vbucket, self._sbucket, self._rbucket = buckets
         return host, n
 

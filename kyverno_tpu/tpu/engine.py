@@ -263,12 +263,14 @@ class TpuEngine:
         poison resource (crashes two workers, bisected) comes back
         flagged and is marked for host fallback exactly like an
         encode-cap overflow — the scalar oracle answers its column."""
-        from .cache import (EncodeRowCache, global_encode_cache,
-                            resource_content_hash)
+        from ..cluster.columnar import get_store
+        from .cache import (EncodeRowCache, apply_rows_multi, extract_rows,
+                            global_encode_cache, resource_content_hash)
         from .flatten import RowBatch
 
         ec = global_encode_cache
-        if not ec.enabled:
+        store = get_store()
+        if not ec.enabled and store is None:
             return encode_resources(resources, self.cps.encode_cfg,
                                     self.cps.byte_paths,
                                     self.cps.key_byte_paths)
@@ -278,11 +280,25 @@ class TpuEngine:
                 self.cps.key_byte_paths)
         batch = RowBatch(len(resources), self.cps.encode_cfg)
         misses: List[Tuple[int, Optional[Tuple[str, str]]]] = []
+        hit_entries: List[Any] = []
+        hit_idx: List[int] = []
         for i, res in enumerate(resources):
             h = resource_content_hash(res)
             key = (self._encode_cache_key, h) if h is not None else None
-            if key is None or not ec.get_into(key, batch, i):
+            entry = (ec.get_entry(key)
+                     if key is not None and ec.enabled else None)
+            if entry is None and key is not None and store is not None:
+                # columnar tier under the LRU: rows another engine (or
+                # the scan loop, or a prior process via mmap) encoded
+                entry = store.get_entry(self._encode_cache_key, h)
+            if entry is None:
                 misses.append((i, key))
+            else:
+                hit_entries.append(entry)
+                hit_idx.append(i)
+        # ALL hits land in one vectorized fancy-index scatter per lane
+        # (apply_rows_multi) instead of a per-resource Python loop
+        apply_rows_multi(hit_entries, batch, hit_idx)
         if misses and self._encode_rows_pooled(resources, batch, misses, ec):
             return batch
         if misses:
@@ -295,7 +311,13 @@ class TpuEngine:
                 for name, arr in sub_arrays.items():
                     batch_arrays[name][i] = arr[j]
                 if key is not None:
-                    ec.put_from(key, sub, j)
+                    entry = extract_rows(sub, j)
+                    ec.put_entry(key, entry)
+                    if store is not None:
+                        store.put_entry(self.cps.encode_cfg,
+                                        self.cps.byte_paths,
+                                        self.cps.key_byte_paths,
+                                        key[1], entry)
         return batch
 
     # pooling a miss set smaller than this costs more in IPC round-trip
@@ -330,6 +352,9 @@ class TpuEngine:
             # REPORTED encode error re-raises in-process too, where the
             # existing quarantine ladder owns it
             return False
+        from ..cluster.columnar import get_store
+
+        store = get_store()
         poison = set(out.get("poison") or ())
         for j, (i, key) in enumerate(misses):
             if j in poison:
@@ -342,6 +367,13 @@ class TpuEngine:
             apply_rows(entry, batch, i)
             if key is not None:
                 ec.put_entry(key, entry)
+                if store is not None:
+                    # pooled results are system-of-record rows too: the
+                    # next scan gathers them instead of re-encoding
+                    store.put_entry(self.cps.encode_cfg,
+                                    self.cps.byte_paths,
+                                    self.cps.key_byte_paths,
+                                    key[1], entry)
         return True
 
     def _encode_dyn_lanes(self, resources, operations, admission_infos):

@@ -333,6 +333,24 @@ class _ResourceEncoder:
         return r
 
 
+def _count_json_walks(resources: Sequence[Any]) -> None:
+    """Account a full JSON flatten walk per non-empty resource on
+    ``kyverno_tpu_encode_json_walks_total`` — the gate metric for the
+    columnar store (cluster/columnar.py): an unchanged-resource rescan
+    with the store warm must not move this counter. Pad resources
+    ({}) carry no content to walk and are excluded so bucket padding
+    never counts as feed work."""
+    n = sum(1 for r in resources if r)
+    if not n:
+        return
+    try:
+        from ..observability.metrics import global_registry
+
+        global_registry.encode_json_walks.inc(value=n)
+    except Exception:
+        pass  # accounting must never break an encode
+
+
 def encode_resources_reference(
     resources: Sequence[Dict[str, Any]],
     cfg: Optional[EncodeConfig] = None,
@@ -702,6 +720,7 @@ def encode_resources(
     cfg = cfg or EncodeConfig()
     bp = set(byte_paths or ())
     kbp = set(key_byte_paths or ())
+    _count_json_walks(resources)
     batch = RowBatch(len(resources), cfg)
     enc = _FastEncoder(batch, bp, kbp)
     for i, res in enumerate(resources):
@@ -868,6 +887,7 @@ def encode_resources_vocab(
     semantics — parity-tested against it lane by lane). Uses the
     native C walk when the extension builds; Python otherwise."""
     cfg = cfg or EncodeConfig()
+    _count_json_walks(resources)
     from ..native import load as _load_native
 
     native = _load_native()
@@ -894,6 +914,36 @@ _NODE_FLOAT_FIELDS = frozenset({"arr_len", "num_val", "qty_val", "dur_val"})
 _PATH_FIELDS = ("norm_hi", "norm_lo", "parent_hi", "parent_lo",
                 "key_hi", "key_lo", "key_glob")
 
+# the canonical packed-int64 row-matrix column order used for the
+# exact vocabulary dedup: (lane name, packs-as-float64-bits). Shared by
+# _finish_vocab and the columnar store's gather assembly
+# (cluster/columnar.py) so the two vocabulary forms cannot drift.
+VOCAB_MATRIX_FIELDS: Tuple[Tuple[str, bool], ...] = tuple(
+    [(n, False) for n in _PATH_FIELDS]
+    + [(n, n in _NODE_FLOAT_FIELDS) for n in _NODE_FIELDS]
+    + [("scope1", False), ("scope2", False), ("s2_overflow", False),
+       ("byte_slot", False), ("key_byte_slot", False)])
+
+
+def vocab_lanes_from_unique(uniq: np.ndarray) -> Dict[str, np.ndarray]:
+    """Vocabulary lane arrays from a deduped row matrix in
+    ``VOCAB_MATRIX_FIELDS`` column order (row id 0 is the reserved
+    all-zero padding row)."""
+    V = uniq.shape[0] + 1
+    lanes = {name: np.zeros((V,), dtype=_ROW_LANE_DTYPES[name])
+             for name in _ROW_LANES}
+    for l in ("scope1", "scope2", "byte_slot", "key_byte_slot"):
+        lanes[l][0] = -1
+    for k, (name, is_float) in enumerate(VOCAB_MATRIX_FIELDS):
+        col = uniq[:, k]
+        if is_float:
+            lanes[name][1:] = col.view(np.float64).astype(
+                _ROW_LANE_DTYPES[name])
+        else:
+            lanes[name][1:] = col.astype(_ROW_LANE_DTYPES[name])
+    lanes["valid"][1:] = 1
+    return lanes
+
 
 def _finish_vocab(enc: _FastEncoder, vb: VocabBatch) -> None:
     """Columnar vocabulary assembly: one zip-transpose per record
@@ -910,53 +960,34 @@ def _finish_vocab(enc: _FastEncoder, vb: VocabBatch) -> None:
         lanes[l][0] = -1
     if nflat:
         flat_arr = np.asarray(enc.flat, dtype=np.int64)
+        # columns in VOCAB_MATRIX_FIELDS order (the shared dedup layout)
         cols: List[np.ndarray] = []
-        names: List[Tuple[str, bool]] = []  # (lane, is_float)
         pcols = tuple(zip(*enc.paths))
         for k, name in enumerate(_PATH_FIELDS):
             cols.append(np.asarray(pcols[k], dtype=np.int64))
-            names.append((name, False))
         ncols = tuple(zip(*enc.nodes))
         for k, name in enumerate(_NODE_FIELDS):
             if name in _NODE_FLOAT_FIELDS:
                 cols.append(np.asarray(ncols[k],
                                        dtype=np.float64).view(np.int64))
-                names.append((name, True))
             else:
                 cols.append(np.asarray(ncols[k], dtype=np.int64))
-                names.append((name, False))
-        for name, data in (("scope1", enc.scope1), ("scope2", enc.scope2),
-                           ("s2_overflow", enc.s2_over)):
+        for data in (enc.scope1, enc.scope2, enc.s2_over):
             cols.append(np.asarray(data, dtype=np.int64))
-            names.append((name, False))
         # byte-slot assignments arrive as sparse (flat idx, slot) pairs;
         # enc.flat ascends strictly, so searchsorted maps them back
-        for name, pairs in (("byte_slot", enc.byte_slots),
-                            ("key_byte_slot", enc.key_byte_slots)):
+        for pairs in (enc.byte_slots, enc.key_byte_slots):
             arr = np.full((nflat,), -1, dtype=np.int64)
             if pairs:
                 idxs, slots = zip(*pairs)
                 arr[np.searchsorted(flat_arr,
                                     np.asarray(idxs, dtype=np.int64))] = slots
             cols.append(arr)
-            names.append((name, False))
         matrix = np.stack(cols, axis=1)
         uniq, inverse = np.unique(matrix, axis=0, return_inverse=True)
         vb.row_idx.ravel()[flat_arr] = \
             (inverse.reshape(-1) + 1).astype(np.int32)
-        V = uniq.shape[0] + 1
-        lanes = {name: np.zeros((V,), dtype=_ROW_LANE_DTYPES[name])
-                 for name in _ROW_LANES}
-        for l in ("scope1", "scope2", "byte_slot", "key_byte_slot"):
-            lanes[l][0] = -1
-        for k, (name, is_float) in enumerate(names):
-            col = uniq[:, k]
-            if is_float:
-                lanes[name][1:] = col.view(np.float64).astype(
-                    _ROW_LANE_DTYPES[name])
-            else:
-                lanes[name][1:] = col.astype(_ROW_LANE_DTYPES[name])
-        lanes["valid"][1:] = 1
+        lanes = vocab_lanes_from_unique(uniq)
     vb.lanes = lanes
 
     sids: Dict[bytes, int] = {b"": 0}
@@ -967,3 +998,168 @@ def _finish_vocab(enc: _FastEncoder, vb: VocabBatch) -> None:
             sids[data] = sid
             vb.strs.append(data)
         vb.pool_sidx[i, slot] = sid
+
+
+# ---------------------------------------------------------------------------
+# Segment-level encoding — the incremental watch-diff unit.
+#
+# A resource's rows are emitted in one DFS pass, so each top-level key's
+# subtree occupies a CONTIGUOUS row range whose lane values depend only
+# on the subtree itself (path hashes continue from the root FNV state,
+# scopes/depth reset at the top level). The two pieces of whole-resource
+# state — the row budget and the byte-pool slot counter — are both
+# strictly sequential in walk order, so a watch diff can re-encode only
+# the CHANGED top-level subtrees and splice the untouched segments back
+# from the columnar store (cluster/columnar.py), replaying the pool
+# counter across the composed sequence. compose_segments reproduces the
+# full walk's truncation and pool-overflow ladders exactly, so a diffed
+# re-encode is bit-identical to a fresh encode of the same object.
+
+
+class Segment:
+    """Encoded rows of one top-level subtree: trimmed lane arrays (the
+    byte-slot lanes are derived at compose time), the pool-assignment
+    list in walk order as (row_rel, lane, utf8 bytes), and the
+    subtree's own cap-overflow flag."""
+
+    __slots__ = ("key", "lanes", "assigns", "n", "ok")
+
+    def __init__(self, key: str, lanes: Dict[str, np.ndarray],
+                 assigns: List[Tuple[int, str, bytes]], n: int, ok: bool):
+        self.key = key
+        self.lanes = lanes
+        self.assigns = assigns
+        self.n = n
+        self.ok = ok
+
+
+# lanes a Segment carries directly; byte_slot/key_byte_slot are
+# replayed from ``assigns`` and ``valid`` is constant 1
+SEGMENT_LANES = tuple(n for n in _ROW_LANES
+                      if n not in ("byte_slot", "key_byte_slot", "valid"))
+
+
+def _segment_from_encoder(key: str, enc: _FastEncoder) -> Segment:
+    n = len(enc.flat)
+    lanes: Dict[str, np.ndarray] = {}
+    if n:
+        pcols = tuple(zip(*enc.paths))
+        for k, name in enumerate(_PATH_FIELDS):
+            lanes[name] = np.asarray(pcols[k], dtype=_ROW_LANE_DTYPES[name])
+        ncols = tuple(zip(*enc.nodes))
+        for k, name in enumerate(_NODE_FIELDS):
+            lanes[name] = np.asarray(ncols[k], dtype=_ROW_LANE_DTYPES[name])
+        lanes["scope1"] = np.asarray(enc.scope1, dtype=np.int32)
+        lanes["scope2"] = np.asarray(enc.scope2, dtype=np.int32)
+        lanes["s2_overflow"] = np.asarray(enc.s2_over, dtype=np.uint8)
+    else:
+        lanes = {name: np.zeros((0,), dtype=_ROW_LANE_DTYPES[name])
+                 for name in SEGMENT_LANES}
+    # pool assignments in walk order: each successful _assign_pool
+    # appended one pool_strs row AND one (flat, slot) pair, slot-major
+    by_slot: Dict[int, Tuple[int, str]] = {}
+    for (fi, slot) in enc.byte_slots:
+        by_slot[slot] = (fi, "byte_slot")
+    for (fi, slot) in enc.key_byte_slots:
+        by_slot[slot] = (fi, "key_byte_slot")
+    assigns: List[Tuple[int, str, bytes]] = []
+    for (_, slot, data) in enc.pool_strs:
+        fi, lane = by_slot[slot]
+        assigns.append((fi, lane, data))
+    return Segment(key, lanes, assigns, n, enc.ok)
+
+
+def encode_segment(key: Any, value: Any, cfg: EncodeConfig,
+                   byte_paths: Optional[Iterable[int]] = None,
+                   key_byte_paths: Optional[Iterable[int]] = None) -> Segment:
+    """Encode ONE top-level subtree (``resource[key]``) — the partial
+    walk of the incremental watch-diff path. Counts on
+    ``kyverno_tpu_encode_diff_segments_total``, never on the full-walk
+    counter."""
+    enc = _FastEncoder(_CfgShell(cfg), set(byte_paths or ()),
+                       set(key_byte_paths or ()))
+    enc.begin(0)
+    ks = key if type(key) is str else str(key)
+    crec = _PATH_MEMO.child(_FNV_ROOT_STATE, ks)
+    hi, lo = split32(ROOT_HASH)
+    enc.walk(value, crec, hi, lo, -1, -1, 0)
+    try:
+        from ..observability.metrics import global_registry
+
+        global_registry.encode_diff_segments.inc()
+    except Exception:
+        pass
+    return _segment_from_encoder(ks, enc)
+
+
+def root_row_lanes(n_keys: int) -> Dict[str, Any]:
+    """Lane values of the resource's root map row (row 0 of every
+    encoded resource): recomputed at compose time from the new object's
+    key count."""
+    hi, lo = split32(ROOT_HASH)
+    out: Dict[str, Any] = {name: 0 for name in _ROW_LANES}
+    out.update(norm_hi=hi, norm_lo=lo, type_tag=T_MAP,
+               arr_len=float(n_keys), scope1=-1, scope2=-1,
+               byte_slot=-1, key_byte_slot=-1, valid=1)
+    return out
+
+
+def compose_segments(n_keys: int, segments: Sequence[Segment],
+                     cfg: EncodeConfig):
+    """Compose per-top-level-key segments (in the object's key order)
+    into one resource's trimmed row entry. Reproduces the full walk's
+    whole-resource ladders: rows clip at ``max_rows`` in DFS order, and
+    the byte pool replays as one sequential counter — an assignment to
+    a clipped row never happened, an assignment past the slot cap fails
+    without consuming a slot, and either overflow flags fallback.
+
+    Returns ``(lanes, pool, pool_len, n_rows, fallback, placed)`` with
+    ``placed = [(segment, row_off, rows_kept)]`` for the diff index."""
+    max_rows = cfg.max_rows
+    ok = True
+    placed: List[Tuple[Segment, int, int]] = []
+    off = 1  # the root row
+    for seg in segments:
+        kept = max(0, min(seg.n, max_rows - off))
+        if kept < seg.n or not seg.ok:
+            ok = False
+        placed.append((seg, off, kept))
+        off += kept
+    total = off
+    lanes = {name: np.zeros((total,), dtype=_ROW_LANE_DTYPES[name])
+             for name in _ROW_LANES}
+    for l in ("scope1", "scope2", "byte_slot", "key_byte_slot"):
+        lanes[l][:] = -1
+    lanes["valid"][:] = 1
+    root = root_row_lanes(n_keys)
+    for name in _ROW_LANES:
+        lanes[name][0] = root[name]
+    for seg, so, kept in placed:
+        if not kept:
+            continue
+        for name in SEGMENT_LANES:
+            lanes[name][so:so + kept] = seg.lanes[name][:kept]
+    pool_rows: List[bytes] = []
+    for seg, so, kept in placed:
+        for (row_rel, lane, data) in seg.assigns:
+            if row_rel >= kept:
+                continue  # row never emitted in the full walk
+            if len(pool_rows) >= cfg.byte_pool_slots:
+                ok = False
+                continue  # slots exhausted: fails, consumes nothing
+            lanes[lane][so + row_rel] = len(pool_rows)
+            pool_rows.append(data)
+    # canonical trimmed form (cache.extract_rows): drop trailing
+    # zero-length slots — dangling byte_slot refs past the pool write
+    # nothing when applied, exactly like the LRU entries
+    s = len(pool_rows)
+    while s and not pool_rows[s - 1]:
+        s -= 1
+    pool = pool_len = None
+    if s:
+        pool = np.zeros((s, cfg.byte_pool_width), dtype=np.uint8)
+        pool_len = np.zeros((s,), dtype=np.int32)
+        for i, data in enumerate(pool_rows[:s]):
+            pool[i, : len(data)] = np.frombuffer(data, dtype=np.uint8)
+            pool_len[i] = len(data)
+    return lanes, pool, pool_len, total, (0 if ok else 1), placed

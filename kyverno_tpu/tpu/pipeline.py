@@ -104,6 +104,14 @@ class PipelinedScanner:
         self._pool_profile: Optional[Tuple[Any, int]] = None
 
     def _resolve_pool(self):
+        from ..cluster.columnar import get_store
+
+        if get_store() is not None:
+            # columnar feed active: chunks assemble by gather from the
+            # store (misses diff-encode into it) — shipping them to
+            # pool workers would re-walk JSON and bypass the store.
+            # The pool still serves the admission rows feed.
+            return None
         if self._encode_pool is not None:
             return self._encode_pool if self._encode_pool.running else None
         from ..encode import get_pool
@@ -124,12 +132,16 @@ class PipelinedScanner:
         namespace_labels: Optional[Dict[str, Dict[str, str]]] = None,
         operations: Optional[Sequence[Sequence[str]]] = None,
         on_result: Optional[OnResult] = None,
+        content_hashes: Optional[Sequence[Sequence[Optional[str]]]] = None,
     ) -> Dict[str, Any]:
         """Scan ``chunks`` (a list of resource lists). Results are
         delivered through ``on_result`` per chunk, in order; the
         returned stats carry the phase split and the measured overlap
         ratio ((encode+device+host seconds - wall) / wall — 0 means
-        strictly serial)."""
+        strictly serial). ``content_hashes`` (per-chunk, aligned with
+        ``chunks``) lets the columnar-store feed key its gathers off
+        the snapshot's stored hashes instead of re-serializing
+        every body."""
         stats: Dict[str, Any] = {
             "encode_s": 0.0, "device_s": 0.0, "host_s": 0.0,
             "encode_wait_s": 0.0, "starved_s": 0.0,
@@ -174,8 +186,13 @@ class PipelinedScanner:
                                            parent=scan_ctx,
                                            tile=len(chunk)):
                     ops = list(operations[idx]) if operations else None
-                    batch, n = self.scanner.encode(
-                        chunk, namespace_labels, ops)
+                    if content_hashes is not None:
+                        batch, n = self.scanner.encode(
+                            chunk, namespace_labels, ops,
+                            content_hashes=content_hashes[idx])
+                    else:
+                        batch, n = self.scanner.encode(
+                            chunk, namespace_labels, ops)
                 payload: Optional[Any] = (batch, n, None)
             except Exception:
                 payload = None  # serial quarantining fallback

@@ -600,6 +600,7 @@ class Handlers:
             "patterns": _pattern_state(
                 active.engine.cps if active is not None else None),
             "encode_pool": _encode_pool_state(),
+            "columnar": _columnar_state(),
             "faults_armed": {
                 site: {"mode": spec.mode, "calls": spec.calls,
                        "fired": spec.fired}
@@ -1036,6 +1037,18 @@ def _encode_pool_state():
         from ..encode import pool_state
 
         return pool_state()
+    except Exception:
+        return {"enabled": False}
+
+
+def _columnar_state():
+    """The columnar row store's /debug/state block: per-table arena
+    occupancy, hit/miss/segment accounting, and the feed-work counters
+    the columnar gate asserts on ({'enabled': False} when off)."""
+    try:
+        from ..cluster.columnar import store_state
+
+        return store_state()
     except Exception:
         return {"enabled": False}
 

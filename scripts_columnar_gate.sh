@@ -1,0 +1,169 @@
+#!/bin/bash
+# Columnar-store gate: the feed-work contract, asserted end-to-end
+# through the real serve control plane.
+#
+# Leg 1 drives two full /scan posts against a store-enabled control
+# plane and asserts the SECOND performs zero full-JSON flatten walks
+# AND zero diff-segment encodes (kyverno_tpu_encode_json_walks_total /
+# kyverno_tpu_encode_diff_segments_total frozen) while the report
+# verdicts stay identical; a one-subtree watch upsert then re-encodes
+# exactly one segment. Leg 2 corrupts a persisted mmap arena and
+# asserts the next process rebuilds cold — correct verdicts, rebuild
+# counted, no crash. Leg 3 runs the columnar + diff test file.
+#
+# Usage: ./scripts_columnar_gate.sh
+set -o pipefail
+cd "$(dirname "$0")"
+rc=0
+
+echo "=== leg 1/3: two /scan posts — second must do zero feed work ==="
+JAX_PLATFORMS=cpu timeout -k 10 600 python - <<'EOF' || rc=1
+import copy
+import http.client
+import json
+import sys
+
+from kyverno_tpu.api.policy import ClusterPolicy
+from kyverno_tpu.cluster.columnar import configure_store
+from kyverno_tpu.cli.serve import ControlPlane
+from kyverno_tpu.observability.metrics import global_registry as reg
+
+configure_store(enabled=True)  # serve's default; explicit here
+
+POLICIES = [ClusterPolicy.from_dict({
+    "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+    "metadata": {"name": "col-gate"},
+    "spec": {"validationFailureAction": "Enforce", "rules": [{
+        "name": "no-privileged",
+        "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+        "validate": {"message": "privileged",
+                     "pattern": {"spec": {"containers": [
+                         {"securityContext": {"privileged": "!true"}}]}}},
+    }]}})]
+
+
+def get(port, path):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+    conn.request("GET", path)
+    resp = conn.getresponse()
+    body = resp.read()
+    conn.close()
+    return resp.status, body
+
+
+def post(port, path, doc):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+    conn.request("POST", path, json.dumps(doc),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    body = resp.read()
+    conn.close()
+    return resp.status, body
+
+
+cp = ControlPlane(POLICIES, port=0, metrics_port=0)
+cp.start(scan_interval=3600.0)
+met = cp.metrics_server.server_address[1]
+ok = True
+try:
+    for i in range(50):
+        post(met, "/snapshot/upsert", {
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": f"p{i}", "namespace": "default",
+                         "uid": f"gate-{i}"},
+            "spec": {"containers": [{
+                "name": "c", "image": "nginx",
+                "securityContext": {"privileged": i % 4 == 0}}]}})
+    s1, b1 = post(met, "/scan", {"full": True})
+    assert s1 == 200, b1
+    sum1 = json.loads(b1)["summary"]
+    walks0 = reg.encode_json_walks.value()
+    segs0 = reg.encode_diff_segments.value()
+    s2, b2 = post(met, "/scan", {"full": True})
+    assert s2 == 200, b2
+    sum2 = json.loads(b2)["summary"]
+    dwalks = reg.encode_json_walks.value() - walks0
+    dsegs = reg.encode_diff_segments.value() - segs0
+    if dwalks != 0 or dsegs != 0:
+        print(f"FAIL: warm full rescan did feed work "
+              f"(walks={dwalks}, segments={dsegs})")
+        ok = False
+    if sum1 != sum2:
+        print(f"FAIL: rescan summary moved: {sum1} -> {sum2}")
+        ok = False
+    # one-subtree watch upsert: exactly one diff segment re-encodes
+    pod = copy.deepcopy(cp.snapshot.get("gate-1"))
+    pod["spec"]["hostNetwork"] = True
+    post(met, "/snapshot/upsert", pod)
+    segs1 = reg.encode_diff_segments.value()
+    walks1 = reg.encode_json_walks.value()
+    post(met, "/scan", {})
+    if reg.encode_json_walks.value() - walks1 != 0:
+        print("FAIL: watch upsert fell back to a full JSON walk")
+        ok = False
+    if reg.encode_diff_segments.value() - segs1 != 1:
+        print(f"FAIL: expected 1 diff segment, got "
+              f"{reg.encode_diff_segments.value() - segs1}")
+        ok = False
+    # the /metrics + /debug surfaces carry the store block
+    st, body = get(met, "/metrics")
+    assert st == 200 and b"kyverno_tpu_encode_json_walks_total" in body
+    st, body = get(met, "/debug/state")
+    assert st == 200 and json.loads(body)["columnar"]["enabled"] is True
+finally:
+    cp.stop()
+if not ok:
+    sys.exit(1)
+print("leg 1 OK: warm rescan walks=0 segments=0, verdicts stable, "
+      "1-subtree upsert -> 1 segment")
+EOF
+
+echo "=== leg 2/3: corrupt mmap arena -> cold rebuild, never wrong ==="
+JAX_PLATFORMS=cpu timeout -k 10 600 python - <<'EOF' || rc=1
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+from kyverno_tpu.cluster.columnar import ColumnarStore
+from kyverno_tpu.observability.metrics import global_registry as reg
+from kyverno_tpu.tpu.flatten import EncodeConfig, encode_resources_vocab
+
+cfg = EncodeConfig()
+res = [{"apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": f"p{i}", "uid": f"u{i}"},
+        "spec": {"containers": [{"name": "c", "image": "nginx"}]}}
+       for i in range(8)]
+d = tempfile.mkdtemp(prefix="colgate-")
+s1 = ColumnarStore(directory=d)
+s1.encode_vocab(res, cfg)
+s1.sync()
+(tdir,) = [os.path.join(d, n) for n in os.listdir(d)
+           if os.path.isdir(os.path.join(d, n))]
+with open(os.path.join(tdir, "lane_norm_lo.bin"), "r+b") as f:
+    f.truncate(3)  # torn write
+r0 = reg.columnar_rebuilds.value()
+s2 = ColumnarStore(directory=d)  # must not raise
+assert reg.columnar_rebuilds.value() == r0 + 1, "rebuild not counted"
+vb = s2.encode_vocab(res, cfg)
+ref = encode_resources_vocab(res, cfg)
+for name in ref.lanes:
+    if not np.array_equal(vb.lanes[name][vb.row_idx],
+                          ref.lanes[name][ref.row_idx]):
+        print(f"FAIL: lane {name} wrong after rebuild")
+        sys.exit(1)
+print("leg 2 OK: truncated arena -> rebuild counted, rows correct")
+EOF
+
+echo "=== leg 3/3: columnar + diff-encode test file ==="
+JAX_PLATFORMS=cpu timeout -k 10 600 \
+  python -m pytest tests/test_columnar.py -q \
+  -p no:cacheprovider -p no:xdist -p no:randomly || rc=1
+
+if [ $rc -eq 0 ]; then
+  echo "columnar gate: ALL LEGS PASSED"
+else
+  echo "columnar gate: FAILURES (rc=$rc)"
+fi
+exit $rc

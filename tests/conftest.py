@@ -26,12 +26,15 @@ import pytest  # noqa: E402
 
 @pytest.fixture(autouse=True)
 def _fresh_perf_caches():
+    from kyverno_tpu.cluster.columnar import reset_store
     from kyverno_tpu.tpu.cache import (global_encode_cache,
                                        global_verdict_cache)
 
     global_verdict_cache.clear()
     global_encode_cache.clear()
+    reset_store()  # the columnar store is opt-in; drop any leftover
     yield
+    reset_store()
 
 
 # the policy observatory (observability/analytics.py) accumulates

@@ -101,6 +101,17 @@ def test_serve_help_covers_flight_flags(capsys):
         assert flag in out
 
 
+def test_serve_help_covers_columnar_flags(capsys):
+    """The columnar row store's knobs (cluster/columnar.py) must be
+    operator-visible: mmap directory, kill switch, entry bound."""
+    with pytest.raises(SystemExit) as exc:
+        main(["serve", "--help"])
+    assert exc.value.code == 0
+    out = capsys.readouterr().out
+    for flag in ("--columnar-dir", "--no-columnar", "--columnar-entries"):
+        assert flag in out
+
+
 def test_replay_and_flight_dump_help(capsys):
     with pytest.raises(SystemExit) as exc:
         main(["replay", "--help"])

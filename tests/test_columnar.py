@@ -1,0 +1,497 @@
+"""Columnar resource store + incremental watch-diff encode.
+
+The contract under test: every row that reaches the device through the
+store — gathered, diffed, composed, restored from mmap — is
+bit-identical to a fresh full-walk encode of the same object, and an
+unchanged-resource rescan performs zero full JSON walks AND zero
+segment encodes. Robustness: a truncated or corrupt mmap file rebuilds
+an empty table (cold, never wrong)."""
+
+import copy
+import json
+import os
+
+import numpy as np
+import pytest
+
+from kyverno_tpu.cluster.columnar import (ColumnarStore, configure_store,
+                                          get_store, reset_store,
+                                          subtree_hash)
+from kyverno_tpu.cluster.snapshot import ClusterSnapshot
+from kyverno_tpu.observability.metrics import global_registry as reg
+from kyverno_tpu.tpu.cache import (apply_rows, apply_rows_multi,
+                                   extract_rows, resource_content_hash)
+from kyverno_tpu.tpu.flatten import (EncodeConfig, RowBatch,
+                                     encode_resources,
+                                     encode_resources_vocab)
+from kyverno_tpu.tpu.hashing import hash_path
+
+
+def make_pod(i=0, **spec_extra):
+    return {
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": f"p{i}", "namespace": "default",
+                     "uid": f"uid-{i}", "labels": {"app": f"a{i % 3}"}},
+        "spec": {"containers": [
+            {"name": "c", "image": "nginx:1.25",
+             "securityContext": {"privileged": i % 2 == 0}}],
+            **spec_extra},
+    }
+
+
+BP = {hash_path(("spec", "containers", "[]", "image"))}
+KBP = {hash_path(("metadata", "labels"))}
+
+
+def entries_equal(a, b):
+    assert a.n_rows == b.n_rows
+    assert a.fallback == b.fallback
+    for name in b.lanes:
+        assert np.array_equal(a.lanes[name], b.lanes[name]), name
+    if b.pool is None:
+        assert a.pool is None
+    else:
+        assert np.array_equal(a.pool, b.pool)
+        assert np.array_equal(a.pool_len, b.pool_len)
+
+
+def fresh_entry(res, cfg, bp=(), kbp=()):
+    return extract_rows(encode_resources([res], cfg, bp, kbp), 0)
+
+
+# ---------------------------------------------------------------------------
+# diff-encode bit-identity across pathological edits (satellite 3)
+
+
+def diff_roundtrip(cfg, r_old, r_new, bp=(), kbp=()):
+    """Encode r_old (cold), then r_new as a uid-diff against its stored
+    segments; return the diffed entry for comparison against a fresh
+    full encode of r_new."""
+    store = ColumnarStore()
+    store.warm(cfg, bp, kbp, r_old, resource_content_hash(r_old),
+               uid="u", subhashes={k: subtree_hash(v)
+                                   for k, v in r_old.items()})
+    store.warm(cfg, bp, kbp, r_new, resource_content_hash(r_new),
+               uid="u", subhashes={k: subtree_hash(v)
+                                   for k, v in r_new.items()})
+    ekey = store.encode_key(cfg, bp, kbp)
+    return store.get_entry(ekey, resource_content_hash(r_new))
+
+
+def test_diff_value_type_change_bit_identical():
+    cfg = EncodeConfig()
+    r_old = make_pod(1, hostNetwork=True)
+    r_new = copy.deepcopy(r_old)
+    r_new["spec"]["hostNetwork"] = "true"  # bool -> string at a path
+    e = diff_roundtrip(cfg, r_old, r_new, BP, KBP)
+    entries_equal(e, fresh_entry(r_new, cfg, BP, KBP))
+
+
+def test_diff_array_length_change_bit_identical():
+    cfg = EncodeConfig()
+    r_old = make_pod(2)
+    r_new = copy.deepcopy(r_old)
+    r_new["spec"]["containers"].append(
+        {"name": "c2", "image": "redis:7", "ports": [{"containerPort": 1}]})
+    e = diff_roundtrip(cfg, r_old, r_new, BP, KBP)
+    entries_equal(e, fresh_entry(r_new, cfg, BP, KBP))
+
+
+def test_diff_label_key_deleted_bit_identical():
+    cfg = EncodeConfig()
+    r_old = make_pod(3)
+    r_new = copy.deepcopy(r_old)
+    del r_new["metadata"]["labels"]["app"]
+    e = diff_roundtrip(cfg, r_old, r_new, BP, KBP)
+    entries_equal(e, fresh_entry(r_new, cfg, BP, KBP))
+
+
+def test_diff_path_moves_in_and_out_of_byte_pool():
+    # the byte pool is a whole-resource sequential counter: editing an
+    # EARLY subtree must renumber the pool slots of LATER (spliced)
+    # segments exactly like a fresh walk would
+    cfg = EncodeConfig()
+    r_old = make_pod(4)
+    r_old["metadata"]["labels"]["z"] = "pooled-via-wildcard"
+    r_new = copy.deepcopy(r_old)
+    # image is byte-pooled; removing the container drops its pool slot
+    r_new["spec"]["containers"] = []
+    e = diff_roundtrip(cfg, r_old, r_new, BP, KBP)
+    entries_equal(e, fresh_entry(r_new, cfg, BP, KBP))
+    # and back IN: a later edit restores a pooled path
+    store = ColumnarStore()
+    for r in (r_old, r_new, r_old):
+        store.warm(cfg, BP, KBP, r, resource_content_hash(r), uid="u",
+                   subhashes={k: subtree_hash(v) for k, v in r.items()})
+    e2 = store.get_entry(store.encode_key(cfg, BP, KBP),
+                         resource_content_hash(r_old))
+    entries_equal(e2, fresh_entry(r_old, cfg, BP, KBP))
+
+
+def test_diff_row_cap_overflow_bit_identical():
+    # composed resource clips at max_rows in DFS order with fallback
+    # flagged, exactly like the full walk
+    cfg = EncodeConfig(max_rows=24)
+    r_old = make_pod(5)
+    r_new = copy.deepcopy(r_old)
+    r_new["status"] = {"conditions": [{"type": f"t{j}", "status": "True"}
+                                      for j in range(10)]}
+    e = diff_roundtrip(cfg, r_old, r_new)
+    ref = fresh_entry(r_new, cfg)
+    assert ref.fallback == 1  # the edit genuinely overflows
+    entries_equal(e, ref)
+
+
+def test_diff_reencodes_only_touched_subtrees():
+    cfg = EncodeConfig()
+    store = ColumnarStore()
+    r1 = make_pod(6)
+    store.warm(cfg, (), (), r1, resource_content_hash(r1), uid="u6",
+               subhashes={k: subtree_hash(v) for k, v in r1.items()})
+    r2 = copy.deepcopy(r1)
+    r2["spec"]["hostNetwork"] = True
+    s0 = reg.encode_diff_segments.value()
+    u0 = reg.columnar_segments_reused.value()
+    w0 = reg.encode_json_walks.value()
+    store.warm(cfg, (), (), r2, resource_content_hash(r2), uid="u6",
+               subhashes={k: subtree_hash(v) for k, v in r2.items()})
+    assert reg.encode_json_walks.value() == w0  # no full walk
+    assert reg.encode_diff_segments.value() - s0 == 1  # only spec
+    assert reg.columnar_segments_reused.value() - u0 == 3
+
+
+# ---------------------------------------------------------------------------
+# vocab assembly from the store: gather path vs fresh encoder
+
+
+def densified(vb, cfg):
+    out = {name: arr[vb.row_idx] for name, arr in vb.lanes.items()}
+    strs = {i: s for i, s in enumerate(vb.strs)}
+    pools = [[strs[int(s)] for s in row] for row in vb.pool_sidx]
+    return out, pools
+
+
+def test_vocab_from_store_densifies_identically():
+    cfg = EncodeConfig()
+    res = [make_pod(i) for i in range(6)] + [{}]
+    res[2]["spec"]["volumes"] = [{"name": "v", "hostPath": {}}]
+    store = ColumnarStore()
+    vb_store = store.encode_vocab(res, cfg, BP, KBP)
+    vb_fresh = encode_resources_vocab(res, cfg, BP, KBP)
+    a, pa = densified(vb_store, cfg)
+    b, pb = densified(vb_fresh, cfg)
+    for name in b:
+        assert np.array_equal(a[name], b[name]), name
+    assert pa == pb
+    assert np.array_equal(vb_store.n_rows, vb_fresh.n_rows)
+    assert np.array_equal(vb_store.fallback, vb_fresh.fallback)
+
+
+def test_warm_rescan_zero_feed_work():
+    cfg = EncodeConfig()
+    res = [make_pod(i) for i in range(8)]
+    store = ColumnarStore()
+    store.encode_vocab(res, cfg, BP, KBP)
+    w0 = reg.encode_json_walks.value()
+    s0 = reg.encode_diff_segments.value()
+    vb = store.encode_vocab(res, cfg, BP, KBP)
+    assert reg.encode_json_walks.value() == w0
+    assert reg.encode_diff_segments.value() == s0
+    assert int(vb.n_rows.sum()) > 0
+
+
+def test_scan_verdicts_bit_identical_store_on_vs_off():
+    from kyverno_tpu.policies import load_pss_policies
+    from kyverno_tpu.policy.autogen import expand_policy
+    from kyverno_tpu.parallel.sharding import ShardedScanner
+
+    pols = [expand_policy(p) for p in load_pss_policies()][:3]
+    res = [make_pod(i) for i in range(12)]
+    res[1]["spec"]["hostNetwork"] = True
+    reset_store()
+    off = ShardedScanner(pols).scan(res)
+    configure_store(enabled=True)
+    on = ShardedScanner(pols).scan(res)
+    assert off.rules == on.rules
+    assert np.array_equal(off.verdicts, on.verdicts)
+    # and the warm repeat gathers without feed work
+    w0 = reg.encode_json_walks.value()
+    s0 = reg.encode_diff_segments.value()
+    on2 = ShardedScanner(pols).scan(res)
+    assert np.array_equal(on2.verdicts, off.verdicts)
+    assert reg.encode_json_walks.value() == w0
+    assert reg.encode_diff_segments.value() == s0
+
+
+# ---------------------------------------------------------------------------
+# vectorized multi-row batch fill (satellite 2)
+
+
+def test_apply_rows_multi_bit_identical_to_loop():
+    cfg = EncodeConfig()
+    res = [make_pod(i) for i in range(7)]
+    res[3] = {"weird": [1, "x", None, {"deep": {"er": True}}]}
+    src = encode_resources(res, cfg, BP, KBP)
+    entries = [extract_rows(src, i) for i in range(len(res))]
+    idxs = [5, 0, 3, 7, 2, 9, 6]  # scattered, out of order
+    loop = RowBatch(10, cfg)
+    for e, i in zip(entries, idxs):
+        apply_rows(e, loop, i)
+    multi = RowBatch(10, cfg)
+    apply_rows_multi(entries, multi, idxs)
+    la, ma = loop.arrays(), multi.arrays()
+    for name in la:
+        assert np.array_equal(la[name], ma[name]), name
+
+
+def test_apply_rows_multi_single_and_empty():
+    cfg = EncodeConfig()
+    e = extract_rows(encode_resources([make_pod(0)], cfg), 0)
+    b1, b2 = RowBatch(2, cfg), RowBatch(2, cfg)
+    apply_rows(e, b1, 1)
+    apply_rows_multi([e], b2, [1])
+    for name, arr in b1.arrays().items():
+        assert np.array_equal(arr, b2.arrays()[name]), name
+    apply_rows_multi([], RowBatch(1, cfg), [])  # no-op, no crash
+
+
+def test_engine_encode_rows_uses_store_tier():
+    from kyverno_tpu.policies import load_pss_policies
+    from kyverno_tpu.policy.autogen import expand_policy
+    from kyverno_tpu.tpu.cache import global_encode_cache
+    from kyverno_tpu.tpu.engine import TpuEngine
+
+    pols = [expand_policy(p) for p in load_pss_policies()][:2]
+    res = [make_pod(i) for i in range(4)]
+    configure_store(enabled=True)
+    eng = TpuEngine(pols)
+    rows1 = eng._encode_rows(res)
+    # the LRU now also holds the rows; drop it so the second encode can
+    # only be served by the columnar tier
+    global_encode_cache.clear()
+    h0 = reg.columnar_store.value({"outcome": "hit"})
+    w0 = reg.encode_json_walks.value()
+    rows2 = eng._encode_rows(res)
+    assert reg.columnar_store.value({"outcome": "hit"}) - h0 == len(res)
+    assert reg.encode_json_walks.value() == w0
+    a, b = rows1.arrays(), rows2.arrays()
+    for name in a:
+        assert np.array_equal(a[name], b[name]), name
+
+
+# ---------------------------------------------------------------------------
+# mmap persistence + robustness
+
+
+def test_mmap_store_roundtrip(tmp_path):
+    cfg = EncodeConfig()
+    res = [make_pod(i) for i in range(5)]
+    d = str(tmp_path / "col")
+    s1 = ColumnarStore(directory=d)
+    vb1 = s1.encode_vocab(res, cfg, BP, KBP)
+    s1.sync()
+    s2 = ColumnarStore(directory=d)
+    w0 = reg.encode_json_walks.value()
+    s0 = reg.encode_diff_segments.value()
+    vb2 = s2.encode_vocab(res, cfg, BP, KBP)
+    assert reg.encode_json_walks.value() == w0
+    assert reg.encode_diff_segments.value() == s0
+    a, pa = densified(vb1, cfg)
+    b, pb = densified(vb2, cfg)
+    for name in a:
+        assert np.array_equal(a[name], b[name]), name
+    assert pa == pb
+
+
+@pytest.mark.parametrize("corruption", ["truncate", "garbage_manifest",
+                                        "flip_bytes", "missing_lane",
+                                        "tamper_offsets"])
+def test_mmap_corruption_rebuilds_never_crashes(tmp_path, corruption):
+    cfg = EncodeConfig()
+    res = [make_pod(i) for i in range(4)]
+    d = str(tmp_path / "col")
+    s1 = ColumnarStore(directory=d)
+    s1.encode_vocab(res, cfg, BP, KBP)
+    s1.sync()
+    (tdir,) = [os.path.join(d, n) for n in os.listdir(d)
+               if os.path.isdir(os.path.join(d, n))]
+    if corruption == "truncate":
+        path = os.path.join(tdir, "lane_norm_hi.bin")
+        with open(path, "r+b") as f:
+            f.truncate(4)
+    elif corruption == "garbage_manifest":
+        with open(os.path.join(tdir, "manifest.json"), "w") as f:
+            f.write("{not json")
+    elif corruption == "flip_bytes":
+        path = os.path.join(tdir, "lane_repr_lo.bin")
+        with open(path, "r+b") as f:
+            f.seek(0)
+            f.write(b"\xff\xff\xff\xff")
+    elif corruption == "tamper_offsets":
+        # a parseable manifest with an edited offsets table must NOT
+        # serve another entry's rows (negative offsets wrap in Python)
+        mpath = os.path.join(tdir, "manifest.json")
+        with open(mpath) as f:
+            man = json.load(f)
+        man["entries"]["row_off"][0] = -4
+        with open(mpath, "w") as f:
+            json.dump(man, f)
+    else:
+        os.remove(os.path.join(tdir, "lane_valid.bin"))
+    r0 = reg.columnar_rebuilds.value()
+    s2 = ColumnarStore(directory=d)  # must not raise
+    assert reg.columnar_rebuilds.value() == r0 + 1
+    # rebuilt cold: encodes fresh and still produces correct rows
+    vb = s2.encode_vocab(res, cfg, BP, KBP)
+    ref = encode_resources_vocab(res, cfg, BP, KBP)
+    a, pa = densified(vb, cfg)
+    b, pb = densified(ref, cfg)
+    for name in b:
+        assert np.array_equal(a[name], b[name]), name
+    assert pa == pb
+
+
+def test_eviction_and_compaction_keep_live_rows_correct():
+    cfg = EncodeConfig()
+    store = ColumnarStore(capacity=8)
+    store.compact_min_rows = 1
+    all_res = [make_pod(i) for i in range(32)]
+    for r in all_res:
+        store.warm(cfg, (), (), r, resource_content_hash(r))
+    store.maybe_compact()
+    assert reg.columnar_compactions.value() >= 1
+    # the LRU tail survived compaction bit-identical
+    ekey = store.encode_key(cfg, (), ())
+    live = 0
+    for r in all_res:
+        e = store.get_entry(ekey, resource_content_hash(r))
+        if e is None:
+            continue
+        live += 1
+        entries_equal(e, fresh_entry(r, cfg))
+    assert live == 8
+
+
+# ---------------------------------------------------------------------------
+# snapshot: incremental namespace-labels index + subtree hashes
+
+
+def test_namespace_labels_index_matches_walk():
+    snap = ClusterSnapshot()
+
+    def oracle():
+        out = {}
+        for _, res, _ in snap.items():
+            if res.get("kind") == "Namespace":
+                meta = res.get("metadata") or {}
+                out[meta.get("name", "")] = dict(meta.get("labels") or {})
+        return out
+
+    snap.upsert({"kind": "Namespace",
+                 "metadata": {"name": "a", "uid": "ns-a",
+                              "labels": {"team": "x"}}})
+    snap.upsert({"kind": "Pod", "metadata": {"name": "p", "uid": "p1"}})
+    snap.upsert({"kind": "Namespace", "metadata": {"name": "b", "uid": "ns-b"}})
+    assert snap.namespace_labels() == oracle()
+    # label change
+    snap.upsert({"kind": "Namespace",
+                 "metadata": {"name": "a", "uid": "ns-a",
+                              "labels": {"team": "y", "env": "prod"}}})
+    assert snap.namespace_labels() == oracle()
+    # rename under the same uid drops the old index entry
+    snap.upsert({"kind": "Namespace",
+                 "metadata": {"name": "a2", "uid": "ns-a",
+                              "labels": {"team": "y"}}})
+    assert snap.namespace_labels() == oracle()
+    # delete by uid
+    snap.delete("ns-b")
+    assert snap.namespace_labels() == oracle()
+    # returned maps are copies — mutating them must not poison the index
+    snap.namespace_labels().get("a2", {})["evil"] = "1"
+    assert "evil" not in snap.namespace_labels().get("a2", {})
+
+
+def test_namespace_recreated_before_old_delete_arrives():
+    # watch relist ordering: the namespace is recreated under a new uid
+    # BEFORE the old uid's delete event lands — the late delete must
+    # not wipe the live namespace's labels
+    snap = ClusterSnapshot()
+    snap.upsert({"kind": "Namespace",
+                 "metadata": {"name": "prod", "uid": "ns-old",
+                              "labels": {"team": "x"}}})
+    snap.upsert({"kind": "Namespace",
+                 "metadata": {"name": "prod", "uid": "ns-new",
+                              "labels": {"team": "x", "env": "prod"}}})
+    snap.delete("ns-old")
+    assert snap.namespace_labels() == {
+        "prod": {"team": "x", "env": "prod"}}
+    snap.delete("ns-new")  # the real owner's delete still drops it
+    assert snap.namespace_labels() == {}
+
+
+def test_subhashes_track_content():
+    snap = ClusterSnapshot()
+    r = make_pod(0)
+    uid = snap.upsert(r)
+    subs = snap.subhashes_of(uid)
+    assert set(subs) == set(r)
+    assert subs == snap.subhashes_of(uid)  # cached
+    r2 = copy.deepcopy(r)
+    r2["spec"]["hostNetwork"] = True
+    snap.upsert(r2)
+    subs2 = snap.subhashes_of(uid)
+    assert subs2["spec"] != subs["spec"]
+    assert subs2["metadata"] == subs["metadata"]
+
+
+# ---------------------------------------------------------------------------
+# scan-service integration: zero feed work on the unchanged rescan
+
+
+def test_scan_once_warm_rescan_zero_walks():
+    from kyverno_tpu.cluster.policycache import PolicyCache
+    from kyverno_tpu.cluster.reports import ReportAggregator
+    from kyverno_tpu.cluster.scanner import BackgroundScanService
+    from kyverno_tpu.policies import load_pss_policies
+    from kyverno_tpu.policy.autogen import expand_policy
+
+    configure_store(enabled=True)
+    pols = [expand_policy(p) for p in load_pss_policies()][:2]
+    snap = ClusterSnapshot()
+    cache = PolicyCache()
+    for p in pols:
+        cache.set(p)
+    agg = ReportAggregator()
+    svc = BackgroundScanService(snap, cache, agg, batch_size=8)
+    for i in range(12):
+        snap.upsert(make_pod(i))
+    assert svc.scan_once() == 12
+    w0 = reg.encode_json_walks.value()
+    s0 = reg.encode_diff_segments.value()
+    assert svc.scan_once(full=True) == 12  # full rescan, warm store
+    assert reg.encode_json_walks.value() == w0
+    assert reg.encode_diff_segments.value() == s0
+    # one-subtree edit: exactly one segment re-encodes
+    r = copy.deepcopy(snap.get("uid-3"))
+    r["spec"]["hostNetwork"] = True
+    snap.upsert(r)
+    svc.scan_once()
+    assert reg.encode_json_walks.value() == w0
+    assert reg.encode_diff_segments.value() - s0 == 1
+    # deletes drop the uid's diff state
+    snap.delete("uid-3")
+    assert all("uid-3" not in t.uid_segs
+               for t in get_store()._tables.values())
+
+
+def test_store_state_and_debug_block():
+    configure_store(enabled=True)
+    cfg = EncodeConfig()
+    get_store().encode_vocab([make_pod(0)], cfg)
+    st = get_store().state()
+    assert st["enabled"] and st["tables"][0]["entries"] == 1
+    from kyverno_tpu.webhooks.server import _columnar_state
+
+    assert _columnar_state()["enabled"] is True
+    reset_store()
+    assert _columnar_state() == {"enabled": False}
