@@ -16,3 +16,12 @@ against.
 """
 
 __version__ = "0.1.0"
+
+# Dynamic lock-order sanitizer (devtools/sanitizer.py): must arm BEFORE
+# any engine module creates a lock, and the package __init__ is the one
+# import every entry point funnels through. No-op unless
+# KYVERNO_TPU_SANITIZE=1; the hook itself imports only stdlib.
+from .devtools.sanitizer import install_from_env as _sanitize_install
+
+_sanitize_install()
+del _sanitize_install

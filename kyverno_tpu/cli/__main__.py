@@ -14,6 +14,7 @@ from . import apply as apply_cmd
 from . import chainsaw as chainsaw_cmd
 from . import flight as flight_cmd
 from . import jp as jp_cmd
+from . import lint as lint_cmd
 from . import serve as serve_cmd
 from . import test as test_cmd
 from . import tools as tools_cmd
@@ -50,6 +51,7 @@ def build_parser() -> argparse.ArgumentParser:
     sub = parser.add_subparsers(dest="command", required=True)
     apply_cmd.add_parser(sub)
     analyze_cmd.add_parser(sub)
+    lint_cmd.add_parser(sub)
     jp_cmd.add_parser(sub)
     test_cmd.add_parser(sub)
     serve_cmd.add_parser(sub)

@@ -303,7 +303,7 @@ class ColumnarStore:
         self.uid_capacity = (uid_capacity if uid_capacity is not None
                              else _env_int("KYVERNO_TPU_COLUMNAR_UIDS",
                                            131072))
-        self._tables: Dict[str, _LaneTable] = {}
+        self._tables: Dict[str, _LaneTable] = {}  # guarded-by: _lock
         self._lock = threading.RLock()
         self._metrics = metrics
         self.enabled = True
@@ -312,7 +312,8 @@ class ColumnarStore:
         self.compact_min_rows = 1024
         if self.dir:
             os.makedirs(self.dir, exist_ok=True)
-            self._load_dir()
+            with self._lock:
+                self._load_dir_locked()
 
     def _registry(self):
         if self._metrics is None:
@@ -327,7 +328,7 @@ class ColumnarStore:
     def encode_key(cfg: EncodeConfig, byte_paths, key_byte_paths) -> str:
         return EncodeRowCache.encode_key(cfg, byte_paths, key_byte_paths)
 
-    def _table(self, cfg: EncodeConfig, byte_paths, key_byte_paths,
+    def _table_locked(self, cfg: EncodeConfig, byte_paths, key_byte_paths,
                ekey: Optional[str] = None) -> _LaneTable:
         ekey = ekey or self.encode_key(cfg, byte_paths, key_byte_paths)
         t = self._tables.get(ekey)
@@ -424,7 +425,7 @@ class ColumnarStore:
         if h is None:
             return
         with self._lock:
-            self._append(self._table(cfg, byte_paths, key_byte_paths),
+            self._append(self._table_locked(cfg, byte_paths, key_byte_paths),
                          h, entry)
         self._publish_gauges()
 
@@ -483,7 +484,7 @@ class ColumnarStore:
         if h is None:
             h = resource_content_hash(resource)
         with self._lock:
-            t = self._table(cfg, byte_paths, key_byte_paths)
+            t = self._table_locked(cfg, byte_paths, key_byte_paths)
             if h is not None and h in t.ids:
                 t.ids.move_to_end(h)
                 m.columnar_store.inc({"outcome": "hit"})
@@ -525,7 +526,7 @@ class ColumnarStore:
         for i in range(len(hs), len(resources)):
             hs.append(resource_content_hash(resources[i]))
         with self._lock:
-            t = self._table(cfg, byte_paths, key_byte_paths)
+            t = self._table_locked(cfg, byte_paths, key_byte_paths)
             missing = [i for i, h in enumerate(hs)
                        if h is None or h not in t.ids]
         hits = len(resources) - len(missing)
@@ -744,7 +745,7 @@ class ColumnarStore:
                 json.dump(man, f)
             os.replace(tmp, self._manifest_path(t))
 
-    def _load_dir(self) -> None:
+    def _load_dir_locked(self) -> None:
         """Reattach every valid table under ``self.dir``; anything
         truncated, corrupt, or mismatched is discarded and rebuilds
         cold (counted on kyverno_tpu_columnar_rebuilds_total) — a bad

@@ -32,7 +32,7 @@ class LeaseStore:
 
     def __init__(self, clock=time.monotonic):
         self._lock = threading.Lock()
-        self._leases: Dict[str, LeaseRecord] = {}
+        self._leases: Dict[str, LeaseRecord] = {}  # guarded-by: _lock
         self.clock = clock
 
     def try_acquire_or_renew(self, name: str, identity: str,

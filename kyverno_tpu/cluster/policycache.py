@@ -59,17 +59,17 @@ class PolicyCache:
 
     def __init__(self) -> None:
         self._lock = threading.RLock()
-        self._policies: Dict[str, ClusterPolicy] = {}
-        self._expanded: Dict[str, ClusterPolicy] = {}
-        self._types: Dict[str, PolicyType] = {}
-        self._kinds: Dict[str, Set[str]] = {}
-        self._hashes: Dict[str, str] = {}
-        self._revision = 0
+        self._policies: Dict[str, ClusterPolicy] = {}   # guarded-by: _lock
+        self._expanded: Dict[str, ClusterPolicy] = {}   # guarded-by: _lock
+        self._types: Dict[str, PolicyType] = {}         # guarded-by: _lock
+        self._kinds: Dict[str, Set[str]] = {}           # guarded-by: _lock
+        self._hashes: Dict[str, str] = {}               # guarded-by: _lock
+        self._revision = 0                              # guarded-by: _lock
         # lifecycle subscribers: called AFTER a mutation commits, with
         # (key, change, revision). Fired outside the lock — a listener
         # that re-reads the cache (compile-ahead worker) must not
         # deadlock or serialize mutators behind its work.
-        self._listeners: List[Callable[[str, str, int], None]] = []
+        self._listeners: List[Callable[[str, str, int], None]] = []  # guarded-by: _lock
 
     def subscribe(self, fn: Callable[[str, str, int], None]) -> None:
         with self._lock:

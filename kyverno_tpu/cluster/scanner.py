@@ -67,8 +67,8 @@ class BackgroundScanService:
         self.batch_size = batch_size
         self.metrics = global_registry
         # uid -> (resource hash, policy revision) at last scan
-        self._scanned: Dict[str, Tuple[str, int]] = {}
-        self._dirty: Set[str] = set()
+        self._scanned: Dict[str, Tuple[str, int]] = {}  # guarded-by: _lock
+        self._dirty: Set[str] = set()                   # guarded-by: _lock
         self._lock = threading.Lock()
         self._scanner = None
         self._scanner_rev = -1
@@ -115,10 +115,6 @@ class BackgroundScanService:
                    if (member.get("metadata") or {}).get("namespace", "") == ns_name]
         with self._lock:
             self._dirty.update(members)
-
-    def _needs_scan(self, uid: str, h: str, revision: int) -> bool:
-        last = self._scanned.get(uid)
-        return last is None or last != (h, revision)
 
     def _configmap_sources(self):
         from ..engine.contextloaders import DataSources
@@ -190,6 +186,10 @@ class BackgroundScanService:
         # invalidations between items() and processing)
         with self._lock:
             dirty, self._dirty = self._dirty, set()
+            # one locked snapshot of the scan ledger instead of a
+            # lock-free dict read per resource in the loop below (the
+            # watch thread mutates _scanned concurrently)
+            scanned = dict(self._scanned)
         items = self.snapshot.items()
         todo: List[Tuple[str, Dict[str, Any], str]] = []
         for uid, res, h in items:
@@ -198,7 +198,8 @@ class BackgroundScanService:
                 # generated VAPs) never background-scan — the reference
                 # excludes them via the default resourceFilters
                 continue
-            if full or uid in dirty or self._needs_scan(uid, h, revision):
+            if full or uid in dirty \
+                    or scanned.get(uid) != (h, revision):
                 todo.append((uid, res, h))
             else:
                 self.stats["skipped_clean"] += 1

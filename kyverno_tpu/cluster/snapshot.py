@@ -36,23 +36,24 @@ def resource_uid(resource: Dict[str, Any]) -> str:
 class ClusterSnapshot:
     def __init__(self) -> None:
         self._lock = threading.RLock()
-        self._resources: Dict[str, Dict[str, Any]] = {}
-        self._hashes: Dict[str, str] = {}
-        self._subscribers: List[Callable[[str, str], None]] = []
+        self._resources: Dict[str, Dict[str, Any]] = {}  # guarded-by: _lock
+        self._hashes: Dict[str, str] = {}                # guarded-by: _lock
+        self._subscribers: List[Callable[[str, str], None]] = []  # guarded-by: _lock
         # namespace -> labels index, maintained incrementally at
         # upsert/delete: namespace_labels() is called per scan tick AND
         # per admission flush, so it must not walk every resource
-        self._ns_labels: Dict[str, Dict[str, str]] = {}
-        self._ns_uids: Dict[str, str] = {}  # uid -> indexed ns name
-        self._ns_owner: Dict[str, str] = {}  # ns name -> owning uid
+        self._ns_labels: Dict[str, Dict[str, str]] = {}  # guarded-by: _lock
+        self._ns_uids: Dict[str, str] = {}   # guarded-by: _lock  (uid -> ns name)
+        self._ns_owner: Dict[str, str] = {}  # guarded-by: _lock  (ns -> owning uid)
         # per-resource top-level subtree hashes, computed lazily for
         # the columnar store's watch-diff encode (cluster/columnar.py)
         # and invalidated by content-hash movement
-        self._subhash_cache: Dict[str, Tuple[str, Dict[str, str]]] = {}
+        self._subhash_cache: Dict[str, Tuple[str, Dict[str, str]]] = {}  # guarded-by: _lock
 
     # -- mutation (watch events)
 
-    def _index_namespace(self, uid: str, resource: Dict[str, Any]) -> None:
+    def _index_namespace_locked(self, uid: str,
+                                resource: Dict[str, Any]) -> None:
         """Caller holds the lock. Ownership check: a namespace can be
         recreated under a new uid before the old uid's delete event
         arrives (watch relist) — only the CURRENT owner's removal may
@@ -76,7 +77,7 @@ class ClusterSnapshot:
             changed = self._hashes.get(uid) != h
             self._resources[uid] = resource
             self._hashes[uid] = h
-            self._index_namespace(uid, resource)
+            self._index_namespace_locked(uid, resource)
             if changed:
                 self._subhash_cache.pop(uid, None)
         if changed:
@@ -96,20 +97,26 @@ class ClusterSnapshot:
         self._notify(uid, "delete")
 
     def _notify(self, uid: str, change: str) -> None:
-        for fn in list(self._subscribers):
+        # snapshot the list under the lock, call subscribers outside it
+        # (a subscriber that re-reads the snapshot must not deadlock)
+        with self._lock:
+            subscribers = list(self._subscribers)
+        for fn in subscribers:
             fn(uid, change)
 
     def subscribe(self, fn: Callable[[str, str], None]) -> None:
-        self._subscribers.append(fn)
+        with self._lock:
+            self._subscribers.append(fn)
 
     def unsubscribe(self, fn: Callable[[str, str], None]) -> None:
         """Detach a watcher (informer handler removal); long-lived
         subscribers like GlobalContext entries must unsubscribe on
         stop or every reconcile leaks a callback."""
-        try:
-            self._subscribers.remove(fn)
-        except ValueError:
-            pass
+        with self._lock:
+            try:
+                self._subscribers.remove(fn)
+            except ValueError:
+                pass
 
     # -- reads
 

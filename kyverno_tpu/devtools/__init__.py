@@ -1,0 +1,24 @@
+"""Engine self-analysis — the correctness tooling the engine applies
+to ITSELF, mirroring what ``kyverno_tpu/analysis`` does for policies.
+
+Thirteen PRs of review hardening kept finding the same defect classes
+by hand: torn snapshots, stale thread-local stashes, locks held across
+device dispatch, fault-site typos, metric families invisible to the
+exposition validator. With 40+ locks across ~25 modules those classes
+are now mechanically enforced:
+
+- ``lint`` — a static pass over the package source (stdlib ``ast``,
+  zero dependencies) with five check classes; surfaced as
+  ``kyverno-tpu lint`` and run in tier-1 so every PR pays the
+  invariant tax automatically. See ``lintcore.CHECK_CLASSES``.
+- ``sanitizer`` — a dynamic lock-order sanitizer in the spirit of
+  ThreadSanitizer's deadlock detector: armed via
+  ``KYVERNO_TPU_SANITIZE=1``, it wraps every lock created afterwards,
+  builds the cross-thread lock-order graph, and reports order
+  inversions (potential deadlocks) and locks held across device
+  dispatch with both acquisition stacks.
+
+Everything here is import-light on purpose: the linter must run in a
+bare interpreter and the sanitizer must be installable before any
+engine module creates a lock.
+"""

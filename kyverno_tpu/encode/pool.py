@@ -181,16 +181,16 @@ class EncoderPool:
         self.worker_faults = (worker_faults if worker_faults is not None
                               else os.environ.get("KYVERNO_TPU_FAULTS", ""))
         self._lock = threading.RLock()
-        self._workers: List[_Worker] = [_Worker(i)
+        self._workers: List[_Worker] = [_Worker(i)  # guarded-by: _lock
                                         for i in range(self.n_workers)]
-        self._pending: "deque[_Chunk]" = deque()
-        self._chunks: Dict[int, _Chunk] = {}
-        self._profiles: Dict[int, Dict[str, Any]] = {}
-        self._task_seq = 0
-        self._profile_seq = 0
-        self._rng = random.Random(0xfeed)
-        self._started = False
-        self._stopping = False
+        self._pending: "deque[_Chunk]" = deque()    # guarded-by: _lock
+        self._chunks: Dict[int, _Chunk] = {}        # guarded-by: _lock
+        self._profiles: Dict[int, Dict[str, Any]] = {}  # guarded-by: _lock
+        self._task_seq = 0                          # guarded-by: _lock
+        self._profile_seq = 0                       # guarded-by: _lock
+        self._rng = random.Random(0xfeed)           # guarded-by: _lock
+        self._started = False                       # guarded-by: _lock
+        self._stopping = False                      # guarded-by: _lock
         self.restarts = 0
         self._monitor: Optional[threading.Thread] = None
 
@@ -570,7 +570,7 @@ class EncoderPool:
             # that caused them
             slot.consecutive_restarts += 1
             slot.restart_due = (time.monotonic()
-                                + self._restart_delay(slot))
+                                + self._restart_delay_locked(slot))
             return
         slot.proc = proc
         slot.pid = proc.pid
@@ -593,7 +593,7 @@ class EncoderPool:
                   ("init", {"faults": self.worker_faults,
                             "hb_interval": self.cfg.hb_interval_s}))).start()
 
-    def _restart_delay(self, slot: _Worker) -> float:
+    def _restart_delay_locked(self, slot: _Worker) -> float:
         return self.cfg.restart_backoff.delay(
             min(slot.consecutive_restarts, 8), self._rng)
 
@@ -665,7 +665,7 @@ class EncoderPool:
                 self.restarts += 1
                 slot.consecutive_restarts += 1
                 slot.restart_due = (time.monotonic()
-                                    + self._restart_delay(slot))
+                                    + self._restart_delay_locked(slot))
                 try:
                     self.metrics.encode_pool_restarts.inc()
                 except Exception:

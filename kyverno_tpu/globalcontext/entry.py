@@ -120,11 +120,12 @@ class ExternalApiEntry:
                             else self.STALE_TTL_FACTOR * spec.refresh_interval_s)
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
-        self._data: Any = None
-        self._err: Optional[str] = None
-        self._fetched_at: Optional[float] = None  # last attempt
-        self._ok_at: Optional[float] = None       # last success
-        self._refreshing = False  # single-flight: one lazy refresh at a time
+        self._data: Any = None                    # guarded-by: _lock
+        self._err: Optional[str] = None           # guarded-by: _lock
+        self._fetched_at: Optional[float] = None  # guarded-by: _lock  (last attempt)
+        self._ok_at: Optional[float] = None       # guarded-by: _lock  (last success)
+        # single-flight: one lazy refresh at a time
+        self._refreshing = False                  # guarded-by: _lock
         self._stopped = False
 
     def refresh(self) -> None:
@@ -154,7 +155,7 @@ class ExternalApiEntry:
                 # last-known-good data stays for the stale-serve window
                 self._fetched_at = self._clock()
 
-    def _stale(self) -> bool:
+    def _stale_locked(self) -> bool:
         return (self._fetched_at is None
                 or self._clock() - self._fetched_at >= self.spec.refresh_interval_s)
 
@@ -169,7 +170,7 @@ class ExternalApiEntry:
         # onto a backend that is already failing.
         do_refresh = False
         with self._cond:
-            if self._stale() and not self._refreshing:
+            if self._stale_locked() and not self._refreshing:
                 self._refreshing = True
                 do_refresh = True
         if do_refresh:

@@ -23,7 +23,7 @@ class GlobalContextStore:
         self.snapshot = snapshot
         self.api_executor = api_executor
         self._lock = threading.Lock()
-        self._entries: Dict[str, Any] = {}
+        self._entries: Dict[str, Any] = {}  # guarded-by: _lock
 
     # -- store protocol (store.go:24)
 

@@ -161,10 +161,10 @@ class PolicySetLifecycleManager:
         self._lock = threading.Lock()           # state (_active, quarantine)
         self._compile_lock = threading.Lock()   # one compile at a time
         self._active: Optional[PolicySetVersion] = None
-        self._quarantine: Dict[str, QuarantineEntry] = {}
-        self._synced_revision = -1          # cache revision last reconciled
-        self._set_attempts = 0              # consecutive set-level failures
-        self._set_next_retry_at = 0.0
+        self._quarantine: Dict[str, QuarantineEntry] = {}  # guarded-by: _lock
+        self._synced_revision = -1  # guarded-by: _compile_lock  (cache revision last reconciled)
+        self._set_attempts = 0      # guarded-by: _lock  (consecutive set-level failures)
+        self._set_next_retry_at = 0.0  # guarded-by: _lock
         self._failed_hash: Optional[str] = None
         self._last_error: Optional[str] = None
         self.stats: Dict[str, Any] = {
@@ -421,7 +421,8 @@ class PolicySetLifecycleManager:
                     engine = self._try_compile(policies, q_idx)
             except Exception as e2:
                 return self._set_failure(snap, e2, now)
-        return self._swap(snap, engine, now, compile_s=time.monotonic() - t0)
+        return self._swap_locked(snap, engine, now,
+                                 compile_s=time.monotonic() - t0)
 
     def _bisect(self, snap: PolicySetSnapshot, held: Dict[str, str],
                 err: Exception,
@@ -508,7 +509,8 @@ class PolicySetLifecycleManager:
                error=self._last_error[:200])
         return active
 
-    def _swap(self, snap: PolicySetSnapshot, engine, now: float,
+    # callers hold _compile_lock (the compile-ahead path)
+    def _swap_locked(self, snap: PolicySetSnapshot, engine, now: float,
               compile_s: float) -> PolicySetVersion:
         self.breaker.record_success()
         with self._lock:

@@ -34,11 +34,11 @@ class PolicyDirWatcher:
         self.path = path
         self.cache = cache
         self.interval_s = interval_s
-        self._sig: Dict[str, Tuple[float, int]] = {}     # file -> (mtime, size)
-        self._content: Dict[str, str] = {}               # file -> content hash
-        self._file_keys: Dict[str, Set[str]] = {}        # file -> policy keys
-        self._loaded_hash: Dict[str, str] = {}           # policy key -> hash
-        self._errors: Dict[str, str] = {}                # file -> parse error
+        self._sig: Dict[str, Tuple[float, int]] = {}     # guarded-by: _lock  (file -> (mtime, size))
+        self._content: Dict[str, str] = {}               # guarded-by: _lock  (file -> content hash)
+        self._file_keys: Dict[str, Set[str]] = {}        # guarded-by: _lock  (file -> policy keys)
+        self._loaded_hash: Dict[str, str] = {}           # guarded-by: _lock  (policy key -> hash)
+        self._errors: Dict[str, str] = {}                # guarded-by: _lock  (file -> parse error)
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -65,31 +65,63 @@ class PolicyDirWatcher:
         return policies
 
     def sync_once(self) -> bool:
-        """One poll pass; returns True when any cache mutation landed."""
-        self.stats["polls"] += 1
+        """One poll pass; returns True when any cache mutation landed.
+
+        The IO half (directory walk, stat/hash of every file, YAML
+        parse of changed ones) runs WITHOUT the lock against a locked
+        snapshot of the signature maps — state() is served on the HTTP
+        debug thread and must never stall behind a slow disk or a big
+        parse. Only the apply half (ownership/ledger mutations and the
+        cache set/unset calls) runs under _lock, so a scrape mid-pass
+        sees either the old maps or the new ones, never a resize in
+        flight. Poll passes themselves never run concurrently (one
+        watcher thread; manual sync_once callers are sequential), so
+        reading the snapshot and applying later cannot lose updates."""
+        with self._lock:
+            self.stats["polls"] += 1
+            sig_snap = dict(self._sig)
+            content_snap = dict(self._content)
+            known_files = list(self._file_keys)
         files = self._list_files()
         present = set(files)
-        changed_files: List[str] = []
-        # cheap signature pass first, content hash only on movement
+        # cheap signature pass first, content hash only on movement,
+        # parse only on content movement — all outside the lock
+        new_sigs: Dict[str, Tuple[float, int]] = {}
+        new_content: Dict[str, str] = {}
+        parsed: Dict[str, List[ClusterPolicy]] = {}
+        parse_errors: Dict[str, str] = {}
         for path in files:
             try:
                 st = os.stat(path)
                 sig = (st.st_mtime, st.st_size)
             except OSError:
                 continue  # raced a delete; next poll settles it
-            if self._sig.get(path) == sig:
+            if sig_snap.get(path) == sig:
                 continue
             try:
                 with open(path, "rb") as f:
                     h = hashlib.sha256(f.read()).hexdigest()
             except OSError:
                 continue
-            self._sig[path] = sig
-            if self._content.get(path) != h:
-                self._content[path] = h
-                changed_files.append(path)
-        removed_files = [p for p in list(self._file_keys) if p not in present]
-        if not changed_files and not removed_files:
+            new_sigs[path] = sig
+            if content_snap.get(path) != h:
+                new_content[path] = h
+                try:
+                    parsed[path] = self._parse_file(path)
+                except Exception as e:  # noqa: BLE001 — bad file, keep prior
+                    parse_errors[path] = f"{type(e).__name__}: {e}"
+        removed_files = [p for p in known_files if p not in present]
+        if not new_sigs and not removed_files:
+            return False
+        with self._lock:
+            return self._apply_locked(new_sigs, new_content, parsed,
+                                      parse_errors, removed_files)
+
+    def _apply_locked(self, new_sigs, new_content, parsed, parse_errors,
+                      removed_files) -> bool:
+        self._sig.update(new_sigs)
+        self._content.update(new_content)
+        if not new_content and not removed_files and not parse_errors:
             return False
         mutated = False
         # phase 1: apply every set and update EVERY file's ownership
@@ -97,14 +129,11 @@ class PolicyDirWatcher:
         # files in the same poll must never be transiently unloaded
         # (the stale ownership map would call it unowned mid-pass)
         gone: Set[str] = set()
-        for path in changed_files:
-            try:
-                policies = self._parse_file(path)
-                self._errors.pop(path, None)
-            except Exception as e:  # noqa: BLE001 — bad file, keep prior set
-                self._errors[path] = f"{type(e).__name__}: {e}"
-                self.stats["parse_errors"] += 1
-                continue
+        for path, err in parse_errors.items():
+            self._errors[path] = err
+            self.stats["parse_errors"] += 1
+        for path, policies in parsed.items():
+            self._errors.pop(path, None)
             new_keys = set()
             for p in policies:
                 key = policy_key(p)
@@ -123,12 +152,12 @@ class PolicyDirWatcher:
             self._content.pop(path, None)
             self._errors.pop(path, None)
         # phase 2: unload what no watched file declares anymore
-        mutated |= self._unset_unowned(gone)
+        mutated |= self._unset_unowned_locked(gone)
         if mutated:
             self.stats["syncs"] += 1
         return mutated
 
-    def _unset_unowned(self, keys: Set[str]) -> bool:
+    def _unset_unowned_locked(self, keys: Set[str]) -> bool:
         mutated = False
         for key in keys:
             if any(key in owned for owned in self._file_keys.values()):
@@ -166,11 +195,12 @@ class PolicyDirWatcher:
         self._thread = None
 
     def state(self) -> Dict[str, Any]:
-        return {
-            "path": self.path,
-            "interval_s": self.interval_s,
-            "files": len(self._sig),
-            "loaded_policies": len(self._loaded_hash),
-            "parse_errors": dict(self._errors),
-            "stats": dict(self.stats),
-        }
+        with self._lock:
+            return {
+                "path": self.path,
+                "interval_s": self.interval_s,
+                "files": len(self._sig),
+                "loaded_policies": len(self._loaded_hash),
+                "parse_errors": dict(self._errors),
+                "stats": dict(self.stats),
+            }

@@ -139,13 +139,13 @@ class RuleStatsAccumulator:
     def __init__(self, clock=time.time):
         self._clock = clock
         self._lock = threading.Lock()
-        self._records: Dict[Tuple[str, str], _RuleRecord] = {}
+        self._records: Dict[Tuple[str, str], _RuleRecord] = {}  # guarded-by: _lock
         self.enabled = os.environ.get(
             "KYVERNO_TPU_RULE_STATS", "1").lower() not in ("0", "false", "off")
 
     # -- write side
 
-    def _rec(self, ident: RuleIdent, now: float) -> _RuleRecord:
+    def _rec_locked(self, ident: RuleIdent, now: float) -> _RuleRecord:
         key = (ident.policy_hash, ident.rule_name)
         rec = self._records.get(key)
         if rec is None:
@@ -165,7 +165,7 @@ class RuleStatsAccumulator:
         now = self._clock()
         with self._lock:
             for ident in idents:
-                self._rec(ident, now)
+                self._rec_locked(ident, now)
 
     def ingest_counts(self, idents: Sequence[RuleIdent], counts: Any,
                       source: str = "device") -> None:
@@ -178,7 +178,7 @@ class RuleStatsAccumulator:
         with self._lock:
             for ri, ident in enumerate(idents):
                 row = counts[ri]
-                rec = self._rec(ident, now)
+                rec = self._rec_locked(ident, now)
                 rec.counts[: row.shape[0]] += row
                 evals = int(row.sum())
                 if evals:
@@ -336,7 +336,7 @@ class PatternCellTracker:
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
-        self._per_policy: Dict[str, Dict[str, int]] = {}
+        self._per_policy: Dict[str, Dict[str, int]] = {}  # guarded-by: _lock
 
     def record(self, policy: str, device: int = 0, confirm: int = 0,
                host: int = 0) -> None:
@@ -516,10 +516,10 @@ class StarvationTracker:
         # running window sums maintained incrementally — record() sits
         # on the per-flush/per-chunk hot path and must not re-walk the
         # whole window per call
-        self._events: deque = deque()
-        self._win_busy = 0.0
-        self._win_starved = 0.0
-        self._totals = {"device_busy": 0.0, "encode_wait": 0.0,
+        self._events: deque = deque()   # guarded-by: _lock
+        self._win_busy = 0.0            # guarded-by: _lock
+        self._win_starved = 0.0         # guarded-by: _lock
+        self._totals = {"device_busy": 0.0, "encode_wait": 0.0,  # guarded-by: _lock
                         "readback": 0.0, "host_assemble": 0.0}
         self._hooked = False
 
@@ -538,7 +538,7 @@ class StarvationTracker:
                 pass
         return self._metrics
 
-    def _evict(self, now: float) -> None:
+    def _evict_locked(self, now: float) -> None:
         while self._events and self._events[0][0] < now - self.window_s:
             _, busy, starved = self._events.popleft()
             self._win_busy -= busy
@@ -552,7 +552,7 @@ class StarvationTracker:
                 self._events.append((now, busy_s, starved_s))
                 self._win_busy += busy_s
                 self._win_starved += starved_s
-            self._evict(now)
+            self._evict_locked(now)
             self._totals["device_busy"] += busy_s
             self._totals["encode_wait"] += starved_s
             self._totals["readback"] += readback_s
@@ -564,7 +564,7 @@ class StarvationTracker:
         [0, 1]; 0.0 with no samples."""
         now = self._clock() if now is None else now
         with self._lock:
-            self._evict(now)
+            self._evict_locked(now)
             busy, starved = self._win_busy, self._win_starved
         denom = busy + starved
         return round(min(1.0, max(0.0, starved) / denom), 4) \
@@ -632,16 +632,16 @@ class SloTracker:
         # (t, latency_s, class) — class is the scheduling priority tier
         # (serving/scheduler.py), "default" for unclassified callers,
         # so the windows split per class without unbounded cardinality
-        self._adm: deque = deque(maxlen=max_samples)
+        self._adm: deque = deque(maxlen=max_samples)  # guarded-by: _lock
         # burn-rate cache for the serving shed ladder: submit() reads
         # the burn signal per request, so the read must not walk the
         # whole sample window each time
         self._burn_cache: Tuple[float, float] = (-1e9, 0.0)
-        self._last_scan: Optional[float] = None
-        self._coverage: Optional[float] = None
+        self._last_scan: Optional[float] = None  # guarded-by: _lock
+        self._coverage: Optional[float] = None   # guarded-by: _lock
         # verdict-integrity samples: (t, diverged 0/1) per shadow-
         # verification check (observability/verification.py)
-        self._verif: deque = deque(maxlen=max_samples)
+        self._verif: deque = deque(maxlen=max_samples)  # guarded-by: _lock
         self._hooked = False
 
     def _registry(self):

@@ -48,8 +48,8 @@ class EventGenerator:
         # every counter mutation holds _counter_lock — add() and the
         # worker threads race on these, and a lost drop increment hides
         # an overload signal
-        self.dropped = 0
-        self.emitted = 0
+        self.dropped = 0   # guarded-by: _counter_lock
+        self.emitted = 0   # guarded-by: _counter_lock
         self._counter_lock = threading.Lock()
         if metrics is None:
             from .metrics import global_registry

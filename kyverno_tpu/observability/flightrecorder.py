@@ -203,17 +203,19 @@ class FlightRecorder:
         self._lock = threading.Lock()
         self._rng = random.Random()
         self._sinks: List[Callable[[FlightRecord], None]] = []
-        self._reset_state()
+        with self._lock:
+            self._reset_state_locked()
 
-    def _reset_state(self) -> None:
+    def _reset_state_locked(self) -> None:
         self.capacity = self._default_capacity
         self.sample_rate = self._default_sample
         self.body_cap = self._default_body_cap
         self.spool_dir: Optional[str] = None
-        self._ring: deque = deque(maxlen=max(1, self.capacity))
-        self._seq = 0
-        self._last_spool_at = -1e9
-        self._spool_seq = 0
+        self._ring: deque = deque(maxlen=max(1, self.capacity))  # guarded-by: _lock
+        self._seq = 0            # guarded-by: _lock
+        self._last_spool_at = -1e9   # guarded-by: _lock
+        self._spool_seq = 0          # guarded-by: _lock
+        # guarded-by: _lock
         self.stats: Dict[str, Any] = {
             "captured": 0, "sampled_out": 0, "spools": 0,
             "by_outcome": {}, "divergences_spooled": 0}
@@ -238,7 +240,7 @@ class FlightRecorder:
     def reset(self) -> None:
         """Back to construction defaults (per-test isolation)."""
         with self._lock:
-            self._reset_state()
+            self._reset_state_locked()
         self._sinks = []
 
     def add_sink(self, fn: Callable[[FlightRecord], None]) -> None:
@@ -317,10 +319,11 @@ class FlightRecorder:
             self.stats["captured"] += 1
             by = self.stats["by_outcome"]
             by[rec.outcome] = by.get(rec.outcome, 0) + 1
+            ring_n = len(self._ring)
         try:
             reg = self._registry()
             reg.flight_records.inc({"outcome": rec.outcome})
-            reg.flight_ring_size.set(len(self._ring))
+            reg.flight_ring_size.set(ring_n)
         except Exception:
             pass
         for sink in self._sinks:
@@ -453,15 +456,17 @@ class FlightRecorder:
         return [r.to_dict(self.body_cap) for r in records]
 
     def __len__(self) -> int:
-        return len(self._ring)
+        with self._lock:
+            return len(self._ring)
 
     def state(self) -> Dict[str, Any]:
         with self._lock:
             stats = {k: (dict(v) if isinstance(v, dict) else v)
                      for k, v in self.stats.items()}
+            ring_n = len(self._ring)
         return {"capacity": self.capacity,
                 "sample_rate": self.sample_rate,
-                "records": len(self._ring),
+                "records": ring_n,
                 "spool_dir": self.spool_dir,
                 "body_cap": self.body_cap,
                 "stats": stats}

@@ -46,10 +46,10 @@ class OpLog:
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
-        self._path: Optional[str] = None
-        self._fh = None
-        self._stderr = False
-        self.events_emitted = 0
+        self._path: Optional[str] = None   # guarded-by: _lock
+        self._fh = None                    # guarded-by: _lock
+        self._stderr = False               # guarded-by: _lock
+        self.events_emitted = 0            # guarded-by: _lock
 
     # -- configuration
 
@@ -79,21 +79,24 @@ class OpLog:
 
     def reset(self) -> None:
         self.configure(path="off", stderr=False)
-        self.events_emitted = 0
+        with self._lock:
+            self.events_emitted = 0
 
     @property
     def enabled(self) -> bool:
         return self._stderr or self._fh is not None
 
     def state(self) -> Dict[str, Any]:
-        return {"stderr": self._stderr, "file": self._path,
-                "events_emitted": self.events_emitted}
+        with self._lock:
+            return {"stderr": self._stderr, "file": self._path,
+                    "events_emitted": self.events_emitted}
 
     # -- emission
 
     def emit(self, event: str, level: str = "info", **fields: Any) -> None:
         if not (self._stderr or self._fh is not None):
-            self.events_emitted += 1  # counted even when unsunk (tests)
+            with self._lock:
+                self.events_emitted += 1  # counted even when unsunk (tests)
             return
         try:
             self._emit(event, level if level in _LEVELS else "info", fields)
@@ -117,8 +120,8 @@ class OpLog:
         for k, v in fields.items():
             if v is not None:
                 rec[k] = v
-        self.events_emitted += 1
         with self._lock:
+            self.events_emitted += 1
             if self._fh is not None:
                 json.dump(rec, self._fh, default=str)
                 self._fh.write("\n")

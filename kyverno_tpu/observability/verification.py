@@ -103,17 +103,20 @@ class ShadowVerifier:
         self._clock = clock
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
-        self._queue: deque = deque()
-        self._inflight = 0  # popped but not yet verified (drain waits)
+        self._queue: deque = deque()  # guarded-by: _lock
+        # popped but not yet verified (drain waits)
+        self._inflight = 0  # guarded-by: _lock
         self._thread: Optional[threading.Thread] = None
-        self._stopping = False
+        self._stopping = False  # guarded-by: _lock
         self._rng = random.Random()
         self._registered = False
-        self._reset_state()
+        with self._lock:
+            self._reset_state_locked()
 
-    def _reset_state(self) -> None:
+    def _reset_state_locked(self) -> None:
         self.rate = 0.0
         self.synchronous = False
+        # guarded-by: _lock
         self.stats: Dict[str, int] = {
             "offered": 0, "sampled_out": 0, "checked": 0, "matched": 0,
             "divergences": 0, "skipped_no_engine": 0,
@@ -144,7 +147,7 @@ class ShadowVerifier:
         with self._lock:
             if self._thread is not None and self._thread.is_alive():
                 return
-            self._stopping = False
+            self._stopping = False  # guarded-by: _lock
             self._thread = threading.Thread(target=self._run, daemon=True,
                                             name="shadow-verifier")
             self._thread.start()
@@ -167,7 +170,7 @@ class ShadowVerifier:
         with self._lock:
             self._queue.clear()
             self._inflight = 0
-            self._reset_state()
+            self._reset_state_locked()
         self._registered = False
 
     # -- write side (flight recorder sink)
@@ -195,10 +198,11 @@ class ShadowVerifier:
             # recorder drops rec.engine right after the sinks run so
             # the ring cannot pin superseded compiled versions
             self._queue.append((rec, rec.engine))
+            depth = len(self._queue)
             self._cv.notify()
         self._ensure_thread()
         try:
-            self._registry().verification_queue_depth.set(len(self._queue))
+            self._registry().verification_queue_depth.set(depth)
         except Exception:
             pass
 
@@ -230,9 +234,9 @@ class ShadowVerifier:
                     return
                 rec, engine = self._queue.popleft()
                 self._inflight += 1
+                depth = len(self._queue)
             try:
-                self._registry().verification_queue_depth.set(
-                    len(self._queue))
+                self._registry().verification_queue_depth.set(depth)
             except Exception:
                 pass
             try:

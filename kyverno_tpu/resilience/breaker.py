@@ -61,11 +61,11 @@ class CircuitBreaker:
             metrics = global_registry
         self.metrics = metrics
         self._lock = threading.Lock()
-        self._state = CLOSED
-        self._consecutive_failures = 0
-        self._opened_at: Optional[float] = None
-        self._probes_in_flight = 0
-        self._publish_state()
+        self._state = CLOSED                 # guarded-by: _lock
+        self._consecutive_failures = 0       # guarded-by: _lock
+        self._opened_at: Optional[float] = None  # guarded-by: _lock
+        self._probes_in_flight = 0           # guarded-by: _lock
+        self._publish_state_locked()
 
     # -- introspection
 
@@ -91,9 +91,9 @@ class CircuitBreaker:
             self._probes_in_flight = 0
             self._opened_at = None
             if self._state != CLOSED:
-                self._transition(CLOSED)
+                self._transition_locked(CLOSED)
             else:
-                self._publish_state()
+                self._publish_state_locked()
 
     # -- protocol
 
@@ -102,7 +102,7 @@ class CircuitBreaker:
             if self._state == OPEN:
                 if (self._opened_at is not None
                         and self._clock() - self._opened_at >= self.reset_timeout_s):
-                    self._transition(HALF_OPEN)
+                    self._transition_locked(HALF_OPEN)
                     self._probes_in_flight = 0
                 else:
                     return False
@@ -118,24 +118,24 @@ class CircuitBreaker:
             if self._state in (HALF_OPEN, OPEN):
                 # OPEN can see a success when a probe raced the trip;
                 # either way the device path just worked end to end
-                self._transition(CLOSED)
+                self._transition_locked(CLOSED)
 
     def record_failure(self) -> None:
         with self._lock:
             self._consecutive_failures += 1
             if self._state == HALF_OPEN:
-                self._open()
+                self._open_locked()
             elif (self._state == CLOSED
                     and self._consecutive_failures >= self.failure_threshold):
-                self._open()
+                self._open_locked()
 
     # -- internals (lock held)
 
-    def _open(self) -> None:
+    def _open_locked(self) -> None:
         self._opened_at = self._clock()
-        self._transition(OPEN)
+        self._transition_locked(OPEN)
 
-    def _transition(self, to: str) -> None:
+    def _transition_locked(self, to: str) -> None:
         frm, self._state = self._state, to
         if frm != to:
             self.metrics.breaker_transitions.inc(
@@ -170,9 +170,9 @@ class CircuitBreaker:
                     consecutive_failures=self._consecutive_failures)
             except Exception:
                 pass
-        self._publish_state()
+        self._publish_state_locked()
 
-    def _publish_state(self) -> None:
+    def _publish_state_locked(self) -> None:
         self.metrics.breaker_state.set(
             _STATE_GAUGE[self._state], {"breaker": self.name})
 

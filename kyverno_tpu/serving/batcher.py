@@ -175,6 +175,7 @@ class AdmissionPipeline:
         self.queue = AdmissionQueue(self.config.high_water,
                                     config=self.config)
         self._stopped = False
+        # guarded-by: _stats_lock
         self.stats: Dict[str, Any] = {
             "requests": 0, "flushes": 0, "evaluated": 0, "shed": 0,
             "expired": 0, "cache_hits": 0, "flush_reasons": {},
@@ -215,7 +216,7 @@ class AdmissionPipeline:
                 with self._stats_lock:
                     self.stats["cache_hits"] = \
                         self.stats.get("cache_hits", 0) + 1
-                    self._cstat(pri)["cache_hits"] += 1
+                    self._cstat_locked(pri)["cache_hits"] += 1
                 dt = time.monotonic() - t0
                 self.metrics.serving_request_latency.observe(
                     dt, {"path": "cached", "class": pri})
@@ -289,7 +290,7 @@ class AdmissionPipeline:
 
     # -- overload ladder (shed) and hedged dispatch
 
-    def _cstat(self, pri: str) -> Dict[str, int]:
+    def _cstat_locked(self, pri: str) -> Dict[str, int]:
         """Per-class stats bucket; callers hold _stats_lock."""
         c = self.stats["by_class"].get(pri)
         if c is None:
@@ -329,7 +330,7 @@ class AdmissionPipeline:
         pri = priority_of(cls)
         with self._stats_lock:
             self.stats["shed"] += 1
-            self._cstat(pri)["shed"] += 1
+            self._cstat_locked(pri)["shed"] += 1
         root.add_event("shed", reason=reason, cls=pri,
                        depth=self.queue.depth())
         self.metrics.serving_class_requests.inc(
@@ -405,7 +406,7 @@ class AdmissionPipeline:
         pri = priority_of(req.cls)
         with self._stats_lock:
             self.stats["hedges"] += 1
-            self._cstat(pri)["hedges"] += 1
+            self._cstat_locked(pri)["hedges"] += 1
         req.hedged = True
         # claim the flight record UP FRONT: a race that runs to
         # completion must be the one to record (labeled with its
@@ -658,7 +659,7 @@ class AdmissionPipeline:
             if drain_info:
                 self.stats["bulk_topups"] += drain_info.get("bulk_topup", 0)
             for req in batch:
-                c = self._cstat(priority_of(req.cls))
+                c = self._cstat_locked(priority_of(req.cls))
                 c["requests"] += 1
                 if id(req) in expired_ids:
                     c["expired"] += 1

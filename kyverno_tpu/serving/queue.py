@@ -99,7 +99,7 @@ class QueuedRequest:
         # and a racing hedge both want to write THE record for this
         # request — claim_flight() arbitrates so exactly one side does,
         # whatever order they finish in
-        self.flight_claimed = False
+        self.flight_claimed = False  # guarded-by: _rlock
         self._rlock = threading.Lock()
 
     def claim_flight(self) -> bool:
@@ -142,13 +142,13 @@ class AdmissionQueue:
         # set under cv together with the pipeline's stop flag: a put
         # racing shutdown either fails fast here or lands before the
         # final drain — never stranded until the wait timeout
-        self.closed = False
-        self._items: List[QueuedRequest] = []
+        self.closed = False          # guarded-by: cv
+        self._items: List[QueuedRequest] = []  # guarded-by: cv
         self._config = config
         # WFQ state: global virtual time + per-class last finish tag
-        self._vt = 0.0
-        self._finish: Dict[Any, float] = {}
-        self._class_depth: Dict[str, int] = {}
+        self._vt = 0.0               # guarded-by: cv
+        self._finish: Dict[Any, float] = {}    # guarded-by: cv
+        self._class_depth: Dict[str, int] = {}  # guarded-by: cv
         # wake_times() aggregates (oldest non-bulk arrival, oldest bulk
         # arrival, tightest deadline), maintained incrementally: put()
         # updates them in O(1) — an append at the tail can only SET an
@@ -156,7 +156,7 @@ class AdmissionQueue:
         # them dirty for one O(n) recompute at the next read. Without
         # this, every put's notify_all would send the flusher on an
         # O(depth) walk under the cv submitters contend on.
-        self._agg: Optional[tuple] = (None, None, None)
+        self._agg: Optional[tuple] = (None, None, None)  # guarded-by: cv
         # drain() telemetry for the pipeline (single flusher reader)
         self.last_drain_info: Dict[str, Any] = {}
 
@@ -261,7 +261,7 @@ class AdmissionQueue:
             batch, self._items = self._items[:max_n], self._items[max_n:]
             self.last_drain_info = {}
         else:
-            batch = self._select(max_n, now, config, stopping)
+            batch = self._select_locked(max_n, now, config, stopping)
         t = time.monotonic()
         for req in batch:
             req.dispatched = True
@@ -287,7 +287,7 @@ class AdmissionQueue:
             self._finish.clear()
         return batch
 
-    def _select(self, max_n: int, now: float, cfg: Any,
+    def _select_locked(self, max_n: int, now: float, cfg: Any,
                 stopping: bool) -> List[QueuedRequest]:
         items = self._items
         if stopping:

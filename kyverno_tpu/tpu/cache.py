@@ -96,7 +96,7 @@ class LruCache:
     def __init__(self, capacity: int, name: str = "lru"):
         self.name = name
         self._capacity = int(capacity)
-        self._data: "OrderedDict[Any, Any]" = OrderedDict()
+        self._data: "OrderedDict[Any, Any]" = OrderedDict()  # guarded-by: _lock
         self._lock = threading.Lock()
         self.evictions = 0
 

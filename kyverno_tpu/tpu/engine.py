@@ -39,6 +39,7 @@ from ..observability.profiling import (PATH_DEVICE, PATH_SCALAR_FALLBACK,
                                        global_profiler, maybe_xla_trace,
                                        set_dispatch_path)
 from ..observability.tracing import global_tracer
+from ..devtools import sanitizer as _sanitizer
 from ..resilience.faults import SITE_TPU_DISPATCH, global_faults
 from .compiler import CompiledPolicySet, compile_policy_set
 from .evaluator import (CONFIRM, ERROR, FAIL, HOST, NOT_MATCHED, PASS, SKIP,
@@ -859,6 +860,10 @@ class TpuEngine:
             with global_tracer.span("tpu.dispatch",
                                     breaker=self.breaker.state) as span:
                 global_faults.fire(SITE_TPU_DISPATCH)
+                if _sanitizer.ENABLED:
+                    # lock-order sanitizer: any lock held across the
+                    # device call serializes its waiters behind XLA
+                    _sanitizer.note_device_dispatch()
                 table = dispatch_fn()
                 table = self._validate_device_table(table, want_shape)
                 span.attributes["engine"] = PATH_DEVICE
@@ -892,6 +897,8 @@ class TpuEngine:
             return None
         try:
             global_faults.fire(SITE_TPU_DISPATCH)
+            if _sanitizer.ENABLED:
+                _sanitizer.note_device_dispatch()
             return (launch_fn(),)
         except Exception as e:
             self._record_dispatch_failure(e)

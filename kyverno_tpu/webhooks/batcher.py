@@ -38,9 +38,9 @@ class MicroBatcher:
         self.max_batch = max_batch
         self.max_wait = max_wait_ms / 1000.0
         self._lock = threading.Lock()
-        self._queue: List[_Pending] = []
-        self._flusher: Optional[threading.Timer] = None
-        self._stopped = False
+        self._queue: List[_Pending] = []               # guarded-by: _lock
+        self._flusher: Optional[threading.Timer] = None  # guarded-by: _lock
+        self._stopped = False                          # guarded-by: _lock
 
     def submit(self, payload: Any, timeout: float = 30.0) -> Any:
         p = _Pending(payload)

@@ -1,0 +1,67 @@
+#!/bin/bash
+# Engine self-analysis gate: the static lint must be clean on the real
+# package (modulo the justified baseline) and every check class must
+# stay LIVE against the seeded-violation fixtures; then the chaos
+# suites (64-thread dispatch faults, bulk-flood scheduling, a policy
+# churn slice) run under the dynamic lock-order sanitizer
+# (KYVERNO_TPU_SANITIZE=1) and must come back with ZERO lock-order
+# cycles and zero non-allowlisted locks held across device dispatch —
+# while a seeded AB/BA inversion proves the detector itself fires.
+#
+# Usage: ./scripts_lint_gate.sh
+set -o pipefail
+cd "$(dirname "$0")"
+rc=0
+
+echo "=== leg 1/4: static lint (package clean, fixtures caught) ==="
+KYVERNO_TPU_FAULTS= JAX_PLATFORMS=cpu timeout -k 10 180 \
+  python -m kyverno_tpu.cli lint --json > /tmp/_lint_pkg.json || rc=1
+python - <<'EOF' || rc=1
+import json
+doc = json.load(open("/tmp/_lint_pkg.json"))
+assert doc["exit"] == 0 and doc["findings"] == [], doc["findings"]
+print(f"package clean ({len(doc['baselined'])} baselined)")
+EOF
+KYVERNO_TPU_FAULTS= JAX_PLATFORMS=cpu timeout -k 10 180 \
+  python -m kyverno_tpu.cli lint --json --no-baseline \
+  tests/lint_fixtures/badpkg > /tmp/_lint_fix.json
+if [ $? -ne 1 ]; then echo "FIXTURE TREE DID NOT FAIL"; rc=1; fi
+python - <<'EOF' || rc=1
+import json
+doc = json.load(open("/tmp/_lint_fix.json"))
+got = {f["check"] for f in doc["findings"]}
+want = {"jax-import", "guarded-by", "fault-site", "metric-family",
+        "blocking-under-lock"}
+assert got == want, f"check classes live: {got} != {want}"
+print(f"all {len(want)} check classes live on fixtures")
+EOF
+
+echo "=== leg 2/4: sanitizer detects the seeded AB/BA inversion ==="
+KYVERNO_TPU_FAULTS= JAX_PLATFORMS=cpu timeout -k 10 300 \
+  python -m pytest tests/test_sanitizer.py -q -p no:cacheprovider || rc=1
+
+echo "=== leg 3/4: chaos suites under the sanitizer ==="
+rm -f /tmp/_san_chaos.json
+KYVERNO_TPU_SANITIZE=1 KYVERNO_TPU_SANITIZE_REPORT=/tmp/_san_chaos.json \
+  KYVERNO_TPU_FAULTS= JAX_PLATFORMS=cpu timeout -k 10 1800 \
+  python -m pytest tests/test_chaos_load.py tests/test_sched_load.py \
+  tests/test_policy_churn.py -q -p no:cacheprovider || rc=1
+python - <<'EOF' || rc=1
+import json
+doc = json.load(open("/tmp/_san_chaos.json"))
+assert doc["locks_tracked"] > 100, "sanitizer saw too few locks to mean anything"
+assert doc["cycles"] == [], f"LOCK-ORDER CYCLES: {doc['cycles']}"
+assert doc["dispatch_violations"] == [], \
+    f"locks held across dispatch: {doc['dispatch_violations']}"
+print(f"chaos clean under sanitizer: {doc['locks_tracked']} locks, "
+      f"{doc['edges']} edges, 0 cycles, "
+      f"{len(doc['dispatch_allowed'])} allowlisted dispatch holds")
+EOF
+
+echo "=== leg 4/4: tier-1 (includes the lint-as-test wiring) ==="
+KYVERNO_TPU_FAULTS= JAX_PLATFORMS=cpu timeout -k 10 870 \
+  python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors \
+  -p no:cacheprovider -p no:xdist -p no:randomly || rc=1
+
+if [ $rc -eq 0 ]; then echo "LINT GATE: PASS"; else echo "LINT GATE: FAIL"; fi
+exit $rc

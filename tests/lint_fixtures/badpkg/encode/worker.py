@@ -1,0 +1,11 @@
+"""Seeded jax-import violation root: this fixture worker reaches jax
+through a helper module, exactly the leak the runtime handshake would
+only catch after the damage."""
+
+
+def main() -> None:
+    from ..util import helper  # noqa: F401  (pulls jax transitively)
+
+
+if __name__ == "__main__":
+    main()

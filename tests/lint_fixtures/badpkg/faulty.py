@@ -1,0 +1,14 @@
+"""Seeded fault-site violation: a typo'd site string — the chaos hook
+that silently never fires."""
+
+
+class _Faults:
+    def fire(self, site: str) -> None:  # stand-in registry shape
+        pass
+
+
+faults = _Faults()
+
+
+def dispatch() -> None:
+    faults.fire("tpu.dispach")  # VIOLATION: typo'd site literal

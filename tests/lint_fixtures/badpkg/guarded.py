@@ -1,0 +1,26 @@
+"""Seeded guarded-by violations: a stats counter annotated as guarded
+that two methods touch lock-free — the torn-counter shape review keeps
+catching by hand."""
+
+import threading
+
+
+class Accumulator:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._total = 0          # guarded-by: _lock
+        self._last = None        # guarded-by: _lock
+        self._phantom = 0        # guarded-by: _mutex  (stale: no _mutex)
+
+    def add(self, n: int) -> None:
+        with self._lock:
+            self._total += n     # correct: under the lock
+        self._last = n           # VIOLATION: store outside the lock
+
+    def peek(self) -> int:
+        return self._total       # VIOLATION: lock-free read
+
+    def drain_locked(self) -> int:
+        # exempt by convention: callers hold the lock
+        t, self._total = self._total, 0
+        return t

@@ -1,0 +1,24 @@
+"""Seeded blocking-under-lock violations: a sleep and a file write
+while holding the lock every submitter contends on."""
+
+import threading
+import time
+
+
+class Flusher:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._spool = "/tmp/spool.json"
+
+    def flush(self) -> None:
+        with self._lock:
+            time.sleep(0.1)              # VIOLATION: sleep under lock
+            with open(self._spool, "w") as f:  # VIOLATION: IO under lock
+                f.write("{}")
+
+    def flush_outside(self) -> None:
+        with self._lock:
+            payload = "{}"
+        time.sleep(0.01)  # fine: lock released
+        with open(self._spool, "w") as f:
+            f.write(payload)

@@ -1,0 +1,10 @@
+"""Seeded metric-family violations: an ad-hoc family the registry (and
+therefore the exposition validator) never sees, plus a computed label
+key — unbounded key cardinality."""
+
+
+def publish(registry, name: str) -> None:
+    c = registry.counter("kyverno_rogue_total",  # VIOLATION: unregistered
+                         "a family the validator never sees")
+    c.inc({"outcome": "ok"})
+    c.inc({name: "1"})  # VIOLATION: computed label key

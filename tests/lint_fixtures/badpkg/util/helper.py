@@ -1,0 +1,8 @@
+"""Innocent-looking helper that drags the device runtime in at module
+level — reachable from the fixture worker."""
+
+import jax  # seeded violation: module-level jax in the worker closure
+
+
+def shape(x):
+    return jax.numpy.asarray(x).shape

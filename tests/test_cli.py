@@ -217,6 +217,40 @@ def test_top_help(capsys):
         assert flag in out
 
 
+def test_lint_help(capsys):
+    with pytest.raises(SystemExit) as exc:
+        main(["lint", "--help"])
+    assert exc.value.code == 0
+    out = capsys.readouterr().out
+    for flag in ("--json", "--fail-on", "--checks", "--baseline",
+                 "--no-baseline"):
+        assert flag in out
+    # every check class is documented in the help text
+    from kyverno_tpu.devtools.lintcore import CHECK_CLASSES
+
+    for cls in CHECK_CLASSES:
+        assert cls in out
+
+
+def test_lint_exit_codes(capsys):
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    fixtures = os.path.join(repo, "tests", "lint_fixtures", "badpkg")
+    # 0: the real package is clean modulo the checked-in baseline
+    assert main(["lint", "--json",
+                 "--baseline", os.path.join(repo, "lint_baseline.json")]) == 0
+    capsys.readouterr()
+    # 1: seeded-violation fixture tree fails
+    assert main(["lint", "--json", "--no-baseline", fixtures]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["exit"] == 1 and doc["findings"]
+    # 2: usage errors — unknown --fail-on class, bad path
+    assert main(["lint", "--fail-on", "bogus-class"]) == 2
+    assert main(["lint", os.path.join(repo, "does-not-exist")]) == 2
+    capsys.readouterr()
+
+
 def test_serve_batching_help_module_entry():
     """The literal `python -m kyverno_tpu serve --batching --help`
     invocation (package-level __main__) exits 0 and shows the flags."""

@@ -1,0 +1,388 @@
+"""Engine self-analysis: the devtools static lint pass.
+
+Three layers:
+
+- seeded-violation fixtures (tests/lint_fixtures/badpkg): every check
+  class proven LIVE — each seeded defect caught at its exact file:line;
+- the real package: clean modulo the checked-in lint_baseline.json
+  (this is the tier-1 invariant tax — an unguarded annotated attr, a
+  fault-site typo, or a rogue metric family fails CI here);
+- regression tests for the real violations this subsystem surfaced and
+  fixed (lock-free watcher maps, lost oplog counter increments).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from kyverno_tpu.devtools import lintcore
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "lint_fixtures", "badpkg")
+
+
+def _run(root=None, checks=None, baseline=None):
+    return lintcore.run_lint(root=root, checks=checks, baseline=baseline)
+
+
+def _by_check(findings):
+    out = {}
+    for f in findings:
+        out.setdefault(f.check, []).append(f)
+    return out
+
+
+# ---------------------------------------------------------- fixtures
+
+
+def test_fixture_catches_every_check_class():
+    by = _by_check(_run(root=FIXTURES))
+    assert set(by) == set(lintcore.CHECK_CLASSES)
+
+
+def test_fixture_jax_import_chain_and_line():
+    (f,) = _by_check(_run(root=FIXTURES))["jax-import"]
+    assert f.file == "util/helper.py" and f.line == 4
+    assert "encode/worker.py" in f.message  # the chain names the root
+
+
+def test_fixture_guarded_by_violations():
+    fs = _by_check(_run(root=FIXTURES))["guarded-by"]
+    msgs = {(f.file, f.line): f.message for f in fs}
+    assert ("guarded.py", 18) in msgs   # store outside the lock
+    assert ("guarded.py", 21) in msgs   # lock-free read
+    assert any("stale annotation" in m for m in msgs.values())
+    # the _locked-suffix helper and the locked store are NOT flagged
+    assert not any("drain_locked" in m for m in msgs.values())
+    assert all(line != 17 for (_, line) in msgs)
+
+
+def test_fixture_fault_site_typo():
+    (f,) = _by_check(_run(root=FIXTURES))["fault-site"]
+    assert f.file == "faulty.py" and f.line == 14
+    assert "tpu.dispach" in f.message
+
+
+def test_fixture_metric_family_and_label_key():
+    fs = _by_check(_run(root=FIXTURES))["metric-family"]
+    assert {(f.file, f.line) for f in fs} == {("metricky.py", 7),
+                                              ("metricky.py", 10)}
+    assert any("kyverno_rogue_total" in f.message for f in fs)
+    assert any("computed label key" in f.message for f in fs)
+
+
+def test_fixture_blocking_under_lock():
+    fs = _by_check(_run(root=FIXTURES))["blocking-under-lock"]
+    assert {(f.file, f.line) for f in fs} == {("hotpath.py", 15),
+                                              ("hotpath.py", 16)}
+    # the same calls with the lock released are fine
+    assert all(f.line < 19 for f in fs)
+
+
+def test_deferred_callback_under_lock_is_flagged(tmp_path):
+    """A nested def's body runs when CALLED, not where defined: a
+    callback built under the lock but invoked later lock-free must be
+    flagged (regression: the walker used to let nested defs inherit
+    the enclosing held set)."""
+    (tmp_path / "m.py").write_text(
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._total = 0  # guarded-by: _lock\n"
+        "    def go(self):\n"
+        "        with self._lock:\n"
+        "            def cb():\n"
+        "                self._total += 1\n"
+        "            return cb\n")
+    fs = _run(root=str(tmp_path), checks=["guarded-by"])
+    assert len(fs) == 1 and "_total" in fs[0].message
+
+
+def test_nested_class_annotations_do_not_leak(tmp_path):
+    """Regression: a nested class annotating `self._x # guarded-by:`
+    used to poison the OUTER class's guarded map, flagging the outer
+    class's unrelated `self._x` — a false CI failure on correct code."""
+    (tmp_path / "m.py").write_text(
+        "import threading\n"
+        "class Outer:\n"
+        "    def __init__(self):\n"
+        "        self._x = 1\n"
+        "    def read(self):\n"
+        "        return self._x\n"
+        "    class Inner:\n"
+        "        def __init__(self):\n"
+        "            self._lock = threading.Lock()\n"
+        "            self._x = 0  # guarded-by: _lock\n"
+        "        def bump(self):\n"
+        "            with self._lock:\n"
+        "                self._x += 1\n"
+        "        def leak(self):\n"
+        "            return self._x\n")
+    fs = _run(root=str(tmp_path), checks=["guarded-by"])
+    # exactly ONE finding: Inner.leak's lock-free read; Outer is clean
+    assert len(fs) == 1 and "Inner._x" in fs[0].message, \
+        [f.render() for f in fs]
+
+
+def test_class_body_import_reaches_worker(tmp_path):
+    """Class bodies execute at import time: `class L: import jax` in
+    the worker closure must be flagged (regression: only function
+    bodies are deferred execution)."""
+    (tmp_path / "encode").mkdir()
+    (tmp_path / "encode" / "__init__.py").write_text("")
+    (tmp_path / "encode" / "worker.py").write_text("from .. import helper\n")
+    (tmp_path / "__init__.py").write_text("")
+    (tmp_path / "helper.py").write_text("class L:\n    import jax\n")
+    fs = _run(root=str(tmp_path), checks=["jax-import"])
+    assert len(fs) == 1 and "'jax'" in fs[0].message
+
+
+# ------------------------------------------------------- real package
+
+
+def test_package_clean_modulo_baseline():
+    baseline = lintcore.load_baseline(
+        os.path.join(REPO, "lint_baseline.json"))
+    findings = _run(baseline=baseline)
+    live = [f for f in findings if not f.baselined]
+    assert live == [], "\n".join(f.render() for f in live)
+    # the baseline is justified, not a dumping ground: every entry has
+    # a reason and every entry actually suppresses something
+    used = {f.baseline_reason for f in findings if f.baselined}
+    for entry in baseline:
+        assert entry["reason"].strip()
+        assert entry["reason"] in used, f"dead baseline entry: {entry}"
+
+
+def test_package_worker_closure_is_nontrivial():
+    """The jax-import check must actually traverse the worker closure —
+    a vacuous pass (root not found, resolver broken) would silently
+    disable the check."""
+    from kyverno_tpu.devtools import check_imports
+
+    ctx = lintcore.build_context()
+    by_name = {check_imports._module_name(f.rel): f for f in ctx.files}
+    assert check_imports._module_name(check_imports.ROOT_MODULE) in by_name
+    # tpu.flatten (the encode body) must be reachable, tpu.engine not
+    seen = set()
+    queue = [(check_imports._module_name(check_imports.ROOT_MODULE), ())]
+    while queue:
+        name, chain = queue.pop()
+        if name in seen:
+            continue
+        seen.add(name)
+        sf = by_name.get(name)
+        if sf is None:
+            continue
+        for node in check_imports._iter_imports(
+                sf.tree, sf.rel == check_imports.ROOT_MODULE):
+            for target, _ in check_imports._resolve(
+                    name, sf.rel, node, by_name):
+                if target in by_name and target not in seen:
+                    queue.append((target, ()))
+    assert "tpu.flatten" in seen
+    assert "tpu.engine" not in seen
+    assert len(seen) > 10
+
+
+def test_known_sites_extraction_matches_runtime():
+    """The linter reads KNOWN_SITES statically; it must agree with the
+    imported truth or the fault-site check drifts."""
+    from kyverno_tpu.resilience.faults import KNOWN_SITES
+
+    _, known, _ = lintcore.load_engine_invariants()
+    assert known == KNOWN_SITES
+
+
+def test_metric_family_extraction_covers_registry():
+    from kyverno_tpu.observability.metrics import global_registry
+
+    _, _, families = lintcore.load_engine_invariants()
+    for name in global_registry._instruments:
+        if name.startswith("kyverno"):
+            assert name in families, name
+
+
+# ----------------------------------------------------------- baseline
+
+
+def test_baseline_matching_is_by_content_not_line():
+    f = lintcore.Finding(check="guarded-by", file="serving/queue.py",
+                         line=9999, message="X drain() touches Y")
+    lintcore.apply_baseline(
+        [f], [{"check": "guarded-by", "file": "serving/queue.py",
+               "match": "drain() touches", "reason": "held by caller"}])
+    assert f.baselined and f.baseline_reason == "held by caller"
+
+
+def test_baseline_rejects_malformed(tmp_path):
+    p = tmp_path / "bad.json"
+    p.write_text(json.dumps([{"check": "guarded-by"}]))
+    with pytest.raises(lintcore.LintUsageError):
+        lintcore.load_baseline(str(p))
+    with pytest.raises(lintcore.LintUsageError):
+        lintcore.load_baseline(str(tmp_path / "missing.json"))
+
+
+def test_unknown_check_class_is_usage_error():
+    with pytest.raises(lintcore.LintUsageError):
+        _run(checks=["bogus-class"])
+
+
+# --------------------------------------------------- tier-1 CLI wiring
+
+
+def test_cli_lint_json_clean_on_package():
+    """THE invariant-tax test: `kyverno-tpu lint --json` must exit 0 on
+    the real package with the checked-in baseline, from the repo root
+    like CI runs it."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "kyverno_tpu.cli", "lint", "--json"],
+        capture_output=True, text=True, cwd=REPO, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["findings"] == []
+    assert doc["exit"] == 0
+    assert set(doc["checks_run"]) == set(lintcore.CHECK_CLASSES)
+
+
+def test_cli_lint_fails_on_fixture_tree():
+    proc = subprocess.run(
+        [sys.executable, "-m", "kyverno_tpu.cli", "lint", "--json",
+         "--no-baseline", FIXTURES],
+        capture_output=True, text=True, cwd=REPO, timeout=120)
+    assert proc.returncode == 1
+    doc = json.loads(proc.stdout)
+    assert {f["check"] for f in doc["findings"]} \
+        == set(lintcore.CHECK_CLASSES)
+
+
+def test_cli_lint_fail_on_scopes_exit():
+    # fixture tree has guarded-by violations, but we only fail on
+    # fault-site typos elsewhere? -> still 1 because fixture has one;
+    # scope to a class the fixture does NOT violate by pointing at a
+    # clean subtree
+    clean = os.path.join(FIXTURES, "util")
+    proc = subprocess.run(
+        [sys.executable, "-m", "kyverno_tpu.cli", "lint", "--json",
+         "--no-baseline", "--fail-on", "guarded-by", clean],
+        capture_output=True, text=True, cwd=REPO, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# ------------------------------------- regressions for fixed violations
+
+
+def test_watcher_state_safe_during_sync(tmp_path):
+    """Regression: PolicyDirWatcher._lock existed but guarded nothing —
+    state() on the debug/HTTP thread iterated maps sync_once() was
+    mutating. Now both hold the lock; hammering them concurrently must
+    never raise."""
+    from kyverno_tpu.cluster.policycache import PolicyCache
+    from kyverno_tpu.lifecycle.watch import PolicyDirWatcher
+
+    pol = """
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: pol-%d
+spec:
+  rules:
+  - name: r
+    match:
+      any:
+      - resources:
+          kinds: [Pod]
+    validate:
+      message: x
+      pattern:
+        metadata:
+          name: "?*"
+"""
+    watcher = PolicyDirWatcher(str(tmp_path), PolicyCache(),
+                               interval_s=0.01)
+    stop = threading.Event()
+    errors = []
+
+    def reader():
+        while not stop.is_set():
+            try:
+                watcher.state()
+            except Exception as e:  # pragma: no cover - the regression
+                errors.append(e)
+                return
+
+    t = threading.Thread(target=reader)
+    t.start()
+    try:
+        for i in range(40):
+            (tmp_path / f"p{i % 7}.yaml").write_text(pol % i)
+            watcher.sync_once()
+    finally:
+        stop.set()
+        t.join()
+    assert errors == []
+    assert watcher.state()["loaded_policies"] > 0
+
+
+def test_oplog_counter_not_lost_under_contention(tmp_path):
+    """Regression: OpLog.events_emitted was incremented outside _lock
+    on the sink path — concurrent emitters lost updates. 8 threads x
+    200 events must count exactly 1600."""
+    from kyverno_tpu.observability.log import OpLog
+
+    log = OpLog()
+    log.configure(path=str(tmp_path / "op.jsonl"))
+    try:
+        n_threads, per = 8, 200
+
+        def emitter():
+            for i in range(per):
+                log.emit("lint_regression", seq=i)
+
+        threads = [threading.Thread(target=emitter)
+                   for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert log.state()["events_emitted"] == n_threads * per
+    finally:
+        log.reset()
+
+
+def test_snapshot_subscribe_during_notify():
+    """Regression: ClusterSnapshot.subscribe/unsubscribe mutated the
+    subscriber list lock-free while _notify iterated it."""
+    from kyverno_tpu.cluster.snapshot import ClusterSnapshot
+
+    snap = ClusterSnapshot()
+    stop = threading.Event()
+    errors = []
+
+    def churn():
+        def cb(uid, change):
+            pass
+        while not stop.is_set():
+            try:
+                snap.subscribe(cb)
+                snap.unsubscribe(cb)
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+                return
+
+    t = threading.Thread(target=churn)
+    t.start()
+    try:
+        for i in range(300):
+            snap.upsert({"apiVersion": "v1", "kind": "ConfigMap",
+                         "metadata": {"name": f"c{i}", "uid": f"u{i % 13}"}})
+    finally:
+        stop.set()
+        t.join()
+    assert errors == []

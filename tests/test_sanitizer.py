@@ -1,0 +1,255 @@
+"""Dynamic lock-order sanitizer (devtools/sanitizer.py).
+
+The AB/BA fixture is the canonical seeded deadlock: two threads take
+two locks in opposite orders, SEQUENCED so the test never actually
+deadlocks — the sanitizer must still report the cycle, because the
+order inversion is the bug and the hang is just the unlucky schedule.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from kyverno_tpu.devtools import sanitizer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def sanitized():
+    """Install for the test, restore the real factories after. Locks
+    created while installed stay wrapped but harmless."""
+    sanitizer.install()
+    sanitizer.reset()
+    yield sanitizer
+    sanitizer.reset()
+    sanitizer.uninstall()
+
+
+def _run_thread(fn):
+    t = threading.Thread(target=fn)
+    t.start()
+    t.join(timeout=10)
+    assert not t.is_alive()
+
+
+def test_seeded_ab_ba_inversion_reports_cycle(sanitized):
+    a, b = threading.Lock(), threading.Lock()
+
+    def t1():
+        with a:
+            with b:
+                pass
+
+    def t2():
+        with b:
+            with a:
+                pass
+
+    _run_thread(t1)
+    _run_thread(t2)
+    rep = sanitizer.report()
+    assert len(rep["cycles"]) == 1
+    cycle = rep["cycles"][0]
+    assert len(cycle) == 2  # both directions of the inversion
+    # each edge carries BOTH acquisition stacks for the report
+    for edge in cycle:
+        assert edge["from_stack"] and edge["to_stack"]
+        assert any("test_sanitizer" in fr for fr in edge["to_stack"])
+
+
+def test_consistent_order_is_clean(sanitized):
+    a, b, c = threading.Lock(), threading.Lock(), threading.Lock()
+
+    def t():
+        with a:
+            with b:
+                with c:
+                    pass
+
+    for _ in range(3):
+        _run_thread(t)
+    rep = sanitizer.report()
+    assert rep["cycles"] == []
+    assert rep["edges"] >= 3  # a->b, a->c, b->c
+
+
+def test_three_lock_rotation_cycle(sanitized):
+    a, b, c = threading.Lock(), threading.Lock(), threading.Lock()
+    for first, second in ((a, b), (b, c), (c, a)):
+        def t(x=first, y=second):
+            with x:
+                with y:
+                    pass
+        _run_thread(t)
+    rep = sanitizer.report()
+    assert len(rep["cycles"]) == 1
+    assert len(rep["cycles"][0]) == 3
+
+
+def test_rlock_reentrancy_no_self_edge(sanitized):
+    r = threading.RLock()
+
+    def t():
+        with r:
+            with r:  # re-entrant: must not create an edge or a cycle
+                pass
+
+    _run_thread(t)
+    rep = sanitizer.report()
+    assert rep["edges"] == 0 and rep["cycles"] == []
+
+
+def test_condition_wait_releases_tracking(sanitized):
+    """cv.wait() releases the lock while sleeping; the held-set must
+    reflect that or every lock taken inside a waiter body would edge
+    against the cv's lock."""
+    cv = threading.Condition()
+    other = threading.Lock()
+    woke = []
+
+    def waiter():
+        with cv:
+            cv.wait(timeout=5)
+            woke.append(1)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    # while the waiter sleeps, its thread must NOT be considered
+    # holding the cv lock; this main-thread pairing stays edge-free
+    import time
+
+    time.sleep(0.1)
+    with other:
+        pass
+    with cv:
+        cv.notify_all()
+    t.join(timeout=10)
+    assert woke
+    rep = sanitizer.report()
+    assert rep["cycles"] == []
+
+
+def test_cv_wait_at_depth_keeps_lock_tracked(sanitized):
+    """Regression: cv.wait() at RLock recursion depth 2 restored the
+    lock with tracking count 1, so the first post-wait release dropped
+    it from the held set while still held — hiding every order edge
+    (and dispatch hold) in that window."""
+    cv = threading.Condition()
+    other = threading.Lock()
+
+    def t():
+        with cv:
+            with cv:
+                cv.wait(timeout=0.05)
+            # depth back to 1: cv's lock is STILL held here
+            with other:
+                pass
+
+    _run_thread(t)
+    rep = sanitizer.report()
+    assert rep["edges"] >= 1  # the cvlock->other edge must exist
+
+
+def test_dispatch_under_lock_reported_with_stacks(sanitized):
+    lk = threading.Lock()
+    with lk:
+        sanitizer.note_device_dispatch()
+    rep = sanitizer.report()
+    assert len(rep["dispatch_violations"]) == 1
+    v = rep["dispatch_violations"][0]
+    assert v["locks"][0]["acquire_stack"]
+    assert v["dispatch_stack"]
+
+
+def test_dispatch_without_lock_clean(sanitized):
+    sanitizer.note_device_dispatch()
+    assert sanitizer.report()["dispatch_violations"] == []
+
+
+def test_allowlisted_lock_site_reports_separately(sanitized):
+    """The lifecycle compile lock intentionally spans the XLA warm
+    dispatch; it lands under dispatch_allowed, never as a violation."""
+    lk = threading.Lock()
+    # fake the creation site to the allowlisted module
+    sanitizer._LOCK_SITES[lk._san_id] = \
+        "/x/kyverno_tpu/lifecycle/manager.py:162 in __init__"
+    with lk:
+        sanitizer.note_device_dispatch()
+    rep = sanitizer.report()
+    assert rep["dispatch_violations"] == []
+    assert len(rep["dispatch_allowed"]) == 1
+
+
+def test_env_knob_end_to_end(tmp_path):
+    """KYVERNO_TPU_SANITIZE=1 in a fresh process: package import arms
+    the wrappers, the atexit hook writes the JSON report, and a seeded
+    inversion inside engine-shaped code shows up in it."""
+    report = tmp_path / "san.json"
+    code = """
+import threading
+import kyverno_tpu  # arms the sanitizer via the env knob
+
+from kyverno_tpu.devtools import sanitizer
+assert sanitizer.ENABLED
+a, b = threading.Lock(), threading.Lock()
+
+def t1():
+    with a:
+        with b:
+            pass
+
+def t2():
+    with b:
+        with a:
+            pass
+
+for fn in (t1, t2):
+    t = threading.Thread(target=fn)
+    t.start()
+    t.join()
+"""
+    env = dict(os.environ, KYVERNO_TPU_SANITIZE="1",
+               KYVERNO_TPU_SANITIZE_REPORT=str(report),
+               JAX_PLATFORMS="cpu")
+    proc = subprocess.run([sys.executable, "-c", code], env=env,
+                          capture_output=True, text=True, timeout=120,
+                          cwd=REPO)
+    assert proc.returncode == 0, proc.stderr
+    assert "LOCK-ORDER VIOLATIONS" in proc.stderr
+    doc = json.loads(report.read_text())
+    assert len(doc["cycles"]) == 1
+    assert doc["locks_tracked"] >= 2
+
+
+def test_sanitized_smoke_admission_pipeline(sanitized):
+    """Tier-1-speed smoke: a real AdmissionPipeline (queue cv, stats
+    lock, resolver events) under the sanitizer — no crashes, no
+    cycles. The full chaos suites run under scripts_lint_gate.sh."""
+    from kyverno_tpu.serving.batcher import AdmissionPipeline, BatchConfig
+
+    calls = []
+
+    def evaluate(payloads, version=None):
+        calls.append(len(payloads))
+        return [{"n": p} for p in payloads]
+
+    p = AdmissionPipeline(evaluate, config=BatchConfig(
+        max_batch_size=8, max_wait_ms=2.0, deadline_ms=2000.0))
+    try:
+        threads = [threading.Thread(
+            target=lambda i=i: [p.submit(i) for _ in range(5)])
+            for i in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+    finally:
+        p.stop()
+    rep = sanitizer.report()
+    assert rep["cycles"] == [], rep["cycles"]
+    assert rep["locks_tracked"] > 0
