@@ -1626,6 +1626,292 @@ def bench_columnar(n_resources=None, tile=1024):
     return out
 
 
+# ---------------------------------------------------------------------------
+# fleet (kyverno_tpu/fleet/): scan scaling across process-level
+# replicas, peer cache effectiveness, and failover recovery time.
+# Every replica is a REAL serve subprocess sharing one persistent XLA
+# cache dir, so only the first boot pays the build.
+
+
+def bench_fleet():
+    import http.client
+    import signal
+    import socket
+    import subprocess
+    import tempfile
+    import threading
+
+    import yaml
+
+    n_resources = int(os.environ.get("BENCH_FLEET_RESOURCES", "1200"))
+    lease_s = float(os.environ.get("BENCH_FLEET_LEASE_S", "2.0"))
+
+    policy = {
+        "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+        "metadata": {"name": "fleet-bench"},
+        "spec": {"validationFailureAction": "Enforce", "rules": [{
+            "name": "no-privileged",
+            "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+            "validate": {"message": "no privileged",
+                         "pattern": {"spec": {"containers": [
+                             {"=(securityContext)":
+                              {"=(privileged)": "false"}}]}}},
+        }]}}
+    pods = [{
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": f"fp-{i}", "namespace": f"ns{i % 8}",
+                     "uid": f"fu-{i}"},
+        "spec": {"containers": [{
+            "name": "c", "image": "nginx",
+            **({"securityContext": {"privileged": True}}
+               if i % 3 == 0 else {})}]},
+    } for i in range(n_resources)]
+
+    def free_port():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    def get(port, path, timeout=60):
+        conn = http.client.HTTPConnection("127.0.0.1", port,
+                                          timeout=timeout)
+        try:
+            conn.request("GET", path)
+            resp = conn.getresponse()
+            return resp.status, resp.read()
+        finally:
+            conn.close()
+
+    def post(port, path, doc, timeout=600):
+        conn = http.client.HTTPConnection("127.0.0.1", port,
+                                          timeout=timeout)
+        try:
+            conn.request("POST", path, json.dumps(doc),
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            return resp.status, resp.read()
+        finally:
+            conn.close()
+
+    def metric(text, name, **labels):
+        total = 0.0
+        for line in text.splitlines():
+            if not line.startswith(name):
+                continue
+            rest = line[len(name):]
+            if rest and rest[0] not in ("{", " "):
+                continue
+            if all(f'{k}="{v}"' in rest for k, v in labels.items()):
+                try:
+                    total += float(
+                        line.split(" # ")[0].rsplit(" ", 1)[-1])
+                except ValueError:
+                    pass
+        return total
+
+    tmp = tempfile.mkdtemp(prefix="fleet-bench-")
+    pol_file = os.path.join(tmp, "policy.yaml")
+    with open(pol_file, "w") as f:
+        yaml.safe_dump(policy, f)
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["KYVERNO_TPU_XLA_CACHE_DIR"] = os.path.join(tmp, "xla")
+
+    # every spawned replica lands here the moment it exists, so the
+    # outer finally can reap them even when a boot or measurement
+    # step raises mid-way (no leaked serve processes, ever)
+    live_procs = []
+
+    def boot_fleet(k):
+        """k replicas, serialized boots (warm XLA), converged."""
+        fleet_ports = [free_port() for _ in range(k)]
+        met_ports = [free_port() for _ in range(k)]
+        procs = []
+        for i in range(k):
+            peers = ",".join(f"http://127.0.0.1:{fleet_ports[j]}"
+                             for j in range(k) if j != i)
+            argv = [sys.executable, "-m", "kyverno_tpu", "serve",
+                    pol_file, "--port", "0",
+                    "--metrics-port", str(met_ports[i]),
+                    "--scan-interval", "9999", "--batching",
+                    "--fleet-listen", str(fleet_ports[i]),
+                    "--replica-id", f"bench{i}",
+                    "--fleet-lease-s", str(lease_s)]
+            if peers:
+                argv += ["--fleet-peers", peers]
+            procs.append(subprocess.Popen(
+                argv, env=env, stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL))
+            live_procs.append(procs[-1])
+            deadline = time.monotonic() + 300
+            while time.monotonic() < deadline:
+                try:
+                    if get(met_ports[i], "/healthz", timeout=2)[0] == 200:
+                        break
+                except OSError:
+                    time.sleep(0.3)
+            else:
+                raise RuntimeError(f"replica {i} never became healthy")
+        deadline = time.monotonic() + 30
+        while k > 1 and time.monotonic() < deadline:
+            try:
+                views = [json.loads(get(p, "/fleet/state", 2)[1])
+                         for p in fleet_ports]
+                if all(len(v["membership"]["live"]) == k for v in views):
+                    break
+            except OSError:
+                pass
+            time.sleep(0.2)
+        return procs, fleet_ports, met_ports
+
+    def scan_wave(met_ports, full=True):
+        """Concurrent /scan on every replica; returns (wall_s, total)."""
+        results = [None] * len(met_ports)
+
+        def one(i):
+            status, body = post(met_ports[i], "/scan", {"full": full})
+            results[i] = json.loads(body)["scanned"] if status == 200 \
+                else None
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=one, args=(i,))
+                   for i in range(len(met_ports))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        return wall, sum(r or 0 for r in results)
+
+    out = {"metric": "fleet_scan_res_per_s", "unit": "res/s",
+           "resources": n_resources, "lease_s": lease_s,
+           "host_cpus": os.cpu_count(), "replicas": {}}
+    try:
+        for k in (1, 2, 3):
+            procs, fleet_ports, met_ports = boot_fleet(k)
+            try:
+                for pod in pods:
+                    for p in met_ports:
+                        post(p, "/snapshot/upsert", pod)
+                # untimed warm wave (XLA build at the scan shape);
+                # then MUTATE every resource so the measured wave pays
+                # real encode + device work instead of replaying the
+                # verdict cache (which would only measure HTTP)
+                scan_wave(met_ports)
+                for pod in pods:
+                    bumped = dict(pod)
+                    meta = dict(bumped["metadata"])
+                    meta["labels"] = {"gen": f"g{k}"}
+                    bumped["metadata"] = meta
+                    for p in met_ports:
+                        post(p, "/snapshot/upsert", bumped)
+                wall, total = scan_wave(met_ports, full=False)
+                out["replicas"][str(k)] = {
+                    "scan_wall_s": round(wall, 3),
+                    "scanned_total": total,
+                    "res_per_s": round(total / max(wall, 1e-9), 1),
+                }
+            finally:
+                if k < 3:
+                    for p in procs:
+                        p.terminate()
+                    for p in procs:
+                        try:
+                            p.wait(timeout=15)
+                        except subprocess.TimeoutExpired:
+                            p.kill()
+        r1 = out["replicas"]["1"]["res_per_s"]
+        r3 = out["replicas"]["3"]["res_per_s"]
+        out["scaling_3v1"] = round(r3 / max(r1, 1e-9), 2)
+        out["value"] = r3
+
+        # failover on the live 3-replica fleet: SIGKILL replica 1
+        # mid-scan, time detection + takeover rescan, and report how
+        # much of the takeover was served from the (gossip-warmed)
+        # fleet cache instead of recomputed
+        def hits(port):
+            _, body = get(port, "/metrics")
+            return metric(body.decode(), "kyverno_tpu_verdict_cache_total",
+                          outcome="hit")
+
+        survivors = [0, 2]
+        before_hits = sum(hits(met_ports[i]) for i in survivors)
+        threading.Thread(
+            target=lambda: post(met_ports[1], "/scan", {"full": True},
+                                timeout=10),
+            daemon=True).start()
+        time.sleep(0.05)
+        os.kill(procs[1].pid, signal.SIGKILL)
+        t_kill = time.monotonic()
+        deadline = time.monotonic() + lease_s + 20
+        detect_s = None
+        while time.monotonic() < deadline:
+            try:
+                states = [json.loads(get(fleet_ports[i],
+                                         "/fleet/state", 2)[1])
+                          for i in survivors]
+                covered = set()
+                for s in states:
+                    covered.update(s["shards"]["owned"])
+                if (all(len(s["membership"]["live"]) == 2 for s in states)
+                        and covered == set(range(64))):
+                    detect_s = time.monotonic() - t_kill
+                    break
+            except OSError:
+                pass
+            time.sleep(0.1)
+        t0 = time.perf_counter()
+        takeover_total = 0
+        for i in survivors:
+            status, body = post(met_ports[i], "/scan", {})
+            if status == 200:
+                takeover_total += json.loads(body)["scanned"]
+        takeover_wall = time.perf_counter() - t0
+        after_hits = sum(hits(met_ports[i]) for i in survivors)
+        cache_served = min(after_hits - before_hits, takeover_total)
+        # honest budget: the TTL itself plus two heartbeat intervals
+        # (lease_s/4 each — the detector only looks when it ticks)
+        # plus 1s of poll/scheduling slack; the field name says what
+        # was actually tested
+        detect_budget_s = lease_s + 2 * (lease_s / 4.0) + 1.0
+        out["failover"] = {
+            "detect_s": round(detect_s, 3) if detect_s else None,
+            "detect_budget_s": round(detect_budget_s, 3),
+            "recovered_within_budget": bool(
+                detect_s is not None and detect_s < detect_budget_s),
+            "takeover_scanned": takeover_total,
+            "takeover_wall_s": round(takeover_wall, 3),
+            "peer_warmed_ratio": round(
+                cache_served / max(takeover_total, 1), 3),
+        }
+        # fleet counters + divergence from the survivors' exposition
+        _, body = get(met_ports[0], "/metrics")
+        text = body.decode()
+        out["peering"] = {
+            "fetch_hits": metric(text, "kyverno_fleet_peer_fetch_total",
+                                 outcome="hit"),
+            "gossip_received": metric(text, "kyverno_fleet_gossip_total",
+                                      outcome="received"),
+            "rejects": metric(text, "kyverno_fleet_peer_rejects_total"),
+            "divergences": metric(
+                text, "kyverno_verification_divergence_total"),
+        }
+    except Exception as e:  # noqa: BLE001
+        out["error"] = repr(e)[:400]
+    finally:
+        for p in live_procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in live_procs:
+            try:
+                p.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                p.kill()
+    return out
+
+
 FNS = {
     "scan": lambda: bench_scan(),
     "match": lambda: bench_match(),
@@ -1640,6 +1926,7 @@ FNS = {
     "encode_scaling": lambda: bench_encode_scaling(),
     "patterns": lambda: bench_patterns(),
     "analyze": lambda: bench_analyze(),
+    "fleet": lambda: bench_fleet(),
 }
 
 
@@ -1872,7 +2159,7 @@ def run_all():
     emit(out)
     for name in ("match", "overlay", "apply", "admission", "mixed_traffic",
                  "fallback", "cached", "columnar", "encode_scaling",
-                 "patterns", "analyze", "churn"):
+                 "patterns", "analyze", "churn", "fleet"):
         if only and name not in only:
             continue
         t0 = time.perf_counter()
@@ -1954,6 +2241,8 @@ def main():
         config = "patterns"
     if config == "--analyze":  # flag spelling of the analyze config
         config = "analyze"
+    if config == "--fleet":  # flag spelling of the fleet config
+        config = "fleet"
     if config == "--mixed-traffic":  # flag spelling of mixed_traffic
         config = "mixed_traffic"
     if config == "--columnar":  # flag spelling of the columnar config

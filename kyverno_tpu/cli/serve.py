@@ -226,6 +226,40 @@ def add_parser(sub: argparse._SubParsersAction) -> None:
                         "hot swap; findings land on the op log, "
                         "kyverno_analysis_* metrics, /debug/analysis, "
                         "and the /debug/rules never-fired correlation")
+    # fleet (fleet/): multi-replica scan sharding with lease-based
+    # failover and peered verdict caches. Process-level replicas run
+    # the whole chaos story on CPU; --distributed adds the real
+    # multi-host jax mesh when the topology exists.
+    p.add_argument("--fleet-listen", type=int, default=None, metavar="PORT",
+                   help="run the localhost fleet peer protocol on PORT "
+                        "(membership heartbeats, verdict-cache fetch/"
+                        "push); enables the fleet layer — background "
+                        "scans then cover only this replica's "
+                        "rendezvous-assigned keyspace shards, with "
+                        "failover when a peer's lease expires (0 picks "
+                        "an ephemeral port)")
+    p.add_argument("--fleet-peers", default=None,
+                   metavar="URL[,URL...]",
+                   help="peer replica base URLs "
+                        "(http://127.0.0.1:PORT,...); additional peers "
+                        "are discovered through heartbeat exchange")
+    p.add_argument("--replica-id", default=None, metavar="ID",
+                   help="this replica's stable fleet identity "
+                        "(default: r<pid>); the lowest live id leads")
+    p.add_argument("--fleet-lease-s", type=float, default=3.0,
+                   help="membership lease TTL: a replica that stops "
+                        "heartbeating for this long is declared dead "
+                        "and its shards fail over")
+    p.add_argument("--fleet-shards", type=int, default=64,
+                   help="fixed shard count the resource keyspace is "
+                        "rendezvous-hashed into (must match across "
+                        "the fleet)")
+    p.add_argument("--distributed", action="store_true",
+                   help="bring up jax.distributed (coordinator/rank "
+                        "from the standard JAX env) and shard device "
+                        "batches over the 2-D hosts x data mesh; "
+                        "without a multi-host topology this logs and "
+                        "continues single-host")
     p.add_argument("--dfa-state-budget", type=int, default=None, metavar="N",
                    help="per-pattern DFA state budget for device-side "
                         "string matching: exact tables up to N states, "
@@ -244,7 +278,8 @@ class ControlPlane:
                  policy_watch=None, reload_interval=2.0,
                  flight_sample_rate=None, flight_capacity=None,
                  flight_dir=None, shadow_verify_rate=None,
-                 analyze_on_swap=False, classify_config=None):
+                 analyze_on_swap=False, classify_config=None,
+                 fleet_config=None, mesh=None):
         # flight recorder + shadow verifier are process-global (like
         # the caches); only explicitly-passed knobs are applied so a
         # test-configured recorder survives ControlPlane construction
@@ -266,7 +301,7 @@ class ControlPlane:
         self.configuration = configuration or Configuration()
         self.toggles = toggles or Toggles()
         self.scan_service = BackgroundScanService(
-            self.snapshot, self.cache, self.aggregator)
+            self.snapshot, self.cache, self.aggregator, mesh=mesh)
         # Kyverno->VAP generation: eligible CEL policies materialize a
         # ValidatingAdmissionPolicy + binding pair in the snapshot
         # (controllers/validatingadmissionpolicy-generate/controller.go)
@@ -309,6 +344,24 @@ class ControlPlane:
             global_analysis.lint_enabled = True
             self.lifecycle.analyze_on_swap = True
         self.cache.subscribe(self._on_policy_change)
+        # fleet layer: membership + shard failover + cache peering
+        # (fleet/manager.py). Configured BEFORE the scan thread starts
+        # so the first tick already scans only owned shards.
+        self.fleet = None
+        if fleet_config is not None:
+            from ..fleet import configure_fleet
+
+            self.fleet = configure_fleet(fleet_config)
+            lifecycle = self.lifecycle
+
+            def _active_rows():
+                active = lifecycle.active
+                return (len(active.engine.cps.rules)
+                        if active is not None else None)
+
+            # push-receive shape verification: pushed columns must
+            # match the active compiled set's rule count
+            self.fleet.rows_provider = _active_rows
         self.watcher = None
         if policy_watch:
             from ..lifecycle import PolicyDirWatcher
@@ -353,6 +406,16 @@ class ControlPlane:
 
     def stop(self) -> None:
         self._stop.set()
+        if self.fleet is not None:
+            # graceful leave: peers rebalance immediately instead of
+            # waiting out the lease TTL
+            from ..fleet import configure_fleet, get_fleet
+
+            if get_fleet() is self.fleet:
+                configure_fleet(None)
+            else:
+                self.fleet.stop()
+            self.fleet = None
         if self.watcher is not None:
             self.watcher.stop()
         self.admission.stop()
@@ -439,6 +502,43 @@ def _metrics_server(cp: "ControlPlane", port: int) -> ThreadingHTTPServer:
                 self._send(404, b"")
 
     return ThreadingHTTPServer(("127.0.0.1", port), _Req)
+
+
+def _init_distributed():
+    """serve --distributed: initialize jax.distributed (coordinator
+    address/rank from the standard JAX env vars) and build the 2-D
+    (hosts, data) mesh from parallel/sharding.py when the process
+    actually spans hosts. Returns the mesh or None; every failure
+    mode degrades to single-host with an op-log breadcrumb."""
+    from ..observability.log import global_oplog
+
+    try:
+        import jax
+
+        try:
+            jax.distributed.initialize()
+        except Exception as e:
+            # already initialized (ok) or no coordinator configured
+            if "already" not in str(e).lower():
+                global_oplog.emit("distributed_init_skipped",
+                                  level="warn", error=str(e)[:200])
+                return None
+        hosts = jax.process_count()
+        per_host = max(len(jax.devices()) // max(hosts, 1), 1)
+        if hosts <= 1:
+            global_oplog.emit("distributed_single_host",
+                              devices=len(jax.devices()))
+            return None
+        from ..parallel.sharding import make_mesh_2d
+
+        mesh = make_mesh_2d(hosts, per_host)
+        global_oplog.emit("distributed_initialized", hosts=hosts,
+                          per_host=per_host)
+        return mesh
+    except Exception as e:  # noqa: BLE001
+        global_oplog.emit("distributed_init_failed", level="warn",
+                          error=str(e)[:200])
+        return None
 
 
 def _load_policies(paths) -> list:
@@ -543,6 +643,35 @@ def run(args: argparse.Namespace) -> int:
                 if u.strip())
         if classify_kw:
             classify_config = ClassifyConfig(**classify_kw)
+    fleet_config = None
+    if args.fleet_listen is not None:
+        from ..fleet import FleetConfig
+
+        if args.fleet_shards <= 0:
+            print("--fleet-shards must be positive (0 would scan "
+                  "nothing, everywhere)", file=sys.stderr)
+            return 2
+        peers = tuple(u.strip().rstrip("/")
+                      for u in (args.fleet_peers or "").split(",")
+                      if u.strip())
+        fleet_config = FleetConfig(
+            replica_id=args.replica_id or f"r{os.getpid()}",
+            listen_port=args.fleet_listen,
+            peers=peers,
+            lease_s=args.fleet_lease_s,
+            num_shards=args.fleet_shards)
+    elif args.fleet_peers or args.replica_id:
+        print("--fleet-peers/--replica-id need --fleet-listen "
+              "(the peer protocol endpoint)", file=sys.stderr)
+        return 2
+    mesh = None
+    if args.distributed:
+        # real multi-host: bring up jax.distributed from the standard
+        # coordinator env and shard scans over the 2-D hosts x data
+        # mesh. Anything short of a working topology logs and stays
+        # single-host — the fleet layer above is what carries the
+        # process-level story either way.
+        mesh = _init_distributed()
     exporter = None
     if args.trace_export:
         from ..observability.tracing import (OTLPJsonFileExporter,
@@ -563,7 +692,15 @@ def run(args: argparse.Namespace) -> int:
                       flight_dir=args.flight_dir,
                       shadow_verify_rate=args.shadow_verify_rate,
                       analyze_on_swap=args.analyze_on_swap,
-                      classify_config=classify_config)
+                      classify_config=classify_config,
+                      fleet_config=fleet_config, mesh=mesh)
+    if fleet_config is not None and cp.fleet is not None:
+        global_oplog.emit("fleet_enabled",
+                          replica_id=fleet_config.replica_id,
+                          listen=cp.fleet.url,
+                          peers=list(fleet_config.peers),
+                          lease_s=fleet_config.lease_s,
+                          shards=fleet_config.num_shards)
     if args.analyze_on_swap:
         global_oplog.emit("analyze_on_swap_enabled")
     if args.policy_watch:

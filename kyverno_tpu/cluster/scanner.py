@@ -173,7 +173,11 @@ class BackgroundScanService:
 
     def scan_once(self, full: bool = False) -> int:
         """Scan dirty (or all, when full/revision changed) resources.
-        Returns the number of resources evaluated."""
+        Returns the number of resources evaluated. Under a fleet
+        (fleet/manager.py) the keyspace is sharded: this replica scans
+        ONLY the shards it owns, and shards just taken over from a
+        dead replica force-rescan (the dead owner's reports died with
+        it — clean-skip bookkeeping must not hide that)."""
         revision = self.cache.revision
         # ONE dep-movement decision per tick: it drives both the full
         # rescan (stale verdicts) and the recompile, so a configmap
@@ -181,6 +185,21 @@ class BackgroundScanService:
         deps_moved = self._deps_moved()
         if deps_moved:
             full = True
+        # ONE ownership snapshot per tick (the fleet heartbeat thread
+        # rebalances concurrently; mid-tick changes land next tick)
+        fleet = None
+        owned = takeover = None
+        try:
+            from ..fleet import get_fleet, shard_of
+
+            fleet = get_fleet()
+        except Exception:
+            fleet = None
+        if fleet is not None and fleet.active:
+            owned = fleet.owned_view()
+            # peek, don't drain: a tick that dies mid-scan must retry
+            # the takeover (note_scan_tick clears it at completion)
+            takeover = fleet.pending_takeover()
         # swap the dirty set FIRST: changes arriving during this scan
         # land in the fresh set and are picked up next pass (no lost
         # invalidations between items() and processing)
@@ -198,6 +217,15 @@ class BackgroundScanService:
                 # generated VAPs) never background-scan — the reference
                 # excludes them via the default resourceFilters
                 continue
+            if owned is not None:
+                shard = shard_of(uid, fleet.config.num_shards)
+                if shard not in owned:
+                    self.stats["skipped_unowned"] = \
+                        self.stats.get("skipped_unowned", 0) + 1
+                    continue
+                if takeover and shard in takeover:
+                    todo.append((uid, res, h))
+                    continue
             if full or uid in dirty \
                     or scanned.get(uid) != (h, revision):
                 todo.append((uid, res, h))
@@ -205,10 +233,12 @@ class BackgroundScanService:
                 self.stats["skipped_clean"] += 1
         if not todo:
             # a clean tick is still a completed scan: freshness resets
+            # (fleet: by the oldest owned shard, not unconditionally)
             try:
                 from ..observability.analytics import global_slo
 
-                global_slo.record_scan()
+                global_slo.record_scan(
+                    lag_s=self._fleet_lag(fleet, owned, takeover))
             except Exception:
                 pass
             return 0
@@ -295,13 +325,40 @@ class BackgroundScanService:
             miss_keys = [None] * len(todo)
         else:
             for entry, key in zip(todo, keys):
-                col = vc.get(key) if key is not None else None
+                col = (vc.get(key, expect_rows=len(rules))
+                       if key is not None else None)
                 if col is None:
                     miss.append(entry)
                     miss_keys.append(key)
                 else:
                     hit_entries.append(entry)
                     hit_cols.append(col)
+        if miss and fleet is not None and fleet.active and keys is not None:
+            # fleet cache peering: before paying encode + device for
+            # the misses, ask live peers for their columns (one
+            # bounded batch fetch; dead peers cost nothing past their
+            # breaker). Verified hits are served exactly like local
+            # hits — content-addressed keys make a wrong-revision or
+            # poisoned peer answer impossible to serve.
+            try:
+                peer_cols = fleet.fetch_missing(
+                    [k for k in miss_keys if k is not None], len(rules))
+            except Exception:
+                peer_cols = {}
+            if peer_cols:
+                still: List[Tuple[str, Dict[str, Any], str]] = []
+                still_keys: List[Optional[Tuple]] = []
+                for entry, key in zip(miss, miss_keys):
+                    col = peer_cols.get(key) if key is not None else None
+                    if col is None:
+                        still.append(entry)
+                        still_keys.append(key)
+                    else:
+                        hit_entries.append(entry)
+                        hit_cols.append(col)
+                miss, miss_keys = still, still_keys
+                self.stats["fleet_peer_hits"] = \
+                    self.stats.get("fleet_peer_hits", 0) + len(peer_cols)
         if hit_entries:
             hit_table = np.stack(hit_cols, axis=1)
             report(hit_entries, ScanResult(verdicts=hit_table, rules=rules))
@@ -393,7 +450,7 @@ class BackgroundScanService:
         total = len(todo)
         self.stats["scans"] += 1
         self.stats["resources_scanned"] += total
-        self._record_slo(eng)
+        self._record_slo(eng, lag_s=self._fleet_lag(fleet, owned, takeover))
         try:
             from .columnar import get_store
 
@@ -404,17 +461,33 @@ class BackgroundScanService:
             pass
         return total
 
-    def _record_slo(self, eng) -> None:
+    @staticmethod
+    def _fleet_lag(fleet, owned, takeover=None) -> float:
+        """Stamp this tick's covered shards fresh (clearing the
+        honored takeover set) and return the fleet freshness lag (0
+        outside a fleet): a completed tick covered every owned shard,
+        so the lag is nonzero only while a takeover's shards still
+        carry the dead owner's stamps."""
+        if fleet is None or owned is None or not fleet.active:
+            return 0.0
+        try:
+            return fleet.note_scan_tick(owned, taken=takeover)
+        except Exception:
+            return 0.0
+
+    def _record_slo(self, eng, lag_s: float = 0.0) -> None:
         """Scan-freshness + device-coverage SLO inputs: every completed
-        scan tick stamps the freshness clock and republishes the active
-        compiled set's device coverage."""
+        scan tick stamps the freshness clock (set back by the fleet
+        shard lag, so takeover staleness is visible) and republishes
+        the active compiled set's device coverage."""
         try:
             from ..observability.analytics import (global_slo,
                                                    global_starvation)
 
             dev, total_rules = eng.coverage()
             global_slo.record_scan(
-                coverage=(dev / total_rules) if total_rules else 1.0)
+                coverage=(dev / total_rules) if total_rules else 1.0,
+                lag_s=lag_s)
             self.stats["feed_starvation"] = global_starvation.ratio()
         except Exception:
             pass  # observability must never fail a scan tick

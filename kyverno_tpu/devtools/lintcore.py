@@ -194,6 +194,10 @@ HOT_MODULES = frozenset({
     "observability/metrics.py", "observability/analytics.py",
     "observability/flightrecorder.py", "resilience/breaker.py",
     "lifecycle/snapshot.py",
+    # fleet: the peer-fetch path runs on admission submit and the
+    # heartbeat/gossip threads share state with the scan tick — remote
+    # IO must never happen under a held fleet lock
+    "fleet/manager.py", "fleet/membership.py", "fleet/peering.py",
 })
 
 

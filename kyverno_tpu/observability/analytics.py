@@ -691,9 +691,15 @@ class SloTracker:
         self._burn_cache = (now, burn)
         return burn
 
-    def record_scan(self, coverage: Optional[float] = None) -> None:
+    def record_scan(self, coverage: Optional[float] = None,
+                    lag_s: float = 0.0) -> None:
+        """A scan tick completed. ``lag_s`` sets the freshness clock
+        BACK: under a fleet the completed tick may still be serving
+        shards whose last real scan happened on a now-dead replica —
+        the scan-freshness SLO must age from the oldest owned shard,
+        not from the tick that merely took ownership."""
         with self._lock:
-            self._last_scan = self._clock()
+            self._last_scan = self._clock() - max(lag_s, 0.0)
             if coverage is not None:
                 self._coverage = coverage
         self.update_gauges()

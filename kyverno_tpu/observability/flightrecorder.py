@@ -98,6 +98,18 @@ def policyset_key(engine: Any) -> str:
     return key
 
 
+def _replica_id() -> Optional[str]:
+    """This process's fleet replica id (None outside a fleet) — the
+    per-record tag that attributes spooled decisions to a failure
+    domain."""
+    try:
+        from ..fleet.manager import current_replica_id
+
+        return current_replica_id()
+    except Exception:
+        return None
+
+
 class FlightRecord:
     """One recorded decision. Bodies and verdict rows are held by
     reference — building a record costs dict-slot assignments, never a
@@ -149,6 +161,12 @@ class FlightRecord:
             "resource_sha": self.resource_sha,
             "namespace": self.namespace, "operation": self.operation,
         }
+        # fleet: records are tagged with the replica that made the
+        # decision, so a spooled capture from a 3-replica incident
+        # says WHICH failure domain each verdict came from
+        replica = _replica_id()
+        if replica:
+            doc["replica"] = replica
         if self.userinfo:
             doc["userinfo"] = self.userinfo
         if self.ns_labels:

@@ -429,6 +429,50 @@ class MetricsRegistry:
             "kyverno_serving_hedge_total",
             "hedged scalar dispatches racing an in-flight device batch, "
             "by winner (scalar/device/device_error/expired/error)")
+        # fleet layer (fleet/): multi-replica membership, rendezvous
+        # shard ownership, and cache peering. Peer labels are replica
+        # ids — cardinality is bounded by the (small, operator-
+        # configured) fleet size, so per-peer families are safe here
+        # where per-tenant ones would not be
+        self.fleet_replicas = self.gauge(
+            "kyverno_fleet_replicas",
+            "live replicas in this replica's membership view "
+            "(self included)")
+        self.fleet_is_leader = self.gauge(
+            "kyverno_fleet_is_leader",
+            "1 when this replica is the fleet leader (lowest live id)")
+        self.fleet_epoch = self.gauge(
+            "kyverno_fleet_epoch",
+            "membership-change epoch the current shard map was "
+            "computed at")
+        self.fleet_shards_owned = self.gauge(
+            "kyverno_fleet_shards_owned",
+            "resource-keyspace shards this replica currently owns")
+        self.fleet_shard_reassignments = self.counter(
+            "kyverno_fleet_shard_reassignments_total",
+            "shards that moved INTO this replica's ownership, by "
+            "reason (initial/membership)")
+        self.fleet_shard_staleness = self.gauge(
+            "kyverno_fleet_shard_staleness_seconds",
+            "seconds by which the oldest owned shard trails the last "
+            "scan tick (takeover shards inherit the dead owner's last "
+            "gossiped stamp until rescanned)")
+        self.fleet_heartbeats = self.counter(
+            "kyverno_fleet_heartbeats_total",
+            "outbound membership heartbeats by peer and outcome")
+        self.fleet_peer_fetch = self.counter(
+            "kyverno_fleet_peer_fetch_total",
+            "verdict-cache peer fetch keys by peer and outcome "
+            "(hit/miss/error/rejected)")
+        self.fleet_peer_rejects = self.counter(
+            "kyverno_fleet_peer_rejects_total",
+            "peer cache entries rejected at receive verification by "
+            "reason (checksum/key_mismatch/shape/decode) — every "
+            "reject is served as a miss, never a wrong verdict")
+        self.fleet_gossip = self.counter(
+            "kyverno_fleet_gossip_total",
+            "async verdict-column gossip by outcome "
+            "(sent/received/error/dropped)")
         # resilience layer (resilience/): breaker state machine, scalar
         # fallback routing, retry outcomes, injected faults
         self.breaker_state = self.gauge(

@@ -18,6 +18,14 @@ the failure physically happens:
                           dispatch (encode/pool.py)
     encode.worker       the encode executed INSIDE a pool worker
                         process (encode/worker.py)
+    fleet.heartbeat     a replica's outbound membership heartbeat
+                        (fleet/manager.py) — a raise here looks like a
+                        network partition: the peer's lease keeps
+                        aging and failover engages at the TTL
+    fleet.peer_fetch    the verdict-cache fetch-on-miss call to a peer
+                        (fleet/peering.py) — degrades to local compute
+    fleet.gossip        the async push of freshly computed verdict
+                        columns to peers (fleet/manager.py)
 
 Tests (and the ``KYVERNO_TPU_FAULTS`` env knob) arm a site with a
 probability- or count-based trigger and a mode — ``raise``, ``delay``,
@@ -67,11 +75,15 @@ SITE_SERVING_HEDGE = "serving.hedge"
 SITE_POLICYSET_COMPILE = "policyset.compile"
 SITE_ENCODE_POOL_DISPATCH = "encode.pool_dispatch"
 SITE_ENCODE_WORKER = "encode.worker"
+SITE_FLEET_HEARTBEAT = "fleet.heartbeat"
+SITE_FLEET_PEER_FETCH = "fleet.peer_fetch"
+SITE_FLEET_GOSSIP = "fleet.gossip"
 
 KNOWN_SITES = frozenset({
     SITE_TPU_DISPATCH, SITE_CONTEXT_API_CALL, SITE_CONTEXT_IMAGE_DATA,
     SITE_GCTX_REFRESH, SITE_SERVING_FLUSH, SITE_SERVING_HEDGE,
     SITE_POLICYSET_COMPILE, SITE_ENCODE_POOL_DISPATCH, SITE_ENCODE_WORKER,
+    SITE_FLEET_HEARTBEAT, SITE_FLEET_PEER_FETCH, SITE_FLEET_GOSSIP,
 })
 
 MODES = ("raise", "delay", "corrupt", "crash")

@@ -158,6 +158,12 @@ class VerdictCache:
             capacity = _env_int("KYVERNO_TPU_VERDICT_CACHE", 65536)
         self._lru = LruCache(capacity, name="verdict")
         self._metrics = metrics
+        # optional fleet fan-out hook (fleet/manager.py): called with
+        # (key, column) AFTER a locally computed column lands, so one
+        # replica's scan warms its peers. Set/cleared by the fleet
+        # manager; never called for peer-received columns (put with
+        # fanout=False) — a column cannot ping-pong across the fleet.
+        self.on_put = None
 
     def _registry(self):
         if self._metrics is None:
@@ -183,9 +189,19 @@ class VerdictCache:
     def clear(self) -> None:
         self._lru.clear()
 
-    def get(self, key: Any) -> Optional[np.ndarray]:
+    def get(self, key: Any,
+            expect_rows: Optional[int] = None) -> Optional[np.ndarray]:
+        """Lookup; with ``expect_rows`` a stored column whose length
+        does not match the caller's compiled rule count is a MISS —
+        the one place the wrong-shape defense lives (a hostile or
+        racing fleet push may land a length-consistent column under a
+        content key before the receive-side shape check can know the
+        active rule count; no reader may crash on it)."""
         m = self._registry()
         col = self._lru.get(key)
+        if col is not None and expect_rows is not None \
+                and col.shape[0] != expect_rows:
+            col = None
         if col is None:
             m.verdict_cache.inc({"outcome": "miss"})
             return None
@@ -205,7 +221,15 @@ class VerdictCache:
         total = hits + misses
         return round(hits / total, 4) if total else 0.0
 
-    def put(self, key: Any, column: np.ndarray) -> None:
+    def peek(self, key: Any) -> Optional[np.ndarray]:
+        """Lookup WITHOUT hit/miss accounting — the fleet peer-fetch
+        server path (a peer probing this cache must not skew the local
+        hit-rate signal)."""
+        col = self._lru.get(key)
+        return col.copy() if col is not None else None
+
+    def put(self, key: Any, column: np.ndarray,
+            fanout: bool = True) -> None:
         if not self._lru.enabled:
             return
         before = self._lru.evictions
@@ -215,6 +239,12 @@ class VerdictCache:
         if evicted:
             m.verdict_cache_evictions.inc(value=evicted)
         m.verdict_cache_size.set(len(self._lru))
+        hook = self.on_put
+        if fanout and hook is not None:
+            try:
+                hook(key, column)  # bounded enqueue, never blocks
+            except Exception:
+                pass
 
 
 # per-resource row lanes stored trimmed to the rows the resource uses

@@ -719,7 +719,8 @@ class TpuEngine:
         miss: List[int] = []
         hits: List[int] = []
         for i, key in enumerate(keys):
-            col = vc.get(key) if key is not None else None
+            col = (vc.get(key, expect_rows=len(rules))
+                   if key is not None else None)
             if col is None:
                 miss.append(i)
             else:

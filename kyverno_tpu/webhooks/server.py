@@ -221,7 +221,23 @@ class Handlers:
             [res], ns_labels, [payload.operation], [payload.info])
         if keys is None or keys[0] is None:
             return None
-        col = global_verdict_cache.get(keys[0])
+        col = global_verdict_cache.get(keys[0],
+                                       expect_rows=len(eng.cps.rules))
+        if col is None:
+            # fleet peering: a local miss may be a fleet-wide hit — one
+            # bounded single-key peer fetch (tight budget, per-peer
+            # breaker) before falling through to the batch path. With
+            # every peer down this costs at most one peer timeout and
+            # then nothing until a breaker half-opens — the p99
+            # envelope guarantee of the degradation ladder.
+            try:
+                from ..fleet import get_fleet
+
+                fleet = get_fleet()
+                if fleet is not None and fleet.active:
+                    col = fleet.fetch_one(keys[0], len(eng.cps.rules))
+            except Exception:
+                col = None
         if col is None:
             return None
         # submit-time cache hits never reach the engine: replay the
@@ -607,6 +623,7 @@ class Handlers:
                 for site, spec in global_faults.armed().items()},
             "flight": _flight_state(),
             "verification": _verification_state(),
+            "fleet": _fleet_state(),
             "phase_breakdown": global_profiler.breakdown(),
         }
         if self.pipeline is not None:
@@ -1041,6 +1058,18 @@ def _encode_pool_state():
         return {"enabled": False}
 
 
+def _fleet_state():
+    """The fleet layer's /debug/state block ({'enabled': False}
+    outside a fleet — introspection must not start one)."""
+    try:
+        from ..fleet import get_fleet
+
+        fleet = get_fleet()
+        return fleet.state() if fleet is not None else {"enabled": False}
+    except Exception:
+        return {"enabled": False}
+
+
 def _columnar_state():
     """The columnar row store's /debug/state block: per-table arena
     occupancy, hit/miss/segment accounting, and the feed-work counters
@@ -1125,6 +1154,13 @@ def handle_debug_path(path: str, handlers: Optional[Handlers] = None
         from ..analysis import global_analysis
 
         doc = global_analysis.report_dict()
+        return 200, (json.dumps(doc) + "\n").encode(), "application/json"
+    if route == "/debug/fleet":
+        # the fleet layer's operator surface: membership/lease view,
+        # shard ownership + takeover staleness, per-peer breaker
+        # states, and the push-queue depth ({'enabled': False} on a
+        # single-replica engine)
+        doc = _fleet_state()
         return 200, (json.dumps(doc) + "\n").encode(), "application/json"
     if route == "/debug/flight":
         # the flight recorder's ring, newest-last: the last N decisions

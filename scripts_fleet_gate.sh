@@ -1,0 +1,208 @@
+#!/bin/bash
+# Fleet gate (ISSUE 15): the multi-replica story proven end-to-end
+# through REAL serve processes.
+#
+# Leg 1 boots two fleet-wired replicas, warms one with an admission
+# review, and asserts the COLD replica answers the identical review
+# from the fleet cache (peer fetch hit counted, no local compute) with
+# a bit-identical response, and that the kyverno_fleet_* families pass
+# the exposition surface. Leg 2 is the chaos acceptance: three
+# replicas, one SIGKILLed mid-scan, shard takeover within the lease
+# TTL, the scan completing with the exact expected verdict split
+# across survivors, and zero shadow-verification divergence at rate
+# 1.0. Leg 3 runs the fleet unit/integration suite under the dynamic
+# lock-order sanitizer and asserts zero cycles. Leg 4 is tier-1.
+#
+# Usage: ./scripts_fleet_gate.sh
+set -o pipefail
+cd "$(dirname "$0")"
+rc=0
+
+echo "=== leg 1/4: cold replica answers from the fleet cache ==="
+KYVERNO_TPU_FAULTS= JAX_PLATFORMS=cpu timeout -k 10 600 python - <<'EOF' || rc=1
+import http.client
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+import yaml
+
+POLICY = {
+    "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+    "metadata": {"name": "fleet-gate"},
+    "spec": {"validationFailureAction": "Enforce", "rules": [{
+        "name": "no-privileged",
+        "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+        "validate": {"message": "no privileged",
+                     "pattern": {"spec": {"containers": [
+                         {"=(securityContext)":
+                          {"=(privileged)": "false"}}]}}},
+    }]}}
+
+REVIEW = {"request": {
+    "uid": "gate-1", "operation": "CREATE", "namespace": "default",
+    "object": {"apiVersion": "v1", "kind": "Pod",
+               "metadata": {"name": "gate-pod", "namespace": "default"},
+               "spec": {"containers": [{"name": "c", "image": "nginx"}]}},
+}}
+
+
+def free_port():
+    s = socket.socket(); s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]; s.close(); return port
+
+
+def get(port, path, timeout=30):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    conn.request("GET", path)
+    resp = conn.getresponse(); body = resp.read(); conn.close()
+    return resp.status, body
+
+
+def post(port, path, doc, timeout=300):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    conn.request("POST", path, json.dumps(doc),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse(); body = resp.read(); conn.close()
+    return resp.status, body
+
+
+def metric(text, name, **labels):
+    total = 0.0
+    for line in text.splitlines():
+        if not line.startswith(name):
+            continue
+        rest = line[len(name):]
+        if rest and rest[0] not in ("{", " "):
+            continue
+        if all(f'{k}="{v}"' in rest for k, v in labels.items()):
+            try:
+                total += float(line.split(" # ")[0].rsplit(" ", 1)[-1])
+            except ValueError:
+                pass
+    return total
+
+
+tmp = tempfile.mkdtemp(prefix="fleet-gate-")
+pol_file = os.path.join(tmp, "policy.yaml")
+with open(pol_file, "w") as f:
+    yaml.safe_dump(POLICY, f)
+env = dict(os.environ)
+env.update({"JAX_PLATFORMS": "cpu",
+            "KYVERNO_TPU_XLA_CACHE_DIR": os.path.join(tmp, "xla")})
+fleet = [free_port(), free_port()]
+adm = [free_port(), free_port()]
+met = [free_port(), free_port()]
+procs = []
+try:
+    for i in range(2):
+        peers = f"http://127.0.0.1:{fleet[1 - i]}"
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "kyverno_tpu", "serve", pol_file,
+             "--port", str(adm[i]), "--metrics-port", str(met[i]),
+             "--scan-interval", "9999", "--batching",
+             "--fleet-listen", str(fleet[i]), "--fleet-peers", peers,
+             "--replica-id", f"gate{i}", "--fleet-lease-s", "2.0"],
+            env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL))
+        # serialize boots so replica 1 reads replica 0's warm XLA cache
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline:
+            try:
+                if get(met[i], "/healthz", timeout=2)[0] == 200:
+                    break
+            except OSError:
+                time.sleep(0.3)
+        else:
+            raise AssertionError(f"replica {i} never became healthy")
+    # converge to 2 live replicas
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        try:
+            views = [json.loads(get(fleet[i], "/fleet/state", 2)[1])
+                     for i in range(2)]
+            if all(len(v["membership"]["live"]) == 2 for v in views):
+                break
+        except OSError:
+            pass
+        time.sleep(0.3)
+    else:
+        raise AssertionError("fleet never converged")
+
+    # warm replica 0 with the review (computes + caches the column)
+    status, body = post(adm[0], "/validate", REVIEW)
+    assert status == 200, status
+    warm = json.loads(body)["response"]
+    # give the async gossip a beat, then ALSO verify fetch-on-miss by
+    # hitting the cold replica: whether the column arrived by push or
+    # is pulled now, the cold replica must answer from the FLEET cache
+    status, body = get(met[1], "/metrics")
+    before_fetch = metric(body.decode(), "kyverno_fleet_peer_fetch_total",
+                          outcome="hit")
+    before_gossip = metric(body.decode(), "kyverno_fleet_gossip_total",
+                           outcome="received")
+    status, body = post(adm[1], "/validate", REVIEW)
+    assert status == 200, status
+    cold = json.loads(body)["response"]
+    assert cold["allowed"] == warm["allowed"], (cold, warm)
+    status, body = get(met[1], "/metrics")
+    text = body.decode()
+    after_fetch = metric(text, "kyverno_fleet_peer_fetch_total",
+                         outcome="hit")
+    after_gossip = metric(text, "kyverno_fleet_gossip_total",
+                          outcome="received")
+    assert (after_fetch > before_fetch or after_gossip >= 1), \
+        "cold replica neither fetched nor received the warm column"
+    # exposition surface: every fleet family TYPE'd and present
+    for fam in ("kyverno_fleet_replicas", "kyverno_fleet_is_leader",
+                "kyverno_fleet_epoch", "kyverno_fleet_shards_owned",
+                "kyverno_fleet_heartbeats_total",
+                "kyverno_fleet_shard_reassignments_total"):
+        assert f"# TYPE {fam} " in text, fam
+    assert metric(text, "kyverno_fleet_replicas") == 2
+    # /debug/fleet rides the metrics port debug router too
+    status, body = get(met[1], "/debug/fleet")
+    doc = json.loads(body)
+    assert doc["enabled"] and doc["membership"]["replica_id"] == "gate1"
+    print(f"cold-peer admission OK (fetch {after_fetch - before_fetch:+.0f}, "
+          f"gossip received {after_gossip:.0f}); families scrapeable")
+finally:
+    for p in procs:
+        p.terminate()
+    for p in procs:
+        try:
+            p.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            p.kill()
+EOF
+
+echo "=== leg 2/4: SIGKILL chaos — takeover + zero divergence ==="
+KYVERNO_TPU_FAULTS= JAX_PLATFORMS=cpu timeout -k 10 900 \
+  python -m pytest tests/test_fleet_chaos.py -q -p no:cacheprovider || rc=1
+
+echo "=== leg 3/4: fleet suite under the lock-order sanitizer ==="
+rm -f /tmp/_san_fleet.json
+KYVERNO_TPU_SANITIZE=1 KYVERNO_TPU_SANITIZE_REPORT=/tmp/_san_fleet.json \
+  KYVERNO_TPU_FAULTS= JAX_PLATFORMS=cpu timeout -k 10 900 \
+  python -m pytest tests/test_fleet.py -q -p no:cacheprovider || rc=1
+python - <<'EOF' || rc=1
+import json
+doc = json.load(open("/tmp/_san_fleet.json"))
+assert doc["cycles"] == [], f"LOCK-ORDER CYCLES: {doc['cycles']}"
+assert doc["dispatch_violations"] == [], \
+    f"locks held across dispatch: {doc['dispatch_violations']}"
+print(f"fleet clean under sanitizer: {doc['locks_tracked']} locks, "
+      f"{doc['edges']} edges, 0 cycles")
+EOF
+
+echo "=== leg 4/4: tier-1 ==="
+KYVERNO_TPU_FAULTS= JAX_PLATFORMS=cpu timeout -k 10 870 \
+  python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors \
+  -p no:cacheprovider -p no:xdist -p no:randomly || rc=1
+
+if [ $rc -eq 0 ]; then echo "FLEET GATE: PASS"; else echo "FLEET GATE: FAIL"; fi
+exit $rc

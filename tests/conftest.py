@@ -85,6 +85,20 @@ def _fresh_flight_recorder():
     global_oplog.reset()
 
 
+# the fleet manager (fleet/manager.py) is process-global like the
+# caches: a test that configures replicas must not leak membership,
+# peer breakers, or the verdict-cache fan-out hook into the next test
+@pytest.fixture(autouse=True)
+def _fresh_fleet():
+    yield
+    from kyverno_tpu.fleet import get_fleet, reset_fleet
+    from kyverno_tpu.tpu.cache import global_verdict_cache
+
+    if get_fleet() is not None:
+        reset_fleet()
+    global_verdict_cache.on_put = None
+
+
 @pytest.fixture
 def no_verdict_cache():
     """Opt-out for tests that count device dispatches on repeat scans

@@ -112,6 +112,43 @@ def test_serve_help_covers_columnar_flags(capsys):
         assert flag in out
 
 
+def test_serve_help_covers_fleet_flags(capsys):
+    """The fleet layer's knobs (fleet/) must be operator-visible:
+    peer endpoint, peer list, identity, lease TTL, shard count, and
+    the multi-host mesh switch."""
+    with pytest.raises(SystemExit) as exc:
+        main(["serve", "--help"])
+    assert exc.value.code == 0
+    out = capsys.readouterr().out
+    for flag in ("--fleet-listen", "--fleet-peers", "--replica-id",
+                 "--fleet-lease-s", "--fleet-shards", "--distributed"):
+        assert flag in out
+
+
+def test_serve_fleet_flags_need_listen(capsys):
+    """--fleet-peers without --fleet-listen is a config error, not a
+    silently-single-replica serve."""
+    import tempfile
+
+    import yaml as _yaml
+
+    with tempfile.NamedTemporaryFile("w", suffix=".yaml",
+                                     delete=False) as f:
+        _yaml.safe_dump({
+            "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+            "metadata": {"name": "p"},
+            "spec": {"rules": [{
+                "name": "r",
+                "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+                "validate": {"message": "m",
+                             "pattern": {"metadata": {"name": "?*"}}},
+            }]}}, f)
+        path = f.name
+    rc = main(["serve", path, "--fleet-peers", "http://127.0.0.1:1"])
+    assert rc == 2
+    assert "--fleet-listen" in capsys.readouterr().err
+
+
 def test_replay_and_flight_dump_help(capsys):
     with pytest.raises(SystemExit) as exc:
         main(["replay", "--help"])

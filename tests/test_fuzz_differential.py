@@ -10,6 +10,12 @@ suite is deterministic; the budget is bounded via max_examples."""
 
 import string
 
+import pytest
+
+# the whole module is hypothesis-driven: skip (not fail collection) in
+# containers without the optional dependency
+pytest.importorskip("hypothesis")
+
 from hypothesis import HealthCheck, given, settings, strategies as st
 
 from kyverno_tpu.api.policy import ClusterPolicy

@@ -10,6 +10,11 @@ import json
 
 import pytest
 
+# the module-level key fixtures below do real ECDSA generation: skip
+# the whole suite (not fail collection) without the optional library
+pytest.importorskip("cryptography")
+pytestmark = pytest.mark.requires_crypto
+
 from kyverno_tpu.api.policy import ClusterPolicy
 from kyverno_tpu.engine.engine import Engine
 from kyverno_tpu.engine.policycontext import PolicyContext

@@ -351,12 +351,14 @@ class TestGoDurationFormat:
         assert format_go_duration(1_500_000_000) == "1.5s"
 
 
+@pytest.mark.requires_crypto
 def test_x509_decode_rsapss_hash_distinguished():
     """Go maps the hash-agnostic RSA-PSS OID to 13/14/15 by PSS hash
     params (x509.go signatureAlgorithmDetails); SHA384-PSS must decode
     as 14, not 13."""
     import datetime
 
+    pytest.importorskip("cryptography")
     from cryptography import x509 as cx
     from cryptography.hazmat.primitives import hashes, serialization
     from cryptography.hazmat.primitives.asymmetric import padding, rsa

@@ -241,6 +241,18 @@ def test_exposition_format_is_scrapeable():
     reg.analysis_anomalies.set(2, {"kind": "shadow"})
     reg.analysis_witnesses.set(46)
     reg.analysis_wall_seconds.set(0.5, {"phase": "evaluate"})
+    # fleet families (fleet/): membership, shard ownership, per-peer
+    # heartbeat/fetch outcomes, receive-verification rejects, gossip
+    reg.fleet_replicas.set(3)
+    reg.fleet_is_leader.set(1)
+    reg.fleet_epoch.set(4)
+    reg.fleet_shards_owned.set(21)
+    reg.fleet_shard_reassignments.inc({"reason": "membership"}, value=17)
+    reg.fleet_shard_staleness.set(2.5)
+    reg.fleet_heartbeats.inc({"peer": "r1", "outcome": "ok"})
+    reg.fleet_peer_fetch.inc({"peer": "r1", "outcome": "hit"})
+    reg.fleet_peer_rejects.inc({"reason": "checksum"})
+    reg.fleet_gossip.inc({"outcome": "sent"}, value=8)
 
     text = reg.exposition()
     # every new family is present (cardinality guard has its own test)
@@ -264,7 +276,15 @@ def test_exposition_format_is_scrapeable():
                 "kyverno_analysis_wall_seconds",
                 "kyverno_serving_class_queue_depth",
                 "kyverno_serving_class_requests_total",
-                "kyverno_serving_hedge_total"):
+                "kyverno_serving_hedge_total",
+                "kyverno_fleet_replicas", "kyverno_fleet_is_leader",
+                "kyverno_fleet_epoch", "kyverno_fleet_shards_owned",
+                "kyverno_fleet_shard_reassignments_total",
+                "kyverno_fleet_shard_staleness_seconds",
+                "kyverno_fleet_heartbeats_total",
+                "kyverno_fleet_peer_fetch_total",
+                "kyverno_fleet_peer_rejects_total",
+                "kyverno_fleet_gossip_total"):
         assert f"# TYPE {fam} " in text, fam
     # per-class SLO burn series render alongside the aggregate ones
     assert 'kyverno_slo_admission_burn_rate{class="bulk",window=' in text

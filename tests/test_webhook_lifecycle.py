@@ -7,6 +7,8 @@ import http.client
 import json
 import ssl
 
+import pytest
+
 from kyverno_tpu.api.policy import ClusterPolicy
 from kyverno_tpu.cluster import PolicyCache
 from kyverno_tpu.cluster.webhookconfig import (
@@ -106,7 +108,9 @@ def test_mutating_config_covers_mutate_and_verify_images():
 # TLS
 
 
+@pytest.mark.requires_crypto
 def test_cert_generation_and_renewal(tmp_path):
+    pytest.importorskip("cryptography")
     now = [datetime.datetime.now(datetime.timezone.utc)]
     r = CertRenewer(str(tmp_path), ["localhost"], clock=lambda: now[0],
                     cert_validity_s=100 * 24 * 3600)
@@ -122,10 +126,12 @@ def test_cert_generation_and_renewal(tmp_path):
     assert r.renewals == 2
 
 
+@pytest.mark.requires_crypto
 def test_cert_rotation_without_dropping_requests(tmp_path):
     """renewer.go:94: rolling the cert must not interrupt serving —
     requests succeed before and after the rotation, and the new
     handshake presents the new certificate."""
+    pytest.importorskip("cryptography")
     renewer = CertRenewer(str(tmp_path), ["127.0.0.1", "localhost"])
     renewer.renew_if_needed()
     cache = PolicyCache()

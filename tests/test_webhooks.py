@@ -153,10 +153,12 @@ def test_scalar_toggle_and_config_filter():
     handlers.batcher.stop()
 
 
+@pytest.mark.requires_crypto
 def test_mutate_runs_image_verification():
     """resource/handlers.go:139-177: the mutate path runs verify-image
     policies after mutate policies; digest patches ride the same
     JSONPatch response, and enforce failures deny."""
+    pytest.importorskip("cryptography")
     from kyverno_tpu.images import StaticRegistry
 
     from kyverno_tpu.images.crypto import generate_keypair
