@@ -1912,6 +1912,150 @@ def bench_fleet():
     return out
 
 
+# ---------------------------------------------------------------------------
+# batched mutation (ROADMAP item 3): a mutate-heavy admission mix where
+# ~95% of resources are triage-negative. The device triage decides who
+# needs patching; only the positives reach the host patcher. The
+# artifact carries triage throughput, the patch rate, a bit_identical
+# flag against the legacy scalar chain, and the untouched-resource
+# cost: an all-negative batch must cost ~one device dispatch and zero
+# patcher invocations.
+
+
+def bench_mutate(n_resources=None, tile=1024):
+    import copy
+
+    from kyverno_tpu.api.policy import ClusterPolicy
+    from kyverno_tpu.engine.engine import Engine as ScalarEngine
+    from kyverno_tpu.mutation.coordinator import apply_mutations
+    from kyverno_tpu.observability.metrics import global_registry as reg
+    from kyverno_tpu.tpu.compiler import compile_policy_set
+    from kyverno_tpu.tpu.engine import TpuEngine, build_scan_context
+    from kyverno_tpu.tpu.evaluator import ERROR, FAIL, HOST, PASS
+
+    if n_resources is None:
+        n_resources = int(os.environ.get("BENCH_MUTATE_RESOURCES", "4000"))
+    positive_every = max(int(os.environ.get("BENCH_MUTATE_NEG_RATIO", "20")),
+                         1)  # 1-in-20 positives = the 95%-negative mix
+
+    def _pol(name, rule_name, overlay):
+        return ClusterPolicy.from_dict({
+            "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+            "metadata": {"name": name},
+            "spec": {"validationFailureAction": "Enforce", "rules": [{
+                "name": rule_name,
+                "match": {"resources": {"kinds": ["Pod"],
+                                        "namespaces": ["prod"]}},
+                "mutate": {"patchStrategicMerge": overlay},
+            }]},
+        })
+
+    policies = [
+        _pol("stamp-labels", "labels",
+             {"metadata": {"labels": {"+(team)": "core", "env": "prod"}}}),
+        _pol("stamp-scheduling", "sched",
+             {"spec": {"priority": 100, "dnsPolicy": "ClusterFirst"}}),
+    ]
+    cps = compile_policy_set(policies)
+    eng = TpuEngine(cps=cps)
+    device_rows, total_rows = cps.mutate_coverage()
+    nsmap = {"prod": {}, "dev": {}}
+
+    def _mk_pod(i, ns):
+        return {"apiVersion": "v1", "kind": "Pod",
+                "metadata": {"name": f"bench-{ns}-{i}", "namespace": ns},
+                "spec": {"containers": [{"name": "c", "image": "nginx"}]}}
+
+    resources = [
+        _mk_pod(i, "prod" if i % positive_every == 0 else "dev")
+        for i in range(n_resources)]
+    tiles = [resources[i:i + tile] for i in range(0, n_resources, tile)]
+
+    eng.triage_mutate(tiles[0], nsmap)  # pay the XLA build outside timing
+    t0 = time.perf_counter()
+    results = [eng.triage_mutate(t, nsmap) for t in tiles]
+    t_triage = time.perf_counter() - t0
+
+    # route ONLY triage-positive (or host-rung) resources to the patcher
+    routed = []
+    row_totals = {"positive": 0, "negative": 0, "host": 0}
+    for t, res in zip(tiles, results):
+        c = res.counts()
+        for k in row_totals:
+            row_totals[k] += c[k]
+        for ci, r in enumerate(t):
+            rows = res.rows_for(ci)
+            if any(code in (PASS, FAIL, ERROR) or code >= HOST
+                   for _, code in rows):
+                routed.append((r, rows))
+    tmpl0 = reg.mutate_patches.value({"source": "template"})
+    scal0 = reg.mutate_patches.value({"source": "scalar"})
+    t0 = time.perf_counter()
+    patched_out = [apply_mutations(eng, r, rows, namespace_labels={},
+                                   registry=reg) for r, rows in routed]
+    t_patch = time.perf_counter() - t0
+    changed = sum(1 for o in patched_out if o.changed)
+
+    # bit identity vs the legacy per-policy scalar chain on a sample of
+    # positives (plus untouched negatives, which must come back as-is)
+    def _scalar_chain(resource):
+        seng = ScalarEngine()
+        patched = copy.deepcopy(resource)
+        for pol in policies:
+            pctx = build_scan_context(pol, patched, {}, "CREATE", None)
+            resp = seng.mutate(pctx)
+            if resp.patched_resource is not None:
+                patched = resp.patched_resource
+        return patched
+
+    sample = min(int(os.environ.get("BENCH_MUTATE_PARITY_SAMPLE", "64")),
+                 len(routed))
+    bit_identical = all(
+        patched_out[i].patched == _scalar_chain(routed[i][0])
+        for i in range(sample))
+    negatives = [r for r in resources[:200]
+                 if r["metadata"]["namespace"] == "dev"][:8]
+    bit_identical = bit_identical and all(
+        _scalar_chain(r) == r for r in negatives)
+
+    # untouched-resource cost: a fresh all-negative batch must cost one
+    # device dispatch and never reach the patcher
+    untouched = [_mk_pod(i, "dev") for i in range(10_000, 10_512)]
+    d0 = reg.mutate_triage.value({"outcome": "device"})
+    t0 = time.perf_counter()
+    ures = eng.triage_mutate(untouched, nsmap)
+    t_untouched = time.perf_counter() - t0
+    untouched_batches = reg.mutate_triage.value({"outcome": "device"}) - d0
+    uc = ures.counts()
+    assert untouched_batches <= 1, \
+        f"all-negative batch cost {untouched_batches} device dispatches"
+    assert uc["positive"] == 0 and uc["host"] == 0, uc
+
+    return {
+        "metric": "mutate_triage_throughput",
+        "value": round(n_resources / max(t_triage, 1e-9), 1),
+        "unit": "resources/sec",
+        "resources": n_resources,
+        "mutate_rules": total_rows,
+        "device_rows": device_rows,
+        "triage_seconds": round(t_triage, 3),
+        "triage_rows": row_totals,
+        "routed_to_patcher": len(routed),
+        "patched": changed,
+        "patch_seconds": round(t_patch, 4),
+        "patch_rate_per_sec": round(len(routed) / max(t_patch, 1e-9), 1),
+        "template_patches":
+            reg.mutate_patches.value({"source": "template"}) - tmpl0,
+        "scalar_patches":
+            reg.mutate_patches.value({"source": "scalar"}) - scal0,
+        "bit_identical": bool(bit_identical),
+        "parity_sample": sample + len(negatives),
+        "untouched_device_batches": untouched_batches,
+        "untouched_patcher_invocations": 0,
+        "untouched_seconds": round(t_untouched, 4),
+    }
+
+
 FNS = {
     "scan": lambda: bench_scan(),
     "match": lambda: bench_match(),
@@ -1927,6 +2071,7 @@ FNS = {
     "patterns": lambda: bench_patterns(),
     "analyze": lambda: bench_analyze(),
     "fleet": lambda: bench_fleet(),
+    "mutate": lambda: bench_mutate(),
 }
 
 
@@ -2159,7 +2304,7 @@ def run_all():
     emit(out)
     for name in ("match", "overlay", "apply", "admission", "mixed_traffic",
                  "fallback", "cached", "columnar", "encode_scaling",
-                 "patterns", "analyze", "churn", "fleet"):
+                 "patterns", "analyze", "churn", "mutate", "fleet"):
         if only and name not in only:
             continue
         t0 = time.perf_counter()
@@ -2247,6 +2392,8 @@ def main():
         config = "mixed_traffic"
     if config == "--columnar":  # flag spelling of the columnar config
         config = "columnar"
+    if config == "--mutate":  # flag spelling of the mutate config
+        config = "mutate"
     if config in ("capture", "--capture"):
         # replay a spooled flight capture as the admission workload:
         # `python bench.py --capture FILE` (kyverno-tpu flight-dump

@@ -45,6 +45,11 @@ def add_parser(sub: argparse._SubParsersAction) -> None:
     p.add_argument("--batching", action="store_true",
                    help="coalesce concurrent AdmissionReviews into padded "
                         "TPU batches (deadline-aware flush + shedding)")
+    p.add_argument("--mutate-batching", action="store_true",
+                   help="route the mutate webhook through a device-triaged "
+                        "serving pipeline (mutation/): batched needs-"
+                        "mutation triage, template-stamped patches, scalar "
+                        "fallback for everything else")
     p.add_argument("--max-batch-size", type=int, default=64,
                    help="flush when this many requests are queued")
     p.add_argument("--max-wait-ms", type=float, default=2.0,
@@ -274,6 +279,7 @@ class ControlPlane:
 
     def __init__(self, policies, port=0, metrics_port=0, cert=None, key=None,
                  configuration=None, toggles=None, batching=False,
+                 mutate_batching=False,
                  batch_config=None, request_timeout_s=10.0,
                  policy_watch=None, reload_interval=2.0,
                  flight_sample_rate=None, flight_capacity=None,
@@ -330,7 +336,8 @@ class ControlPlane:
             configuration=self.configuration, toggles=self.toggles,
             batching=batching, batch_config=batch_config,
             request_timeout_s=request_timeout_s,
-            classify_config=classify_config)
+            classify_config=classify_config,
+            mutate_batching=mutate_batching)
         # policy-set lifecycle: the compile-ahead worker owns recompiles
         # from here on (started in start()); webhook-config and VAP
         # reconciliation ride every cache mutation so hot-reloaded
@@ -684,6 +691,7 @@ def run(args: argparse.Namespace) -> int:
                       cert=args.cert, key=args.key,
                       configuration=configuration, toggles=toggles,
                       batching=args.batching, batch_config=batch_config,
+                      mutate_batching=args.mutate_batching,
                       request_timeout_s=args.request_timeout_s,
                       policy_watch=args.policy_watch,
                       reload_interval=args.reload_interval,

@@ -42,9 +42,11 @@ import time
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
-# outcomes captured regardless of the sample rate
+# outcomes captured regardless of the sample rate ("mutated" records
+# are the shadow verifier's only evidence that a template-stamped patch
+# matched the scalar oracle — they must never sample out)
 ALWAYS_CAPTURE = frozenset({"error", "fallback", "shed", "confirm",
-                            "expired", "hedged"})
+                            "expired", "hedged", "mutated"})
 
 OUTCOME_OK = "ok"
 OUTCOME_ERROR = "error"
@@ -58,6 +60,10 @@ OUTCOME_EXPIRED = "expired"
 # race always captures — bit-identity under racing is exactly the
 # claim the audit trail exists to witness
 OUTCOME_HEDGED = "hedged"
+# a batched-mutation decision: the record carries the patched body +
+# its sha next to the original, and the verifier diffs the PATCHED
+# output against a scalar re-patch (rows are routing, not verdicts)
+OUTCOME_MUTATED = "mutated"
 
 # verdict code mirror (tpu/evaluator.py order; this module must stay
 # importable without jax, like the rest of observability/)
@@ -98,6 +104,21 @@ def policyset_key(engine: Any) -> str:
     return key
 
 
+def patched_digest(doc: Optional[Dict[str, Any]]) -> Optional[str]:
+    """Content sha of a patched body — the SAME canonical hash the
+    verdict cache keys resources with, so the webhook's recorded
+    ``patched_sha`` and the verifier's scalar re-patch digest are
+    directly comparable."""
+    if doc is None:
+        return None
+    try:
+        from ..tpu.cache import resource_content_hash
+
+        return resource_content_hash(doc)
+    except Exception:
+        return None
+
+
 def _replica_id() -> Optional[str]:
     """This process's fleet replica id (None outside a fleet) — the
     per-record tag that attributes spooled decisions to a failure
@@ -118,7 +139,8 @@ class FlightRecord:
     __slots__ = ("kind", "seq", "ts", "trace_id", "outcome", "path",
                  "breaker", "revision", "ps_key", "resource",
                  "resource_sha", "namespace", "operation", "userinfo",
-                 "ns_labels", "verdicts", "timings", "engine")
+                 "ns_labels", "verdicts", "timings", "engine",
+                 "patched", "patched_sha")
 
     def __init__(self, kind: str, outcome: str, path: str,
                  resource: Optional[Dict[str, Any]],
@@ -130,7 +152,8 @@ class FlightRecord:
                  ns_labels: Optional[Dict[str, str]] = None,
                  timings: Optional[Dict[str, float]] = None,
                  engine: Any = None, ts: Optional[float] = None,
-                 seq: int = 0):
+                 seq: int = 0, patched: Optional[Dict[str, Any]] = None,
+                 patched_sha: Optional[str] = None):
         self.kind = kind
         self.seq = seq
         self.ts = time.time() if ts is None else ts
@@ -149,6 +172,8 @@ class FlightRecord:
         self.verdicts = verdicts
         self.timings = timings
         self.engine = engine
+        self.patched = patched
+        self.patched_sha = patched_sha
 
     def to_dict(self, body_cap: Optional[int] = None) -> Dict[str, Any]:
         doc: Dict[str, Any] = {
@@ -194,6 +219,20 @@ class FlightRecord:
                 doc["resource_truncated"] = True
                 if blob is not None:
                     doc["resource_bytes"] = len(blob)
+        if self.patched_sha is not None:
+            doc["patched_sha"] = self.patched_sha
+        if self.patched is not None:
+            try:
+                blob = json.dumps(self.patched, sort_keys=True,
+                                  separators=(",", ":"))
+            except (TypeError, ValueError):
+                blob = None
+            cap = self._body_cap() if body_cap is None else body_cap
+            if blob is not None and len(blob) <= cap:
+                doc["patched"] = self.patched
+            else:
+                doc["patched"] = None
+                doc["patched_truncated"] = True
         return doc
 
     @staticmethod
@@ -287,9 +326,14 @@ class FlightRecorder:
     @staticmethod
     def classify(rows: Optional[Sequence[Tuple[Tuple[str, str], int]]],
                  path: str, error: Optional[BaseException] = None,
-                 confirm: bool = False) -> str:
+                 confirm: bool = False, mutated: bool = False) -> str:
         """Outcome classification, most-interesting-wins: error >
-        shed/expired > fallback > confirm > cached > ok."""
+        shed/expired > mutated > hedged > fallback > confirm > cached >
+        ok. ``mutated`` outranks the path-derived classes so every
+        successful mutate decision — including ``hedged_mutate`` and
+        ``cached_mutate`` paths — lands in the mutate outcome class the
+        verifier's patched-output diff selects on (the path string
+        still says HOW it resolved)."""
         if error is not None:
             from ..serving.queue import DeadlineExceededError
 
@@ -300,6 +344,8 @@ class FlightRecorder:
             return OUTCOME_ERROR
         if path == "shed":
             return OUTCOME_SHED
+        if mutated:
+            return OUTCOME_MUTATED
         if path.startswith("hedged"):
             return OUTCOME_HEDGED
         if path in ("scalar_fallback", "pure_scalar"):
@@ -370,7 +416,9 @@ class FlightRecorder:
                          timings: Optional[Dict[str, float]] = None,
                          confirm: bool = False,
                          kind: str = "admission",
-                         outcome: Optional[str] = None
+                         outcome: Optional[str] = None,
+                         patched: Optional[Dict[str, Any]] = None,
+                         patched_sha: Optional[str] = None
                          ) -> Optional[FlightRecord]:
         """Classify + sample + build + append one admission (or scan)
         record. All the potentially-expensive derivations (sha, policy-
@@ -380,9 +428,18 @@ class FlightRecorder:
         too) passes the decided ``outcome`` — sampling is not re-run."""
         if outcome is None:
             outcome = self.classify(rows, path, error=error,
-                                    confirm=confirm)
+                                    confirm=confirm,
+                                    mutated=kind == "mutate")
             if not self.should_capture(outcome):
                 return None
+        # every mutate capture path must label its records: a mutate
+        # record classified into a validate-shaped class (ok/cached/
+        # fallback/...) would silently fall out of the verifier's
+        # patched-output diff. Failure classes are the only exceptions
+        # — there is no patched output to diff.
+        assert kind != "mutate" or outcome in (
+            OUTCOME_MUTATED, OUTCOME_ERROR, OUTCOME_EXPIRED,
+            OUTCOME_SHED), f"unlabeled mutate record: {outcome!r}"
         sha = None
         if resource is not None:
             try:
@@ -397,13 +454,16 @@ class FlightRecorder:
             breaker = tpu_breaker().state
         except Exception:
             breaker = ""
+        if patched is not None and patched_sha is None:
+            patched_sha = patched_digest(patched)
         rec = FlightRecord(
             kind=kind, outcome=outcome, path=path, resource=resource,
             verdicts=list(rows) if rows is not None else None,
             trace_id=trace_id, breaker=breaker, revision=revision,
             ps_key=policyset_key(engine), resource_sha=sha,
             namespace=namespace, operation=operation, userinfo=userinfo,
-            ns_labels=ns_labels, timings=timings, engine=engine)
+            ns_labels=ns_labels, timings=timings, engine=engine,
+            patched=patched, patched_sha=patched_sha)
         return self.record(rec)
 
     def record_scan_chunk(self, chunk, result, engine: Any = None,

@@ -473,6 +473,31 @@ class MetricsRegistry:
             "kyverno_fleet_gossip_total",
             "async verdict-column gossip by outcome "
             "(sent/received/error/dropped)")
+        # batched mutation (mutation/): device triage over the compiled
+        # mutate bank, patch application by source, degradation-ladder
+        # fallbacks, and shadow-verification divergence — the mutate
+        # mirror of the validate serving instruments
+        self.mutate_triage = self.counter(
+            "kyverno_mutate_triage_total",
+            "needs-mutation triage batches by outcome "
+            "(device/fallback/cached)")
+        self.mutate_triage_rows = self.counter(
+            "kyverno_mutate_triage_rows_total",
+            "triage (rule, resource) cells by result "
+            "(positive/negative/host)")
+        self.mutate_patches = self.counter(
+            "kyverno_mutate_patches_total",
+            "mutate patch applications by source (template/scalar)")
+        self.mutate_patch_fallbacks = self.counter(
+            "kyverno_mutate_patch_fallbacks_total",
+            "template-stamp passes degraded to the scalar patcher")
+        self.mutate_divergence = self.counter(
+            "kyverno_mutate_divergence_total",
+            "shadow-verified mutate records whose patched output "
+            "differed from the scalar oracle's")
+        self.mutate_duration = self.histogram(
+            "kyverno_mutate_duration_seconds",
+            "batched mutate handling latency (triage + patch)")
         # resilience layer (resilience/): breaker state machine, scalar
         # fallback routing, retry outcomes, injected faults
         self.breaker_state = self.gauge(

@@ -88,6 +88,28 @@ def scalar_rows(engine: Any, resource: Dict[str, Any],
     return rows
 
 
+def scalar_patched(engine: Any, resource: Dict[str, Any],
+                   ns_labels: Optional[Dict[str, str]], operation: str,
+                   info: Any = None) -> Dict[str, Any]:
+    """The full scalar mutate chain — every policy in compiled-bank
+    order through ``Engine.mutate``, patched output feeding the next
+    policy — the patched-output oracle for mutate records."""
+    import copy
+
+    from ..tpu.engine import build_scan_context
+
+    patched = copy.deepcopy(resource)
+    for policy in engine.cps.policies:
+        if not any(r.has_mutate() for r in policy.get_rules()):
+            continue
+        pctx = build_scan_context(policy, patched, ns_labels or {},
+                                  operation, info)
+        resp = engine.scalar.mutate(pctx)
+        if resp.patched_resource is not None:
+            patched = resp.patched_resource
+    return patched
+
+
 class ShadowVerifier:
     """Sampled oracle re-evaluation of flight records.
 
@@ -266,6 +288,9 @@ class ShadowVerifier:
             self._bump("skipped_no_engine")
             self._count_check("skipped_no_engine")
             return
+        if rec.kind == "mutate":
+            self._verify_mutate(rec, engine)
+            return
         try:
             eligible = bool(engine.cache_eligible)
         except Exception:
@@ -322,6 +347,79 @@ class ShadowVerifier:
                 record_trace_id=rec.trace_id or None,
                 resource_sha=rec.resource_sha, path=rec.path,
                 policyset_revision=rec.revision, cells=diff_cells)
+        except Exception:
+            pass
+
+    def _verify_mutate(self, rec: FlightRecord, engine: Any) -> None:
+        """Mutate records diff the PATCHED OUTPUT, not the triage rows:
+        HOST rows are routing, and the all-HOST fallback column is
+        correct by construction (everything scalar-patches) — a row
+        diff would false-alarm on every degraded batch. The claim under
+        audit is bit-identity of the served patched body against a full
+        scalar re-patch at the pinned revision."""
+        try:
+            eligible = bool(engine.mutate_cache_eligible)
+        except Exception:
+            eligible = False
+        if not eligible:
+            # a mutate rule with live context can legitimately patch
+            # differently on replay — visible blind spot, not an alarm
+            self._bump("skipped_impure")
+            self._count_check("skipped_impure")
+            return
+        try:
+            expected = scalar_patched(engine, rec.resource,
+                                      rec.ns_labels, rec.operation,
+                                      info_from_dict(rec.userinfo))
+        except Exception:
+            self._bump("errors")
+            self._count_check("error")
+            return
+        from .flightrecorder import patched_digest
+
+        got = rec.patched if rec.patched is not None else rec.resource
+        got_sha = rec.patched_sha or patched_digest(got)
+        diverged = got != expected \
+            or got_sha != patched_digest(expected)
+        self._bump("checked")
+        try:
+            from .analytics import global_slo
+
+            global_slo.record_verification(diverged)
+        except Exception:
+            pass
+        if not diverged:
+            self._bump("matched")
+            self._count_check("match")
+            return
+        self._bump("divergences")
+        self._count_check("diverge")
+        try:
+            reg = self._registry()
+            reg.mutate_divergence.inc(
+                exemplar=({"trace_id": rec.trace_id}
+                          if rec.trace_id else None))
+            reg.verification_divergence.inc(
+                exemplar=({"trace_id": rec.trace_id}
+                          if rec.trace_id else None))
+        except Exception:
+            pass
+        try:
+            doc = rec.to_dict()
+            doc["expected_patched"] = expected
+            global_flight.spool_divergence(doc, [], list(rec.verdicts))
+        except Exception:
+            pass
+        try:
+            from .log import global_oplog
+
+            global_oplog.emit(
+                "mutate_divergence", level="error",
+                record_trace_id=rec.trace_id or None,
+                resource_sha=rec.resource_sha, path=rec.path,
+                policyset_revision=rec.revision,
+                patched_sha=got_sha,
+                expected_sha=patched_digest(expected))
         except Exception:
             pass
 

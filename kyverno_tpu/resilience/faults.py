@@ -26,6 +26,13 @@ the failure physically happens:
                         (fleet/peering.py) — degrades to local compute
     fleet.gossip        the async push of freshly computed verdict
                         columns to peers (fleet/manager.py)
+    mutate.triage       the needs-mutation device batch over the
+                        compiled mutate bank (tpu/engine.py) — a raise
+                        degrades every row to HOST, routing the whole
+                        batch to the scalar patcher bit-identically
+    mutate.patch        a policy's template-stamp pass in the mutation
+                        coordinator (mutation/coordinator.py) — a raise
+                        falls that policy back to the scalar patcher
 
 Tests (and the ``KYVERNO_TPU_FAULTS`` env knob) arm a site with a
 probability- or count-based trigger and a mode — ``raise``, ``delay``,
@@ -78,12 +85,15 @@ SITE_ENCODE_WORKER = "encode.worker"
 SITE_FLEET_HEARTBEAT = "fleet.heartbeat"
 SITE_FLEET_PEER_FETCH = "fleet.peer_fetch"
 SITE_FLEET_GOSSIP = "fleet.gossip"
+SITE_MUTATE_TRIAGE = "mutate.triage"
+SITE_MUTATE_PATCH = "mutate.patch"
 
 KNOWN_SITES = frozenset({
     SITE_TPU_DISPATCH, SITE_CONTEXT_API_CALL, SITE_CONTEXT_IMAGE_DATA,
     SITE_GCTX_REFRESH, SITE_SERVING_FLUSH, SITE_SERVING_HEDGE,
     SITE_POLICYSET_COMPILE, SITE_ENCODE_POOL_DISPATCH, SITE_ENCODE_WORKER,
     SITE_FLEET_HEARTBEAT, SITE_FLEET_PEER_FETCH, SITE_FLEET_GOSSIP,
+    SITE_MUTATE_TRIAGE, SITE_MUTATE_PATCH,
 })
 
 MODES = ("raise", "delay", "corrupt", "crash")

@@ -39,6 +39,11 @@ from .ir import (
     compile_rule,
 )
 from .metadata import MetaConfig
+# the mutation package only pulls api/engine-level modules — no cycle
+from ..mutation.lowering import (PatchTemplate, lower_mutate_rule,
+                                 paths_conflict, rule_read_paths,
+                                 rule_write_paths)
+from ..mutation.triage import triage_rule
 
 
 def _iter_cond_irs(prog: RuleProgram):
@@ -178,7 +183,15 @@ class CompiledPolicySet:
     # glob/regex operand as one DFA in a packed bank, evaluated by the
     # device program in one scan per byte-lane family
     dfa: Optional[DfaBank] = None
+    # mutate-rule bank (mutation/): one RuleEntry per mutate rule in
+    # policy order, device_row indexing mutate_programs (the compiled
+    # needs-mutation triage predicates), with a parallel list of
+    # lowered patch templates (None = scalar patcher when positive)
+    mutate_entries: List[RuleEntry] = field(default_factory=list)
+    mutate_programs: List[RuleProgram] = field(default_factory=list)
+    mutate_templates: List[Optional[PatchTemplate]] = field(default_factory=list)
     _fn: Optional[Callable] = field(default=None, repr=False)
+    _mutate_fn: Optional[Callable] = field(default=None, repr=False)
     _cache_key: Optional[str] = field(default=None, repr=False)
     _policy_spec_hashes: Optional[List[str]] = field(default=None, repr=False)
 
@@ -212,6 +225,36 @@ class CompiledPolicySet:
         else:
             global_registry.compile_cache.inc({"outcome": "hit"})
         return self._fn
+
+    @property
+    def mutate_rules(self) -> List[Tuple[str, str]]:
+        """Bank-ordered (policy_name, rule_name) idents — the row
+        identity shared by triage verdicts, templates, and the
+        coordinator."""
+        return [(e.policy_name, e.rule_name) for e in self.mutate_entries]
+
+    def mutate_device_fn(self) -> Callable:
+        """The jitted triage batch program over the mutate bank — same
+        shape contract as device_fn minus the analytics counts (triage
+        rows feed routing, not rule stats)."""
+        from ..observability.profiling import PHASE_COMPILE, global_profiler
+        from ..observability.tracing import global_tracer
+
+        if self._mutate_fn is None:
+            with global_profiler.phase(PHASE_COMPILE), \
+                    global_tracer.span("xla_jit_build_mutate",
+                                       programs=len(self.mutate_programs)):
+                self._mutate_fn = jax.jit(
+                    build_program(self.mutate_programs,
+                                  self.encode_cfg.max_instances,
+                                  with_counts=False, dfa=self.dfa)
+                )
+        return self._mutate_fn
+
+    def mutate_coverage(self) -> Tuple[int, int]:
+        dev = sum(1 for e in self.mutate_entries
+                  if e.device_row is not None)
+        return dev, len(self.mutate_entries)
 
     def policy_spec_hashes(self) -> List[str]:
         """Per-policy analytics identity (spec-content hash), memoized
@@ -349,6 +392,71 @@ def _compile_policy_set(
                 entries.append(RuleEntry(pi, policy.name, rule.name, row, None))
             except Unsupported as e:
                 entries.append(RuleEntry(pi, policy.name, rule.name, None, str(e)))
+    # mutate-rule bank: the same lowering ladder for needs-mutation
+    # triage predicates. Pass 1 walks policy order demoting
+    # chain-dependent predicates to host (an earlier mutate rule may
+    # write a path this rule's predicate reads; the scalar chain
+    # evaluates against patched-so-far, device triage against the
+    # ORIGINAL — triaging such a rule on device would be unsound).
+    # Pass 2 compiles the survivors through the same IR path as
+    # validate, sharing the DFA bank and byte-path planning.
+    mutate_entries: List[RuleEntry] = []
+    mutate_programs: List[RuleProgram] = []
+    mutate_templates: List[Optional[PatchTemplate]] = []
+    collected: List[Tuple[int, ClusterPolicy, Rule, bool]] = []
+    writes_so_far: List = []
+    for pi, policy in enumerate(policies):
+        for rule in policy.get_rules():
+            if not rule.has_mutate():
+                continue
+            reads = rule_read_paths(rule)
+            conflict = any(paths_conflict(w, reads) for w in writes_so_far)
+            collected.append((pi, policy, rule, conflict))
+            # demoted rules still WRITE — later predicates must see them
+            writes_so_far.append(rule_write_paths(rule))
+    for pi, policy, rule, conflict in collected:
+        tmpl = lower_mutate_rule(rule)
+        if tmpl is not None:
+            tmpl.policy_name = policy.name
+        mutate_templates.append(tmpl)
+        q_err = quarantine.get(pi)
+        if q_err is not None:
+            mutate_entries.append(RuleEntry(pi, policy.name, rule.name, None,
+                                            f"quarantined: {q_err}"))
+            continue
+        if conflict:
+            mutate_entries.append(RuleEntry(
+                pi, policy.name, rule.name, None,
+                "chain-dependent: an earlier mutate rule may write a "
+                "path this rule's predicate reads"))
+            continue
+        try:
+            prog = compile_rule(policy, triage_rule(rule),
+                                data_sources, deps)
+            if prog.dyn_slots:
+                # triage must not push operand slots into the shared
+                # slot table — that would flip the validate bank's
+                # cache eligibility. Host-route instead.
+                raise Unsupported("context: dynamic operand slots")
+            try:
+                prog.uses_patterns = _register_program_patterns(bank, prog)
+            except DfaUnsupported as e:
+                raise Unsupported(f"pattern: {e}")
+            row = len(mutate_programs)
+            mutate_programs.append(prog)
+            byte_paths |= prog.byte_paths
+            key_byte_paths |= prog.key_byte_paths
+            mutate_entries.append(RuleEntry(pi, policy.name, rule.name,
+                                            row, None))
+        except Unsupported as e:
+            mutate_entries.append(RuleEntry(pi, policy.name, rule.name,
+                                            None, str(e)))
+        except Exception as e:  # noqa: BLE001 — a triage compile crash
+            # must never fail a policy set that compiled before this
+            # bank existed; the rule degrades to host triage
+            mutate_entries.append(RuleEntry(pi, policy.name, rule.name,
+                                            None,
+                                            f"triage compile error: {e}"))
     # dense (un-pruned) encodes only pay for label byte lanes when some
     # compiled selector actually globs. The flag lives on a COPY: the
     # caller's MetaConfig may be shared across compiles, and a later
@@ -358,7 +466,7 @@ def _compile_policy_set(
     meta_cfg = _copy.copy(meta_cfg)
     meta_cfg.label_bytes_enabled = any(
         getattr(sel, "wild_labels", None)
-        for prog in programs
+        for prog in programs + mutate_programs
         for block in (prog.match, prog.exclude) if block is not None
         for f in block.filters
         for sel in (f.selector, f.ns_selector) if sel is not None)
@@ -380,4 +488,7 @@ def _compile_policy_set(
         dyn_slots=dyn_slots,
         quarantined=quarantine,
         dfa=bank,
+        mutate_entries=mutate_entries,
+        mutate_programs=mutate_programs,
+        mutate_templates=mutate_templates,
     )

@@ -40,7 +40,8 @@ from ..observability.profiling import (PATH_DEVICE, PATH_SCALAR_FALLBACK,
                                        set_dispatch_path)
 from ..observability.tracing import global_tracer
 from ..devtools import sanitizer as _sanitizer
-from ..resilience.faults import SITE_TPU_DISPATCH, global_faults
+from ..resilience.faults import (SITE_MUTATE_TRIAGE, SITE_TPU_DISPATCH,
+                                 global_faults)
 from .compiler import CompiledPolicySet, compile_policy_set
 from .evaluator import (CONFIRM, ERROR, FAIL, HOST, NOT_MATCHED, PASS, SKIP,
                         batch_to_host)
@@ -127,6 +128,30 @@ class ScanResult:
         return list(zip(rows.tolist(), cols.tolist()))
 
 
+@dataclass
+class MutateTriageResult:
+    """(num_mutate_rules, N) needs-mutation verdict table in compiled
+    bank order. PASS/FAIL = rule applies; SKIP/NOT_MATCHED = it does
+    not; ERROR/HOST/CONFIRM = undecidable on device, the coordinator
+    routes the policy to the scalar patcher."""
+
+    verdicts: np.ndarray
+    rules: List[Tuple[str, str]]  # (policy_name, rule_name) per row
+
+    def rows_for(self, ci: int) -> List[Tuple[Tuple[str, str], int]]:
+        """One resource's bank-ordered ((policy, rule), code) rows —
+        the coordinator's input shape."""
+        return [(ident, int(self.verdicts[mi, ci]))
+                for mi, ident in enumerate(self.rules)]
+
+    def counts(self) -> Dict[str, int]:
+        pos = int(((self.verdicts == PASS) | (self.verdicts == FAIL)).sum())
+        neg = int(((self.verdicts == SKIP)
+                   | (self.verdicts == NOT_MATCHED)).sum())
+        return {"positive": pos, "negative": neg,
+                "host": int(self.verdicts.size) - pos - neg}
+
+
 def _scalar_rule_verdicts(
     engine: ScalarEngine, policy: ClusterPolicy, pctx: PolicyContext
 ) -> Dict[str, int]:
@@ -189,6 +214,9 @@ class TpuEngine:
         # exception's match/conditions are per-resource dynamic state
         # the compiled program does not model (engine/exceptions.go)
         self._exception_rules: set = set()
+        # same host routing for the mutate triage bank: an excepted
+        # mutate rule's apply decision is per-resource dynamic state
+        self._exception_mutate_rules: set = set()
         if exceptions:
             from ..api.exception import PolicyException
 
@@ -198,6 +226,10 @@ class TpuEngine:
                 if any(t.contains(entry.policy_name, entry.rule_name)
                        for t in typed):
                     self._exception_rules.add(ri)
+            for mi, entry in enumerate(self.cps.mutate_entries):
+                if any(t.contains(entry.policy_name, entry.rule_name)
+                       for t in typed):
+                    self._exception_mutate_rules.add(mi)
         # verdict-cache identity (tpu/cache.py): exceptions change
         # verdicts without changing the compiled set, so they join the
         # policy-set content key
@@ -208,6 +240,7 @@ class TpuEngine:
              for e in exceptions]) if exceptions else ""
         self._cache_ident: Optional[str] = None
         self._cache_eligible: Optional[bool] = None
+        self._mutate_cache_eligible: Optional[bool] = None
         self._encode_cache_key: Optional[str] = None
         # encoder-pool profile for the rows feed, registered lazily per
         # pool instance (a reconfigured pool gets a fresh profile)
@@ -1226,7 +1259,181 @@ class TpuEngine:
         global_rule_stats.ingest_counts(self.rule_idents(), counts,
                                         source=source)
 
+    # -- mutate triage (mutation/): which resources need the patcher?
+
+    @property
+    def mutate_cache_eligible(self) -> bool:
+        """Mutate-side purity: no host-routed or excepted mutate rule
+        carries context entries (the scalar patcher would load them
+        live per request, so a replay — cached triage rows feeding a
+        shadow-verification re-patch — could observe different state).
+        Device-compiled triage rules are pure by construction: dyn-slot
+        programs are refused at compile and folded context hashes are
+        part of the policy-set key."""
+        if self._mutate_cache_eligible is None:
+            eligible = True
+            for mi, entry in enumerate(self.cps.mutate_entries):
+                if (entry.device_row is not None
+                        and mi not in self._exception_mutate_rules):
+                    continue
+                policy = self.cps.policies[entry.policy_idx]
+                for rule in policy.get_rules():
+                    if rule.name == entry.rule_name and rule.context:
+                        eligible = False
+            self._mutate_cache_eligible = eligible
+        return self._mutate_cache_eligible
+
+    def mutate_triage_cache_keys(
+        self,
+        resources: Sequence[Dict[str, Any]],
+        namespace_labels: Optional[Dict[str, Dict[str, str]]] = None,
+        operations: Optional[Sequence[str]] = None,
+        admission_infos: Optional[Sequence[Optional[RequestInfo]]] = None,
+    ) -> Optional[List[Optional[Tuple[str, str, str]]]]:
+        """Verdict-cache keys for triage rows: the validate keys with a
+        namespaced ident, so an (M,) triage column and an (R,) validate
+        column for the same (resource, request) can never collide."""
+        if not self.mutate_cache_eligible:
+            return None
+        keys = self.verdict_cache_keys(resources, namespace_labels,
+                                       operations, admission_infos)
+        if keys is None:
+            return None
+        return [None if k is None else ("mutate|" + k[0], k[1], k[2])
+                for k in keys]
+
+    def triage_mutate(
+        self,
+        resources: Sequence[Dict[str, Any]],
+        namespace_labels: Optional[Dict[str, Dict[str, str]]] = None,
+        operations: Optional[Sequence[str]] = None,
+        admission_infos: Optional[Sequence[Optional[RequestInfo]]] = None,
+    ) -> MutateTriageResult:
+        """Needs-mutation triage over the compiled mutate bank — the
+        same cached ladder as scan(): verdict-cache columns for warm
+        (resource, request) pairs, guarded dispatch for the misses,
+        all-HOST degradation (everything scalar-patches) on any
+        failure."""
+        from ..observability.metrics import global_registry as reg
+        from .cache import global_verdict_cache as vc
+
+        rules = self.cps.mutate_rules
+        m, n = len(rules), len(resources)
+        if m == 0 or n == 0:
+            return MutateTriageResult(
+                np.zeros((m, n), dtype=np.int32), rules)
+        keys = (self.mutate_triage_cache_keys(
+                    resources, namespace_labels, operations,
+                    admission_infos)
+                if vc.enabled else None)
+        if keys is None:
+            return self._triage_uncached(resources, namespace_labels,
+                                         operations, admission_infos)
+        total = np.full((m, n), HOST, dtype=np.int32)
+        miss: List[int] = []
+        hits = 0
+        for i, key in enumerate(keys):
+            col = (vc.get(key, expect_rows=m)
+                   if key is not None else None)
+            if col is None:
+                miss.append(i)
+            else:
+                hits += 1
+                total[:, i] = col
+        if hits:
+            reg.mutate_triage.inc({"outcome": "cached"}, hits)
+        if miss:
+            sub = self._triage_uncached(
+                [resources[i] for i in miss], namespace_labels,
+                [operations[i] for i in miss] if operations else None,
+                [admission_infos[i] for i in miss] if admission_infos
+                else None)
+            for j, i in enumerate(miss):
+                total[:, i] = sub.verdicts[:, j]
+                if keys[i] is not None:
+                    vc.put(keys[i], sub.verdicts[:, j])
+        return MutateTriageResult(verdicts=total, rules=rules)
+
+    def _triage_uncached(
+        self,
+        resources: Sequence[Dict[str, Any]],
+        namespace_labels: Optional[Dict[str, Dict[str, str]]] = None,
+        operations: Optional[Sequence[str]] = None,
+        admission_infos: Optional[Sequence[Optional[RequestInfo]]] = None,
+    ) -> MutateTriageResult:
+        """One device batch over the mutate bank. Host rows (bank host
+        entries, excepted rules, userinfo globs) stay HOST; encode or
+        dispatch failure degrades the whole batch to HOST — the
+        coordinator then scalar-patches everything, bit-identically."""
+        from ..observability.metrics import global_registry as reg
+
+        rules = self.cps.mutate_rules
+        m, n = len(rules), len(resources)
+        total = np.full((m, n), HOST, dtype=np.int32)
+        d = len(self.cps.mutate_programs)
+        device_table = None
+        if d:
+            padded_n = self.bucket_size(max(n, 1))
+            padded = list(resources) + [{} for _ in range(padded_n - n)]
+            ops = (list(operations) + [""] * (padded_n - n)) \
+                if operations else None
+            infos = (list(admission_infos) + [None] * (padded_n - n)) \
+                if admission_infos else None
+            try:
+                with global_profiler.phase(PHASE_ENCODE), \
+                        global_tracer.span("tpu.encode_triage",
+                                           resources=n, padded=padded_n):
+                    batch, _, _ = self.encode(padded, namespace_labels,
+                                              ops, infos)
+            except Exception:  # hostile resource: everything scalar
+                batch = None
+            if batch is not None:
+                def run():
+                    import jax
+
+                    global_faults.fire(SITE_MUTATE_TRIAGE)
+                    with maybe_xla_trace():
+                        with global_profiler.phase(PHASE_DISPATCH):
+                            out = self.cps.mutate_device_fn()(
+                                jax.device_put(batch))
+                        with global_profiler.phase(PHASE_READBACK):
+                            return np.asarray(out)
+
+                device_table = self.guarded_dispatch(run, (d, padded_n))
+        if device_table is not None:
+            glob_cis: List[int] = []
+            if admission_infos:
+                from ..utils.wildcard import contains_wildcard
+
+                for ci in range(n):
+                    info = (admission_infos[ci]
+                            if ci < len(admission_infos) else None)
+                    if info is not None and any(
+                            contains_wildcard(g)
+                            for g in (info.groups or [])):
+                        glob_cis.append(ci)
+            for mi, entry in enumerate(self.cps.mutate_entries):
+                if (entry.device_row is None
+                        or mi in self._exception_mutate_rules):
+                    continue  # stays HOST
+                row = device_table[entry.device_row, :n].copy()
+                if glob_cis and self.cps.mutate_programs[
+                        entry.device_row].uses_userinfo:
+                    row[glob_cis] = HOST
+                total[mi] = row
+            reg.mutate_triage.inc({"outcome": "device"})
+        else:
+            reg.mutate_triage.inc({"outcome": "fallback"})
+        result = MutateTriageResult(verdicts=total, rules=rules)
+        for label, count in result.counts().items():
+            if count:
+                reg.mutate_triage_rows.inc({"result": label}, count)
+        return result
+
     # -- introspection
 
     def coverage(self) -> Tuple[int, int]:
         return self.cps.coverage()
+
+    def mutate_coverage(self) -> Tuple[int, int]:
+        return self.cps.mutate_coverage()

@@ -11,6 +11,7 @@ path suffix (/validate/ignore vs /validate/fail, server.go:296).
 from __future__ import annotations
 
 import base64
+import dataclasses
 import json
 import ssl
 import threading
@@ -34,7 +35,7 @@ from ..serving import (AdmissionPipeline, BatchConfig, ClassifyConfig,
                        classify_request, resource_verdicts)
 from ..tpu.engine import (TpuEngine, VERDICT_NAMES, _scalar_rule_verdicts,
                           build_scan_context)
-from ..tpu.evaluator import ERROR, FAIL, NOT_MATCHED
+from ..tpu.evaluator import ERROR, FAIL, HOST, NOT_MATCHED
 from ..utils.jsonpatch import diff as jsonpatch_diff
 from .batcher import MicroBatcher
 
@@ -91,6 +92,7 @@ class Handlers:
         batch_config: Optional[BatchConfig] = None,
         request_timeout_s: float = 10.0,
         classify_config: Optional[ClassifyConfig] = None,
+        mutate_batching: bool = False,
     ) -> None:
         self.cache = cache
         self.snapshot = snapshot
@@ -163,6 +165,48 @@ class Handlers:
                 # the flush it races, so the race is bit-identical
                 # even while a hot swap lands mid-flight
                 hedge_fn=self._scalar_verdict_rows)
+        # --mutate-batching: a SECOND serving pipeline fronting the
+        # mutate workload, whose evaluator is the compiled needs-
+        # mutation triage (tpu.engine.triage_mutate) instead of the
+        # validate scan. Only triage-positive resources reach the host
+        # patcher (mutation/coordinator.py); every degradation rung —
+        # shed-to-scalar, hedge, breaker fallback, no compiled version
+        # — produces all-HOST rows that route EVERY mutate policy to
+        # the scalar patcher: bit-identical output, just without the
+        # device shortcut.
+        self.mutate_pipeline: Optional[AdmissionPipeline] = None
+        # triage-path stash: the mutate pipeline's flight hook runs on
+        # the flusher thread and is the only place that knows HOW a
+        # request resolved (batched / cached / hedged_* / shed-to-
+        # scalar); mutate() needs that label on the REQUEST thread for
+        # its post-patch record. AdmissionPayload has __slots__, so the
+        # hook parks (path, trace_id) here keyed by payload identity
+        # and the request thread pops it.
+        self._mutate_paths: Dict[int, Tuple[str, str]] = {}  # guarded-by: _mutate_paths_lock
+        self._mutate_paths_lock = threading.Lock()
+        if mutate_batching:
+            # mirror the validate pipeline's operator-tuned knobs but
+            # never share the config OBJECT — a shared instance would
+            # couple the two queues' reserves and buckets
+            mcfg = dataclasses.replace(batch_config) if batch_config \
+                else BatchConfig(max_batch_size=max_batch,
+                                 max_wait_ms=max_wait_ms)
+            mcfg.min_bucket = TpuEngine.MIN_BUCKET
+            if (not self.classify_config.critical_users
+                    and not self.classify_config.trust_annotation_critical):
+                mcfg.critical_reserve = 0.0
+            self.mutate_pipeline = AdmissionPipeline(
+                self._triage_padded,
+                scalar_fallback=self._host_triage_rows,
+                config=mcfg,
+                metrics=self.metrics,
+                version_provider=self._pin_version,
+                cache_lookup=self._cached_triage_rows,
+                flight_hook=self._mutate_flight_hook,
+                # an all-HOST hedge is always safe to race the device
+                # triage: HOST only widens the scalar-patched set, and
+                # the scalar patcher is the bit-identity oracle
+                hedge_fn=self._host_triage_rows)
 
     # -- versioned engine acquisition (lifecycle/manager.py)
 
@@ -361,6 +405,142 @@ class Handlers:
         except Exception:
             pass
         return VerdictRows(rows, revision=rev)
+
+    # -- batched mutation (mutation/): triage evaluator + rungs
+
+    def _triage_padded(self, payloads: List[Optional[AdmissionPayload]],
+                       pinned: Optional[PolicySetVersion] = None):
+        """Mutate-pipeline batch evaluator: ONE device cross-product of
+        the compiled needs-mutation predicates over the whole flush
+        (pad slots encode as empty resources — the same shape-bucket
+        contract as _evaluate_padded). Every degradation — scalar
+        toggle, no compiled version, breaker/dispatch failure inside
+        triage_mutate — yields all-HOST rows; the coordinator then
+        scalar-patches everything, so degraded and device paths stay
+        bit-identical."""
+        pad = AdmissionPayload({}, "", RequestInfo(), "")
+        real_n = sum(1 for p in payloads if p is not None)
+        filled = [p if p is not None else pad for p in payloads]
+        t0 = time.perf_counter()
+        if pinned is None:
+            try:
+                pinned = self.lifecycle.acquire()
+            except PolicySetUnavailable:
+                pinned = None
+        try:
+            self._flight_tls.nsmap = (self.snapshot.namespace_labels()
+                                      if self.snapshot else {})
+        except Exception:
+            self._flight_tls.nsmap = {}
+        if self.toggles.engine == "scalar" or pinned is None:
+            return [self._host_triage_rows(p, version=pinned)
+                    for p in filled[:real_n]]
+        eng = pinned.engine
+        result = eng.triage_mutate(
+            [p.resource for p in filled], self._flight_tls.nsmap,
+            operations=[p.operation for p in filled],
+            admission_infos=[p.info for p in filled])
+        self.metrics.device_dispatch.observe(time.perf_counter() - t0,
+                                             {"engine": "tpu_mutate"})
+        self.metrics.batch_size.observe(real_n)
+        return [VerdictRows(result.rows_for(ci), version=pinned)
+                for ci in range(real_n)]
+
+    def _host_triage_rows(self, payload: AdmissionPayload,
+                          version: Optional[PolicySetVersion] = None):
+        """All-HOST triage rows — the mutate pipeline's shed / hedge /
+        no-device rung. Routing every mutate policy to the scalar
+        patcher is always CORRECT (device triage is only a skip
+        shortcut), so the deepest mutate rung costs throughput, never
+        fidelity. With no compiled version at all there is no mutate
+        bank either: the rows come back empty and versionless, and
+        mutate() takes the legacy per-policy host loop."""
+        if version is None:
+            try:
+                version = self.lifecycle.acquire()
+            except PolicySetUnavailable:
+                version = None
+        if version is None:
+            rev, _ = self.cache.snapshot()
+            return VerdictRows([], revision=rev)
+        return VerdictRows(
+            [((e.policy_name, e.rule_name), HOST)
+             for e in version.engine.cps.mutate_entries],
+            version=version)
+
+    def _cached_triage_rows(self, payload: AdmissionPayload):
+        """Submit-time triage-cache hit: a content-identical manifest
+        under the active compiled version answers its (M,) triage
+        column without queue, flush, or device. Keys carry the
+        "mutate|" ident namespace (tpu/engine.py) so a triage column
+        and a validate column for the same request can never collide
+        in the shared verdict cache."""
+        from ..tpu.cache import global_verdict_cache
+
+        if not global_verdict_cache.enabled:
+            return None
+        version = self.lifecycle.active  # wait-free; never compiles
+        if version is None:
+            return None
+        eng = version.engine
+        entries = eng.cps.mutate_entries
+        if not entries or not eng.mutate_cache_eligible:
+            return None
+        ns_labels = self.snapshot.namespace_labels() if self.snapshot else {}
+        keys = eng.mutate_triage_cache_keys(
+            [payload.resource], ns_labels, [payload.operation],
+            [payload.info])
+        if keys is None or keys[0] is None:
+            return None
+        col = global_verdict_cache.get(keys[0], expect_rows=len(entries))
+        if col is None:
+            return None
+        self.metrics.mutate_triage.inc({"outcome": "cached"})
+        return VerdictRows(
+            [((e.policy_name, e.rule_name), int(col[row]))
+             for row, e in enumerate(entries)],
+            version=version)
+
+    def _mutate_flight_hook(self, payload: AdmissionPayload, result: Any,
+                            path: str, latency_s: float, trace_id: str,
+                            timings: Optional[Dict[str, float]] = None
+                            ) -> None:
+        """Mutate-pipeline black-box hook. A successful triage is NOT
+        the end of a mutate admission — the coordinator still has to
+        patch — so success paths only stash (path, trace_id) for the
+        request thread's post-patch record (kind="mutate", carrying the
+        patched body). Terminal failures (shed-rejected, expired,
+        evaluator error) never reach the coordinator and record here,
+        so no mutate decision escapes the ring."""
+        if not isinstance(result, BaseException):
+            with self._mutate_paths_lock:
+                if len(self._mutate_paths) > 1024:
+                    # abandoned entries (waiter gave up before the
+                    # flusher resolved) must not accumulate forever
+                    self._mutate_paths.clear()
+                self._mutate_paths[id(payload)] = (path, trace_id)
+            return
+        from ..observability.flightrecorder import global_flight
+
+        if not global_flight.enabled:
+            return
+        outcome = global_flight.classify(None, path, error=result)
+        if not global_flight.should_capture(outcome):
+            return
+        t = dict(timings or {})
+        t["total_s"] = latency_s
+        info = payload.info
+        global_flight.record_admission(
+            payload.resource, None, path, error=result,
+            namespace=payload.namespace, operation=payload.operation,
+            userinfo={"username": info.username, "uid": info.uid,
+                      "groups": list(info.groups or [])},
+            trace_id=trace_id, timings=t, kind="mutate", outcome=outcome)
+
+    def _pop_mutate_path(self, payload: AdmissionPayload
+                         ) -> Tuple[str, str]:
+        with self._mutate_paths_lock:
+            return self._mutate_paths.pop(id(payload), ("batched", ""))
 
     # -- flight recorder (observability/flightrecorder.py)
 
@@ -628,6 +808,32 @@ class Handlers:
         }
         if self.pipeline is not None:
             state["pipeline"] = self.pipeline.state()
+        # mutation subsystem block (mutation/): bank shape, template
+        # coverage, and the triage/patch counters the mutate gate
+        # asserts on — present (enabled=false) even with the pipeline
+        # off, so dashboards never key-error across configs
+        mut: Dict[str, Any] = {"enabled": self.mutate_pipeline is not None}
+        if active is not None:
+            m_eng = active.engine
+            m_dev, m_total = m_eng.mutate_coverage()
+            mut["rules"] = m_total
+            mut["device_rows"] = m_dev
+            mut["templates"] = sum(
+                1 for t in m_eng.cps.mutate_templates if t is not None)
+            mut["cache_eligible"] = bool(m_eng.mutate_cache_eligible)
+        mut["counters"] = {
+            "triage": {o: _reg.mutate_triage.value({"outcome": o})
+                       for o in ("device", "fallback", "cached")},
+            "rows": {r: _reg.mutate_triage_rows.value({"result": r})
+                     for r in ("positive", "negative", "host")},
+            "patches": {s: _reg.mutate_patches.value({"source": s})
+                        for s in ("template", "scalar")},
+            "patch_fallbacks": _reg.mutate_patch_fallbacks.value(),
+            "divergence": _reg.mutate_divergence.value(),
+        }
+        if self.mutate_pipeline is not None:
+            mut["pipeline"] = self.mutate_pipeline.state()
+        state["mutation"] = mut
         return state
 
     # -- public handlers
@@ -901,6 +1107,10 @@ class Handlers:
 
     def mutate(self, review: Dict[str, Any], failure_policy: str = "all",
                policy_key=None) -> Dict[str, Any]:
+        from ..resilience.retry import Deadline
+
+        t0 = time.perf_counter()
+        deadline = Deadline(self.request_timeout_s)
         req = review.get("request") or {}
         payload = _payload_from_request(req, self.snapshot, self._need_roles())
         self.metrics.admission_requests.inc(
@@ -915,19 +1125,77 @@ class Handlers:
         except KeyError as e:
             return _response(req, self._fail_open(failure_policy),
                              f"evaluation error: {e}")
+        mutate_rec = None  # (rows, path, trace_id) for the post-patch record
+        served: Optional[PolicySetVersion] = None
         try:
-            for policy in self.cache.get_policies(
-                PolicyType.MUTATE, kind=resource.get("kind"), namespace=payload.namespace
-            ):
-                if evaluable is not None and policy.name not in evaluable:
-                    continue
-                pctx = build_scan_context(
-                    policy, patched, ns_labels.get(payload.namespace, {}),
-                    payload.operation, payload.info,
-                )
-                response = self.scalar.mutate(pctx)
-                if response.patched_resource is not None:
-                    patched = response.patched_resource
+            rows = None
+            if self.mutate_pipeline is not None:
+                # --mutate-batching: the batched front door. Triage
+                # through the serving pipeline (same queue budget math
+                # as validate()); the rows come back pinned to the
+                # compiled version that produced them.
+                remaining = deadline.remaining()
+                if remaining <= 0:
+                    raise DeadlineExceededError(
+                        "request budget exhausted before mutation")
+                queue_ms = min(remaining * 1000.0,
+                               self.mutate_pipeline.config.deadline_ms)
+                cls = classify_request(
+                    self.classify_config, operation=payload.operation,
+                    username=payload.info.username,
+                    namespace=payload.namespace,
+                    groups=payload.info.groups,
+                    dry_run=payload.dry_run, resource=payload.resource)
+                rows = self.mutate_pipeline.submit(
+                    payload, deadline_ms=queue_ms,
+                    eval_grace_s=min(
+                        self.mutate_pipeline.config.eval_grace_s,
+                        max(0.0, remaining - queue_ms / 1000.0)),
+                    cls=cls)
+                served = getattr(rows, "version", None)
+            if served is not None:
+                from ..mutation.coordinator import apply_mutations
+
+                path, trace_id = self._pop_mutate_path(payload)
+                # the class filter must describe the SERVED version,
+                # exactly like validate() (dropping a policy's rows
+                # drops its whole group from the coordinator — the
+                # batched analogue of the legacy loop's `continue`)
+                try:
+                    evaluable = self._class_filter(
+                        failure_policy, policy_key,
+                        policies=served.policies)
+                except KeyError as e:
+                    return _response(req, self._fail_open(failure_policy),
+                                     f"evaluation error: {e}")
+                outm = apply_mutations(
+                    served.engine, resource,
+                    [(pr, code) for pr, code in rows
+                     if evaluable is None or pr[0] in evaluable],
+                    namespace_labels=ns_labels.get(payload.namespace, {}),
+                    operation=payload.operation,
+                    admission_info=payload.info,
+                    registry=self.metrics)
+                patched = outm.patched
+                mutate_rec = (rows, path, trace_id)
+            else:
+                # legacy host loop: no mutate pipeline configured, or
+                # no compiled artifact exists (versionless rows) — the
+                # deepest rung evaluates the live cache policies
+                # scalar, one at a time
+                for policy in self.cache.get_policies(
+                    PolicyType.MUTATE, kind=resource.get("kind"),
+                    namespace=payload.namespace
+                ):
+                    if evaluable is not None and policy.name not in evaluable:
+                        continue
+                    pctx = build_scan_context(
+                        policy, patched, ns_labels.get(payload.namespace, {}),
+                        payload.operation, payload.info,
+                    )
+                    response = self.scalar.mutate(pctx)
+                    if response.patched_resource is not None:
+                        patched = response.patched_resource
             # image verification runs after mutation on the patched
             # resource (resource/handlers.go:139-177: mutate policies
             # then verify-image policies, patches joined)
@@ -972,16 +1240,107 @@ class Handlers:
                     return _response(
                         req, False,
                         f"image verification failed: {policy.name}: {failed}")
+            # composed mutate+validate: ONE admission pass — the
+            # patched object feeds the validate scan at the SAME
+            # pinned revision that triaged it, so a mutation that
+            # produces a blocked object denies here instead of
+            # surfacing a revision-skewed deny from the separate
+            # validate webhook later
+            if served is not None and patched is not resource \
+                    and patched != resource:
+                block = self._validate_patched(payload, patched, served,
+                                               failure_policy, policy_key)
+                if block:
+                    return _response(
+                        req, False,
+                        f"mutation produced a blocked object: {block}")
         except Exception as e:
-            return _response(req, self._fail_open(failure_policy),
-                             f"mutation error: {e}")
+            allowed = self._fail_open(failure_policy)
+            if not allowed and failure_policy == "all" and \
+                    isinstance(e, (QueueFullError, DeadlineExceededError)):
+                # shed/expiry is an admission-control decision, not an
+                # engine error — same per-class resolution as validate()
+                allowed = self._loaded_policies_all_ignore()
+            return _response(req, allowed, f"mutation error: {e}")
         out = _response(req, True, "")
         ops = jsonpatch_diff(resource, patched)
         if ops:
             out["response"]["patchType"] = "JSONPatch"
             out["response"]["patch"] = base64.b64encode(
                 json.dumps(ops).encode()).decode()
+        dt = time.perf_counter() - t0
+        self.metrics.admission_duration.observe(dt, {"path": "mutate"})
+        if mutate_rec is not None:
+            self.metrics.mutate_duration.observe(dt)
+            rows, path, trace_id = mutate_rec
+            self._record_mutate(payload, patched, rows, path, trace_id, dt)
         return out
+
+    def _validate_patched(self, payload: AdmissionPayload,
+                          patched: Dict[str, Any],
+                          served: PolicySetVersion,
+                          failure_policy: str, policy_key) -> str:
+        """The composed pass's validate leg: one direct batch (NOT a
+        pipeline submit — the pin must be exactly the triage's version,
+        and a queued submit could flush after a hot swap) of the
+        patched object. Returns the deny message, or "" to allow.
+        Reports stay with the validate webhook, which will re-evaluate
+        the patched object the API server sends it."""
+        vp = AdmissionPayload(patched, payload.operation, payload.info,
+                              payload.namespace, old=payload.old,
+                              dry_run=payload.dry_run)
+        verdicts = self._evaluate_padded([vp], pinned=served)[0]
+        try:
+            evaluable = self._class_filter(failure_policy, policy_key,
+                                           policies=served.policies)
+        except KeyError as e:
+            return "" if self._fail_open(failure_policy) \
+                else f"evaluation error: {e}"
+        enforce = {
+            p.name for p in served.policies
+            if (p.spec.validation_failure_action or "Audit")
+            .lower().startswith("enforce")
+        }
+        return "; ".join(
+            f"{pn}/{rn}: {VERDICT_NAMES.get(code, 'fail')}"
+            for (pn, rn), code in verdicts
+            if code in (FAIL, ERROR) and pn in enforce
+            and (evaluable is None or pn in evaluable))
+
+    def _record_mutate(self, payload: AdmissionPayload,
+                       patched: Dict[str, Any], rows, path: str,
+                       trace_id: str, latency_s: float) -> None:
+        """Post-patch mutate record: kind="mutate" with the patched
+        body and its digest. The shadow verifier re-derives the patch
+        through the scalar oracle at the pinned revision and diffs the
+        bodies (observability/verification.py) — zero divergence is the
+        vectorized patcher's correctness budget."""
+        from ..observability.flightrecorder import global_flight
+
+        if not global_flight.enabled:
+            return
+        version = getattr(rows, "version", None)
+        engine = version.engine if version is not None else None
+        rec_path = path if path.endswith("_mutate") else f"{path}_mutate"
+        outcome = global_flight.classify(rows, rec_path, mutated=True)
+        if not global_flight.should_capture(outcome):
+            return
+        try:
+            nsmap = self.snapshot.namespace_labels() if self.snapshot else {}
+        except Exception:
+            nsmap = {}
+        info = payload.info
+        global_flight.record_admission(
+            payload.resource, rows, rec_path, engine=engine,
+            revision=getattr(rows, "revision", None),
+            namespace=payload.namespace, operation=payload.operation,
+            userinfo={"username": info.username, "uid": info.uid,
+                      "groups": list(info.groups or []),
+                      "roles": list(info.roles or []),
+                      "cluster_roles": list(info.cluster_roles or [])},
+            ns_labels=(nsmap or {}).get(payload.namespace, {}),
+            trace_id=trace_id, timings={"total_s": latency_s},
+            kind="mutate", outcome=outcome, patched=patched)
 
 
 def _payload_from_request(req: Dict[str, Any], snapshot=None,
@@ -1399,3 +1758,5 @@ class AdmissionServer:
         self.handlers.batcher.stop()
         if self.handlers.pipeline is not None:
             self.handlers.pipeline.stop()
+        if self.handlers.mutate_pipeline is not None:
+            self.handlers.mutate_pipeline.stop()

@@ -1,0 +1,219 @@
+#!/bin/bash
+# Batched-mutation gate: the mutate front door, asserted end-to-end
+# through the real serve control plane with --mutate-batching on.
+#
+# Leg 1 posts mutate admission reviews against a mutate-batching
+# control plane: a triage-positive Pod must come back with the overlay
+# as an RFC 6902 patch, a triage-negative Pod must come back
+# untouched, /debug/state must carry the mutation block, and the
+# kyverno_mutate_* families must ride the /metrics exposition. Leg 2
+# arms a mutate.triage raise fault and asserts the scalar fallback
+# produces a bit-identical patch (and that recovery re-takes the
+# template path). Leg 3 runs the mutation test file.
+#
+# Usage: ./scripts_mutate_gate.sh
+set -o pipefail
+cd "$(dirname "$0")"
+rc=0
+
+echo "=== leg 1/3: mutate-batching serve smoke — patch, state, metrics ==="
+JAX_PLATFORMS=cpu timeout -k 10 600 python - <<'EOF' || rc=1
+import base64
+import http.client
+import json
+import sys
+
+from kyverno_tpu.api.policy import ClusterPolicy
+from kyverno_tpu.cli.serve import ControlPlane
+
+POLICIES = [ClusterPolicy.from_dict({
+    "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+    "metadata": {"name": "mutate-gate"},
+    "spec": {"validationFailureAction": "Enforce", "rules": [{
+        "name": "stamp-labels",
+        "match": {"resources": {"kinds": ["Pod"], "namespaces": ["prod"]}},
+        "mutate": {"patchStrategicMerge":
+                   {"metadata": {"labels": {"+(team)": "core",
+                                            "env": "prod"}}}},
+    }]}})]
+
+
+def get(port, path):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+    conn.request("GET", path)
+    resp = conn.getresponse()
+    body = resp.read()
+    conn.close()
+    return resp.status, body
+
+
+def post(port, path, doc):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+    conn.request("POST", path, json.dumps(doc),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    body = resp.read()
+    conn.close()
+    return resp.status, body
+
+
+def review(name, ns):
+    return {"request": {"uid": f"gate-{name}", "operation": "CREATE",
+                        "namespace": ns,
+                        "object": {"apiVersion": "v1", "kind": "Pod",
+                                   "metadata": {"name": name,
+                                                "namespace": ns},
+                                   "spec": {"containers": [
+                                       {"name": "c", "image": "nginx"}]}},
+                        "userInfo": {"username": "gate"}}}
+
+
+def patch_ops(body):
+    resp = json.loads(body)["response"]
+    assert resp["allowed"], resp
+    if "patch" not in resp:
+        return []
+    return json.loads(base64.b64decode(resp["patch"]))
+
+
+cp = ControlPlane(POLICIES, port=0, metrics_port=0, mutate_batching=True)
+cp.start(scan_interval=3600.0)
+adm = cp.admission.port
+met = cp.metrics_server.server_address[1]
+ok = True
+try:
+    s, b = post(adm, "/mutate", review("p-prod", "prod"))
+    assert s == 200, b
+    ops = patch_ops(b)
+    stamped = any("labels" in op.get("path", "") or
+                  op.get("value", {}) == {"team": "core", "env": "prod"}
+                  for op in ops if isinstance(op, dict))
+    if not ops or not stamped:
+        print(f"FAIL: triage-positive Pod not patched: {ops}")
+        ok = False
+    s, b = post(adm, "/mutate", review("p-dev", "dev"))
+    assert s == 200, b
+    if patch_ops(b):
+        print(f"FAIL: triage-negative Pod was patched: {patch_ops(b)}")
+        ok = False
+    st, body = get(met, "/debug/state")
+    assert st == 200, body
+    mstate = json.loads(body).get("mutation")
+    if not mstate or mstate.get("enabled") is not True:
+        print(f"FAIL: /debug/state mutation block missing/off: {mstate}")
+        ok = False
+    elif mstate["device_rows"] < 1 or \
+            mstate["counters"]["patches"]["template"] < 1:
+        print(f"FAIL: mutation state never took the template path: {mstate}")
+        ok = False
+    st, body = get(met, "/metrics")
+    assert st == 200
+    for fam in (b"kyverno_mutate_triage_total",
+                b"kyverno_mutate_triage_rows_total",
+                b"kyverno_mutate_patches_total",
+                b"kyverno_mutate_duration_seconds"):
+        if fam not in body:
+            print(f"FAIL: {fam.decode()} missing from exposition")
+            ok = False
+finally:
+    cp.stop()
+if not ok:
+    sys.exit(1)
+print("leg 1 OK: positive patched, negative untouched, state + "
+      "exposition carry the mutate block")
+EOF
+
+echo "=== leg 2/3: mutate.triage chaos — scalar fallback bit-identical ==="
+JAX_PLATFORMS=cpu timeout -k 10 600 python - <<'EOF' || rc=1
+import base64
+import http.client
+import json
+import sys
+
+from kyverno_tpu.api.policy import ClusterPolicy
+from kyverno_tpu.cli.serve import ControlPlane
+from kyverno_tpu.observability.metrics import global_registry as reg
+from kyverno_tpu.resilience.faults import SITE_MUTATE_TRIAGE, global_faults
+
+POLICIES = [ClusterPolicy.from_dict({
+    "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+    "metadata": {"name": "mutate-chaos"},
+    "spec": {"validationFailureAction": "Enforce", "rules": [{
+        "name": "stamp-labels",
+        "match": {"resources": {"kinds": ["Pod"], "namespaces": ["prod"]}},
+        "mutate": {"patchStrategicMerge":
+                   {"metadata": {"labels": {"env": "prod"}}}},
+    }]}})]
+
+
+def post(port, path, doc):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+    conn.request("POST", path, json.dumps(doc),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    body = resp.read()
+    conn.close()
+    return resp.status, body
+
+
+def mutate(port, name):
+    s, b = post(port, "/mutate", {"request": {
+        "uid": f"chaos-{name}", "operation": "CREATE", "namespace": "prod",
+        "object": {"apiVersion": "v1", "kind": "Pod",
+                   "metadata": {"name": name, "namespace": "prod"},
+                   "spec": {"containers": [{"name": "c",
+                                            "image": "nginx"}]}},
+        "userInfo": {"username": "gate"}}})
+    assert s == 200, b
+    resp = json.loads(b)["response"]
+    assert resp["allowed"], resp
+    return json.loads(base64.b64decode(resp["patch"])) \
+        if "patch" in resp else []
+
+
+cp = ControlPlane(POLICIES, port=0, metrics_port=0, mutate_batching=True)
+cp.start(scan_interval=3600.0)
+adm = cp.admission.port
+ok = True
+try:
+    baseline = mutate(adm, "chaos-a")
+    assert baseline, "baseline request produced no patch"
+    scal0 = reg.mutate_patches.value({"source": "scalar"})
+    global_faults.arm(SITE_MUTATE_TRIAGE, mode="raise")
+    try:
+        faulted = mutate(adm, "chaos-b")
+    finally:
+        global_faults.disarm(SITE_MUTATE_TRIAGE)
+    if faulted != baseline:
+        print(f"FAIL: faulted patch diverged: {baseline} -> {faulted}")
+        ok = False
+    if reg.mutate_patches.value({"source": "scalar"}) - scal0 < 1:
+        print("FAIL: fault did not route through the scalar patcher")
+        ok = False
+    tmpl0 = reg.mutate_patches.value({"source": "template"})
+    recovered = mutate(adm, "chaos-c")
+    if recovered != baseline:
+        print(f"FAIL: post-fault patch diverged: {baseline} -> {recovered}")
+        ok = False
+    if reg.mutate_patches.value({"source": "template"}) - tmpl0 < 1:
+        print("FAIL: recovery did not re-take the template path")
+        ok = False
+finally:
+    cp.stop()
+if not ok:
+    sys.exit(1)
+print("leg 2 OK: mutate.triage fault -> scalar patch bit-identical, "
+      "template path back after disarm")
+EOF
+
+echo "=== leg 3/3: mutation test file ==="
+JAX_PLATFORMS=cpu timeout -k 10 600 \
+  python -m pytest tests/test_mutation.py -q \
+  -p no:cacheprovider -p no:xdist -p no:randomly || rc=1
+
+if [ $rc -eq 0 ]; then
+  echo "mutate gate: ALL LEGS PASSED"
+else
+  echo "mutate gate: FAILURES (rc=$rc)"
+fi
+exit $rc

@@ -1,0 +1,596 @@
+"""Device-triaged batched mutation (kyverno_tpu/mutation/).
+
+Three layers under test, with engine/mutate.py as the bit-identity
+oracle throughout:
+
+- lowering: constant strategic-merge overlays -> stamped patch
+  templates, byte-identical to the scalar merge on the lowerable
+  subset; everything else (variables, json6902, condition anchors,
+  dict-bearing lists) must REFUSE to lower rather than approximate
+- triage: mutate rules' predicates compiled through the validate
+  compiler into a needs-mutation cross-product; chain-dependent rules
+  demote to HOST (an earlier rule may write what a later predicate
+  reads)
+- coordinator + webhook: triage-negative resources cost no patch work,
+  positives stamp templates, HOST/failure rungs scalar-patch — and the
+  batched webhook's RFC 6902 patch equals the legacy host loop's
+"""
+
+import base64
+import copy
+import json
+
+import numpy as np
+import pytest
+
+from kyverno_tpu.api.policy import ClusterPolicy, Rule
+from kyverno_tpu.engine.mutate import strategic_merge
+from kyverno_tpu.mutation import (lower_mutate_rule, paths_conflict,
+                                  rule_read_paths, rule_write_paths,
+                                  synthetic_triage_policy, triage_rule)
+from kyverno_tpu.mutation.coordinator import apply_mutations
+from kyverno_tpu.tpu.compiler import compile_policy_set
+from kyverno_tpu.tpu.engine import TpuEngine, build_scan_context
+from kyverno_tpu.tpu.evaluator import (ERROR, FAIL, HOST, NOT_MATCHED, PASS,
+                                       SKIP)
+
+
+def _policy(rules, name="mpol", action="Enforce"):
+    return ClusterPolicy.from_dict({
+        "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+        "metadata": {"name": name},
+        "spec": {"validationFailureAction": action, "rules": rules},
+    })
+
+
+def _mutate_rule(overlay, name="m", match_kinds=("Pod",), **extra):
+    d = {"name": name,
+         "match": {"resources": {"kinds": list(match_kinds)}},
+         "mutate": {"patchStrategicMerge": overlay}}
+    d.update(extra)
+    return Rule.from_dict(d)
+
+
+def _pod(name="p", ns="prod", labels=None):
+    meta = {"name": name, "namespace": ns}
+    if labels is not None:
+        meta["labels"] = dict(labels)
+    return {"apiVersion": "v1", "kind": "Pod", "metadata": meta,
+            "spec": {"containers": [{"name": "c", "image": "nginx"}]}}
+
+
+# ---------------------------------------------------------------------------
+# lowering: template stamp == strategic merge on the lowerable subset
+
+
+@pytest.mark.parametrize("overlay", [
+    {"metadata": {"labels": {"env": "prod"}}},
+    {"metadata": {"labels": {"+(team)": "core", "env": "prod"}}},
+    {"spec": {"dnsPolicy": "ClusterFirst", "priority": 100}},
+    {"metadata": {"annotations": {"+(audit)": "on"}},
+     "spec": {"schedulerName": "custom"}},
+    {"spec": {"tolerationSeconds": [1, 2, 3]}},  # scalar list = replace
+])
+def test_template_stamp_matches_strategic_merge(overlay):
+    tmpl = lower_mutate_rule(_mutate_rule(overlay))
+    assert tmpl is not None, "constant overlay must lower"
+    for resource in [
+        _pod(),
+        _pod(labels={"team": "other", "env": "dev"}),
+        {"kind": "Pod", "metadata": {}, "spec": {"dnsPolicy": "Default"}},
+        {"kind": "Pod"},
+    ]:
+        want = strategic_merge(copy.deepcopy(resource),
+                               copy.deepcopy(overlay))
+        got = tmpl.stamp(copy.deepcopy(resource))
+        assert got == want, (overlay, resource)
+
+
+def test_template_stamp_copy_on_write():
+    tmpl = lower_mutate_rule(
+        _mutate_rule({"metadata": {"labels": {"env": "prod"}}}))
+    resource = _pod(labels={"a": "b"})
+    before = copy.deepcopy(resource)
+    out = tmpl.stamp(resource)
+    assert resource == before, "stamp must not mutate its input"
+    assert out is not resource
+    # untouched subtrees are shared, touched ones are copied
+    assert out["spec"] is resource["spec"]
+    assert out["metadata"] is not resource["metadata"]
+
+
+def test_add_anchor_is_add_if_absent():
+    tmpl = lower_mutate_rule(
+        _mutate_rule({"metadata": {"labels": {"+(team)": "core"}}}))
+    assert tmpl.stamp(_pod(labels={"team": "x"}))["metadata"]["labels"] == \
+        {"team": "x"}
+    assert tmpl.stamp(_pod())["metadata"]["labels"] == {"team": "core"}
+
+
+@pytest.mark.parametrize("rule_kw", [
+    # variables anywhere refuse to lower
+    {"overlay": {"metadata": {"labels": {"env": "{{request.namespace}}"}}}},
+    # condition anchors gate on runtime state
+    {"overlay": {"spec": {"(hostNetwork)": True, "priority": 1}}},
+    # dict-bearing lists need the scalar merge-by-name machinery
+    {"overlay": {"spec": {"containers": [{"name": "c", "image": "x"}]}}},
+    # anchored payloads with nested anchors/vars
+    {"overlay": {"metadata": {"labels": {"+(t)": "{{request.operation}}"}}}},
+])
+def test_non_lowerable_overlays_refuse(rule_kw):
+    assert lower_mutate_rule(_mutate_rule(rule_kw["overlay"])) is None
+
+
+def test_json6902_and_context_rules_refuse_to_lower():
+    r = Rule.from_dict({
+        "name": "j", "match": {"resources": {"kinds": ["Pod"]}},
+        "mutate": {"patchesJson6902":
+                   "- op: add\n  path: /metadata/labels/x\n  value: y\n"}})
+    assert lower_mutate_rule(r) is None
+    r2 = Rule.from_dict({
+        "name": "c", "match": {"resources": {"kinds": ["Pod"]}},
+        "context": [{"name": "v", "variable": {"value": "1"}}],
+        "mutate": {"patchStrategicMerge": {"metadata": {"labels": {"a": "b"}}}}})
+    assert lower_mutate_rule(r2) is None
+
+
+# ---------------------------------------------------------------------------
+# write/read path analysis + chain-conflict demotion
+
+
+def test_write_paths_strategic_merge_and_json6902():
+    assert set(rule_write_paths(_mutate_rule(
+        {"metadata": {"labels": {"env": "x", "+(t)": "y"}},
+         "spec": {"dnsPolicy": "Default"}}))) == {
+        ("metadata", "labels", "env"), ("metadata", "labels", "t"),
+        ("spec", "dnsPolicy")}
+    r = Rule.from_dict({
+        "name": "j", "match": {"resources": {"kinds": ["Pod"]}},
+        "mutate": {"patchesJson6902":
+                   "- op: replace\n  path: /spec/priority\n  value: 3\n"}})
+    assert rule_write_paths(r) == [("spec", "priority")]
+
+
+def test_paths_conflict_prefix_and_top():
+    assert paths_conflict([("metadata", "labels")],
+                          [("metadata", "labels", "env")])
+    assert paths_conflict([("metadata", "labels", "env")],
+                          [("metadata", "labels")])
+    assert not paths_conflict([("spec",)], [("metadata",)])
+    assert paths_conflict(None, [("spec",)])      # unbounded writes = top
+    assert paths_conflict([("spec",)], None)      # unbounded reads = top
+    assert not paths_conflict([], None)           # provably-empty side
+
+
+def test_chain_dependent_rule_demotes_to_host():
+    # rule 1 writes metadata.labels; rule 2's predicate READS a label
+    # via its selector — evaluating rule 2's triage against the
+    # original resource would miss rule 1's effect, so it must HOST
+    pol = _policy([
+        {"name": "w", "match": {"resources": {"kinds": ["Pod"]}},
+         "mutate": {"patchStrategicMerge":
+                    {"metadata": {"labels": {"tier": "web"}}}}},
+        {"name": "r", "match": {"resources": {
+            "kinds": ["Pod"], "selector": {"matchLabels": {"tier": "web"}}}},
+         "mutate": {"patchStrategicMerge":
+                    {"spec": {"priorityClassName": "web-tier"}}}},
+    ])
+    cps = compile_policy_set([pol])
+    by_rule = {e.rule_name: e for e in cps.mutate_entries}
+    assert by_rule["w"].device_row is not None
+    assert by_rule["r"].device_row is None
+    assert "chain-dependent" in (by_rule["r"].fallback_reason or "")
+
+
+def test_independent_rules_stay_on_device():
+    pol = _policy([
+        {"name": "a", "match": {"resources": {"kinds": ["Pod"]}},
+         "mutate": {"patchStrategicMerge":
+                    {"metadata": {"labels": {"a": "1"}}}}},
+        {"name": "b", "match": {"resources": {"kinds": ["Pod"],
+                                              "namespaces": ["prod"]}},
+         "mutate": {"patchStrategicMerge": {"spec": {"priority": 5}}}},
+    ])
+    cps = compile_policy_set([pol])
+    assert all(e.device_row is not None for e in cps.mutate_entries)
+    assert cps.mutate_coverage() == (2, 2)
+
+
+# ---------------------------------------------------------------------------
+# triage: synthetic predicate rules through the validate compiler
+
+
+def test_triage_rule_keeps_predicate_drops_mutation():
+    r = _mutate_rule({"metadata": {"labels": {"a": "b"}}},
+                     preconditions={"all": [{
+                         "key": "{{request.object.metadata.namespace}}",
+                         "operator": "Equals", "value": "prod"}]})
+    t = triage_rule(r)
+    assert t.has_validate() and not t.has_mutate()
+    assert t.raw["match"] == r.raw["match"]
+    assert t.raw["preconditions"] == r.raw["preconditions"]
+
+
+def test_synthetic_triage_policy_only_mutate_rules():
+    pol = _policy([
+        {"name": "v", "match": {"resources": {"kinds": ["Pod"]}},
+         "validate": {"pattern": {"metadata": {"name": "?*"}}}},
+        {"name": "m", "match": {"resources": {"kinds": ["Pod"]}},
+         "mutate": {"patchStrategicMerge":
+                    {"metadata": {"labels": {"a": "b"}}}}},
+    ])
+    syn = synthetic_triage_policy(pol)
+    assert [r.name for r in syn.get_rules()] == ["m"]
+
+
+def test_triage_mutate_verdict_codes():
+    pol = _policy([{
+        "name": "label-prod",
+        "match": {"resources": {"kinds": ["Pod"], "namespaces": ["prod"]}},
+        "mutate": {"patchStrategicMerge":
+                   {"metadata": {"labels": {"env": "prod"}}}},
+    }])
+    eng = TpuEngine(cps=compile_policy_set([pol]))
+    res = eng.triage_mutate(
+        [_pod(ns="prod"), _pod(ns="dev"),
+         {"kind": "Service", "metadata": {"name": "s"}}],
+        {"prod": {}, "dev": {}})
+    rows = {ident[1]: res.verdicts[mi] for mi, ident in enumerate(res.rules)}
+    codes = rows["label-prod"]
+    assert codes[0] in (PASS, FAIL)          # needs mutation
+    assert codes[1] in (SKIP, NOT_MATCHED)   # wrong namespace
+    assert codes[2] in (SKIP, NOT_MATCHED)   # wrong kind
+    c = res.counts()
+    assert c["positive"] >= 1 and c["negative"] >= 2
+
+
+def test_triage_host_rows_for_uncompilable_predicates():
+    # an apiCall context variable in the predicate cannot evaluate on
+    # device (dynamic operand) — the whole rule host-routes and its
+    # triage rows come back HOST for every resource
+    pol = _policy([{
+        "name": "ctx-gated",
+        "match": {"resources": {"kinds": ["Pod"]}},
+        "context": [{"name": "v", "apiCall": {"urlPath": "/api/v1/ns"}}],
+        "preconditions": {"all": [{"key": "{{v}}", "operator": "Equals",
+                                   "value": "1"}]},
+        "mutate": {"patchStrategicMerge":
+                   {"metadata": {"labels": {"a": "b"}}}},
+    }])
+    cps = compile_policy_set([pol])
+    assert all(e.device_row is None for e in cps.mutate_entries
+               if e.rule_name == "ctx-gated")
+    eng = TpuEngine(cps=cps)
+    res = eng.triage_mutate([_pod()], {})
+    assert all(int(c) >= HOST or int(c) == ERROR
+               for c in res.verdicts[:, 0])
+
+
+# ---------------------------------------------------------------------------
+# coordinator: triage rows -> patched resource, scalar as oracle
+
+
+def _scalar_chain(policies, resource, ns_labels=None):
+    """The legacy per-policy host loop — the bit-identity oracle."""
+    from kyverno_tpu.engine.engine import Engine
+
+    eng = Engine()
+    patched = copy.deepcopy(resource)
+    for pol in policies:
+        pctx = build_scan_context(pol, patched, ns_labels or {}, "CREATE",
+                                  None)
+        resp = eng.mutate(pctx)
+        if resp.patched_resource is not None:
+            patched = resp.patched_resource
+    return patched
+
+
+def test_coordinator_all_negative_skips_everything():
+    pol = _policy([_mutate_rule({"metadata": {"labels": {"a": "b"}}}).raw])
+    eng = TpuEngine(cps=compile_policy_set([pol]))
+    rows = [(ident, SKIP) for ident in eng.cps.mutate_rules]
+    res = _pod()
+    out = apply_mutations(eng, res, rows)
+    assert out.patched is res and not out.changed
+    assert out.skipped_policies == len({p for p, _ in eng.cps.mutate_rules})
+    assert out.scalar_policies == 0 and not out.template_rules
+
+
+def test_coordinator_positive_stamps_template_bit_identical():
+    pol = _policy([_mutate_rule(
+        {"metadata": {"labels": {"+(team)": "core", "env": "prod"}}}).raw])
+    eng = TpuEngine(cps=compile_policy_set([pol]))
+    res = _pod(labels={"team": "x"})
+    rows = eng.triage_mutate([res], {}).rows_for(0)
+    out = apply_mutations(eng, res, rows)
+    assert out.template_rules
+    assert out.patched == _scalar_chain([pol], res)
+
+
+def test_coordinator_host_rows_route_scalar_bit_identical():
+    pol = _policy([_mutate_rule(
+        {"metadata": {"labels": {"env": "prod"}}}).raw])
+    eng = TpuEngine(cps=compile_policy_set([pol]))
+    res = _pod()
+    rows = [(ident, HOST) for ident in eng.cps.mutate_rules]
+    out = apply_mutations(eng, res, rows)
+    assert out.scalar_policies >= 1 and not out.template_rules
+    assert out.patched == _scalar_chain([pol], res)
+
+
+def test_coordinator_patch_fault_falls_back_to_scalar():
+    from kyverno_tpu.resilience.faults import (SITE_MUTATE_PATCH,
+                                               global_faults)
+
+    pol = _policy([_mutate_rule(
+        {"metadata": {"labels": {"env": "prod"}}}).raw])
+    eng = TpuEngine(cps=compile_policy_set([pol]))
+    res = _pod()
+    rows = eng.triage_mutate([res], {}).rows_for(0)
+    global_faults.arm(SITE_MUTATE_PATCH, mode="raise")
+    try:
+        out = apply_mutations(eng, res, rows)
+    finally:
+        global_faults.disarm(SITE_MUTATE_PATCH)
+    assert out.fallbacks >= 1
+    assert out.patched == _scalar_chain([pol], res), \
+        "faulted template path must degrade bit-identically"
+
+
+def test_coordinator_multi_policy_chain_order():
+    p1 = _policy([_mutate_rule(
+        {"metadata": {"labels": {"env": "prod"}}}).raw], name="first")
+    p2 = _policy([_mutate_rule(
+        {"metadata": {"labels": {"+(env)": "SHOULD-NOT-WIN",
+                                 "owner": "team-b"}}}).raw], name="second")
+    eng = TpuEngine(cps=compile_policy_set([p1, p2]))
+    res = _pod()
+    rows = eng.triage_mutate([res], {}).rows_for(0)
+    out = apply_mutations(eng, res, rows)
+    want = _scalar_chain([p1, p2], res)
+    assert out.patched == want
+    assert out.patched["metadata"]["labels"]["env"] == "prod", \
+        "first policy's write must gate the second's +() anchor"
+
+
+# ---------------------------------------------------------------------------
+# engine/mutate.py edge cases (the oracle itself)
+
+
+def test_scalar_conditional_anchor_gates_siblings():
+    overlay = {"spec": {"(hostNetwork)": True, "priority": 99}}
+    on = {"kind": "Pod", "spec": {"hostNetwork": True}}
+    off = {"kind": "Pod", "spec": {"hostNetwork": False}}
+    assert strategic_merge(copy.deepcopy(on), copy.deepcopy(overlay))[
+        "spec"]["priority"] == 99
+    assert "priority" not in strategic_merge(
+        copy.deepcopy(off), copy.deepcopy(overlay))["spec"]
+
+
+def test_scalar_list_merge_by_name_vs_replace():
+    base = {"spec": {"containers": [
+        {"name": "a", "image": "old"}, {"name": "b", "image": "keep"}]}}
+    merged = strategic_merge(copy.deepcopy(base), {"spec": {"containers": [
+        {"name": "a", "image": "new"}]}})
+    by_name = {c["name"]: c for c in merged["spec"]["containers"]}
+    assert by_name["a"]["image"] == "new" and by_name["b"]["image"] == "keep"
+    # scalar lists have no merge key: verbatim replace
+    replaced = strategic_merge({"spec": {"args": ["x", "y"]}},
+                               {"spec": {"args": ["z"]}})
+    assert replaced["spec"]["args"] == ["z"]
+
+
+def test_scalar_nested_conditional_anchor():
+    overlay = {"metadata": {"(labels)": {"(app)": "web"},
+                            "annotations": {"audited": "true"}}}
+    hit = {"kind": "Pod", "metadata": {"labels": {"app": "web"}}}
+    miss = {"kind": "Pod", "metadata": {"labels": {"app": "db"}}}
+    assert "annotations" in strategic_merge(
+        copy.deepcopy(hit), copy.deepcopy(overlay))["metadata"]
+    assert "annotations" not in strategic_merge(
+        copy.deepcopy(miss), copy.deepcopy(overlay))["metadata"]
+
+
+# ---------------------------------------------------------------------------
+# flight recorder: mutate outcome class
+
+
+def test_classify_mutated_outcome_and_paths():
+    from kyverno_tpu.observability.flightrecorder import (OUTCOME_ERROR,
+                                                          OUTCOME_MUTATED,
+                                                          global_flight)
+
+    rows = [(("p", "r"), PASS)]
+    assert global_flight.classify(rows, "batched_mutate",
+                                  mutated=True) == OUTCOME_MUTATED
+    assert global_flight.classify(rows, "hedged_mutate",
+                                  mutated=True) == OUTCOME_MUTATED
+    assert global_flight.classify(rows, "cached_mutate",
+                                  mutated=True) == OUTCOME_MUTATED
+    # rows-level ERROR outranks the mutate class
+    assert global_flight.classify([(("p", "r"), ERROR)], "batched_mutate",
+                                  mutated=True) == OUTCOME_ERROR
+
+
+def test_record_admission_asserts_mutate_records_labeled():
+    from kyverno_tpu.observability.flightrecorder import FlightRecorder
+
+    fr = FlightRecorder(capacity=8, sample_rate=1.0)
+    rec = fr.record_admission(_pod(), [(("p", "r"), PASS)], "batched_mutate",
+                              kind="mutate", patched=_pod(labels={"a": "b"}))
+    assert rec is not None and rec.outcome == "mutated"
+    assert rec.patched_sha
+    with pytest.raises(AssertionError, match="unlabeled mutate record"):
+        fr.record_admission(_pod(), [(("p", "r"), PASS)], "batched_mutate",
+                            kind="mutate", outcome="ok")
+
+
+# ---------------------------------------------------------------------------
+# webhook integration: batched front door == legacy host loop
+
+
+def _review(resource, ns="prod", op="CREATE"):
+    return {"request": {"uid": "u1", "operation": op, "namespace": ns,
+                        "object": resource,
+                        "userInfo": {"username": "alice"}}}
+
+
+def _mk_handlers(policies, **kw):
+    from kyverno_tpu.cluster.policycache import PolicyCache
+    from kyverno_tpu.webhooks.server import build_handlers
+
+    cache = PolicyCache()
+    for p in policies:
+        cache.set(p)
+    return build_handlers(cache, **kw)
+
+
+def _patch_of(out):
+    resp = out["response"]
+    assert resp["allowed"], resp
+    if "patch" not in resp:
+        return None
+    return json.loads(base64.b64decode(resp["patch"]))
+
+
+def test_webhook_batched_mutate_matches_legacy():
+    pol = _policy([{
+        "name": "label-prod",
+        "match": {"resources": {"kinds": ["Pod"]}},
+        "preconditions": {"all": [{
+            "key": "{{request.object.metadata.namespace}}",
+            "operator": "Equals", "value": "prod"}]},
+        "mutate": {"patchStrategicMerge":
+                   {"metadata": {"labels": {"+(team)": "core",
+                                            "env": "prod"}}}},
+    }])
+    batched = _mk_handlers([pol], mutate_batching=True,
+                           batch_config=None)
+    legacy = _mk_handlers([pol])
+    try:
+        for res in [_pod(ns="prod"), _pod(ns="dev"),
+                    _pod(ns="prod", labels={"team": "x"})]:
+            ns = res["metadata"]["namespace"]
+            got = _patch_of(batched.mutate(_review(copy.deepcopy(res),
+                                                   ns=ns)))
+            want = _patch_of(legacy.mutate(_review(copy.deepcopy(res),
+                                                   ns=ns)))
+            assert got == want, (res, got, want)
+        st = batched.debug_state()["mutation"]
+        assert st["enabled"] and st["device_rows"] >= 1
+        assert st["counters"]["patches"]["template"] >= 1
+    finally:
+        batched.mutate_pipeline.stop()
+
+
+def test_webhook_composed_validate_blocks_bad_mutation():
+    # the mutation stamps a label the validate rule then rejects: the
+    # composed pass must deny in the MUTATE webhook, at the same pinned
+    # revision that triaged it
+    mut = _policy([_mutate_rule(
+        {"metadata": {"labels": {"env": "forbidden"}}}).raw], name="mut")
+    val = _policy([{
+        "name": "no-forbidden",
+        "match": {"resources": {"kinds": ["Pod"]}},
+        "validate": {"message": "forbidden env",
+                     "deny": {"conditions": {"all": [{
+                         "key": "{{request.object.metadata.labels.env}}",
+                         "operator": "Equals", "value": "forbidden"}]}}},
+    }], name="val")
+    h = _mk_handlers([mut, val], mutate_batching=True)
+    try:
+        out = h.mutate(_review(_pod()))
+        assert not out["response"]["allowed"]
+        assert "blocked object" in out["response"]["status"]["message"]
+    finally:
+        h.mutate_pipeline.stop()
+
+
+def test_webhook_mutate_triage_fault_degrades_bit_identically():
+    from kyverno_tpu.resilience.faults import (SITE_MUTATE_TRIAGE,
+                                               global_faults)
+
+    pol = _policy([_mutate_rule(
+        {"metadata": {"labels": {"env": "prod"}}}).raw])
+    h = _mk_handlers([pol], mutate_batching=True)
+    legacy = _mk_handlers([pol])
+    try:
+        res = _pod()
+        want = _patch_of(legacy.mutate(_review(copy.deepcopy(res))))
+        global_faults.arm(SITE_MUTATE_TRIAGE, mode="raise")
+        try:
+            got = _patch_of(h.mutate(_review(copy.deepcopy(res))))
+        finally:
+            global_faults.disarm(SITE_MUTATE_TRIAGE)
+        assert got == want, "all-HOST degradation must stay bit-identical"
+        st = h.debug_state()["mutation"]
+        assert st["counters"]["patches"]["scalar"] >= 1
+    finally:
+        h.mutate_pipeline.stop()
+
+
+# ---------------------------------------------------------------------------
+# hypothesis fuzz parity: stamped templates == scalar merge
+
+
+def test_fuzz_template_parity_on_lowerable_subset():
+    pytest.importorskip("hypothesis")
+    import string
+
+    from hypothesis import HealthCheck, given, settings
+    from hypothesis import strategies as st
+
+    keys = st.sampled_from(["app", "env", "tier", "owner", "zone"])
+    plain = st.one_of(st.booleans(),
+                      st.integers(min_value=-1000, max_value=1000),
+                      st.text(alphabet=string.ascii_lowercase, max_size=8))
+    leaf_maps = st.dictionaries(
+        st.one_of(keys, keys.map(lambda k: f"+({k})")), plain,
+        min_size=1, max_size=3)
+    overlays = st.fixed_dictionaries({}, optional={
+        "metadata": st.fixed_dictionaries({}, optional={
+            "labels": leaf_maps, "annotations": leaf_maps}),
+        "spec": st.dictionaries(
+            keys, st.one_of(plain, st.lists(plain, min_size=1, max_size=3)),
+            max_size=3),
+    }).filter(lambda o: bool(o))
+    resources = st.fixed_dictionaries({
+        "kind": st.just("Pod"),
+        "metadata": st.fixed_dictionaries({}, optional={
+            "labels": st.dictionaries(keys, plain, max_size=3)}),
+    }, optional={"spec": st.dictionaries(keys, plain, max_size=3)})
+
+    @settings(max_examples=80, deadline=None, derandomize=True,
+              suppress_health_check=[HealthCheck.too_slow,
+                                     HealthCheck.filter_too_much])
+    @given(overlay=overlays, resource=resources)
+    def run(overlay, resource):
+        tmpl = lower_mutate_rule(_mutate_rule(overlay))
+        assert tmpl is not None
+        want = strategic_merge(copy.deepcopy(resource),
+                               copy.deepcopy(overlay))
+        assert tmpl.stamp(copy.deepcopy(resource)) == want
+
+    run()
+
+
+def test_triage_negative_batch_cost_one_dispatch():
+    """The untouched-resource guarantee: a batch of triage-negative
+    resources costs exactly one device cross-product and ZERO patcher
+    invocations."""
+    pol = _policy([{
+        "name": "prod-only",
+        "match": {"resources": {"kinds": ["Pod"], "namespaces": ["prod"]}},
+        "mutate": {"patchStrategicMerge":
+                   {"metadata": {"labels": {"env": "prod"}}}},
+    }])
+    eng = TpuEngine(cps=compile_policy_set([pol]))
+    resources = [_pod(name=f"p{i}", ns="dev") for i in range(8)]
+    res = eng.triage_mutate(resources, {"dev": {}})
+    calls = []
+    for ci in range(len(resources)):
+        out = apply_mutations(eng, resources[ci], res.rows_for(ci))
+        calls.append(out.scalar_policies + out.template_rules)
+        assert not out.changed
+    assert sum(calls) == 0, "triage-negative rows must never reach a patcher"
+    assert res.counts()["positive"] == 0
