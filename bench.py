@@ -2056,6 +2056,204 @@ def bench_mutate(n_resources=None, tile=1024):
     }
 
 
+# ---------------------------------------------------------------------------
+# config #17: million-resource endurance soak (reports + watch churn)
+
+
+def bench_soak():
+    """Endurance soak: fill a snapshot with BENCH_SOAK_RESOURCES pods
+    (default 1M), full-scan once, then run churn ticks (upserts +
+    deletes) through the incremental scanner with the crash-consistent
+    report store journaling every delta — under ambient tpu.dispatch +
+    reports.* faults. Asserts the contracts an endurance run must hold:
+    flat RSS, scan-freshness SLO unbreached, zero shadow-verification
+    divergences, an unchanged tick doing ZERO report work, the journal
+    bounded by its compaction cap, and the delta-maintained report
+    state bit-identical to rebuild() at the end."""
+    import gc
+    import tempfile
+
+    from kyverno_tpu.cluster import BackgroundScanService, PolicyCache
+    from kyverno_tpu.cluster.snapshot import ClusterSnapshot
+    from kyverno_tpu.observability.analytics import global_slo
+    from kyverno_tpu.observability.flightrecorder import global_flight
+    from kyverno_tpu.observability.metrics import global_registry as reg
+    from kyverno_tpu.observability.verification import global_verifier
+    from kyverno_tpu.parallel import make_mesh
+    from kyverno_tpu.policies import load_pss_policies
+    from kyverno_tpu.reports import configure_reports
+    from kyverno_tpu.resilience.faults import global_faults
+
+    n = int(os.environ.get("BENCH_SOAK_RESOURCES", "1000000"))
+    ticks = int(os.environ.get("BENCH_SOAK_TICKS", "10"))
+    churn = int(os.environ.get("BENCH_SOAK_CHURN", "2000"))
+    sample_rate = float(os.environ.get("BENCH_SOAK_VERIFY_RATE", "0.001"))
+    journal_max = int(os.environ.get("BENCH_SOAK_JOURNAL_MAX",
+                                     str(1 << 30)))
+    ambient = os.environ.get("BENCH_SOAK_FAULTS", "1").lower() \
+        not in ("0", "", "false", "off")
+    reports_dir = os.environ.get("BENCH_SOAK_REPORTS_DIR") \
+        or tempfile.mkdtemp(prefix="kyverno-soak-reports-")
+    spool_dir = tempfile.mkdtemp(prefix="kyverno-soak-spool-")
+    rng = random.Random(1729)
+
+    def rss_mb():
+        try:
+            with open("/proc/self/status") as f:
+                for line in f:
+                    if line.startswith("VmRSS:"):
+                        return round(int(line.split()[1]) / 1024.0, 1)
+        except OSError:
+            pass
+        return 0.0
+
+    def soak_pod(i, rev=0):
+        # lean on purpose: a million of these must fit in RAM
+        sc = {"securityContext": {"privileged": True}} if i % 9 == 0 else {}
+        return {"apiVersion": "v1", "kind": "Pod",
+                "metadata": {"name": f"soak-{i}", "namespace": f"ns{i % 16}",
+                             "uid": f"soak-u{i}",
+                             "labels": {"rev": str(rev)}},
+                "spec": {"containers": [
+                    {"name": "c", "image": "nginx", **sc}]}}
+
+    store = configure_reports(directory=reports_dir,
+                              journal_max_bytes=journal_max)
+    # bounded spool + sampled shadow verification for the whole run
+    global_flight.configure(sample_rate=sample_rate, spool_dir=spool_dir)
+    global_verifier.configure(rate=1.0)
+
+    cache = PolicyCache()
+    for p in load_pss_policies():
+        cache.set(p)
+    snap = ClusterSnapshot()
+    t0 = time.perf_counter()
+    for i in range(n):
+        snap.upsert(soak_pod(i))
+    t_fill = time.perf_counter() - t0
+
+    svc = BackgroundScanService(snap, cache, mesh=make_mesh())
+    t0 = time.perf_counter()
+    scanned_initial = svc.scan_once(full=True)
+    t_initial = time.perf_counter() - t0
+    rss_series = [rss_mb()]
+
+    # ambient faults for the endurance phase: dispatch failures ride
+    # the breaker/fallback ladder, report faults ride the degrade
+    # paths — all of them must stay invisible in the final state
+    if ambient:
+        global_faults.arm("tpu.dispatch", mode="raise", p=0.01, seed=7)
+        global_faults.arm("reports.fold", mode="raise", p=0.005, seed=11)
+        global_faults.arm("reports.journal", mode="raise", p=0.005, seed=13)
+
+    next_uid = n
+    live_max = n
+    tick_seconds = []
+    folds_churn0 = reg.reports_fold_ops.value()
+    deleted_live = 0
+    try:
+        for _tick in range(ticks):
+            # churn: mostly re-revisioned upserts, some adds + deletes
+            for _ in range(churn):
+                roll = rng.random()
+                if roll < 0.8:
+                    i = rng.randrange(live_max)
+                    snap.upsert(soak_pod(i, rev=_tick + 1))
+                elif roll < 0.9:
+                    snap.upsert(soak_pod(next_uid))
+                    next_uid += 1
+                else:
+                    victim = f"soak-u{rng.randrange(live_max)}"
+                    if snap.get(victim) is not None:
+                        snap.delete(victim)
+                        deleted_live += 1
+            t0 = time.perf_counter()
+            svc.scan_once()
+            tick_seconds.append(time.perf_counter() - t0)
+            gc.collect()
+            rss_series.append(rss_mb())
+    finally:
+        global_faults.disarm()
+
+    churn_folds = reg.reports_fold_ops.value() - folds_churn0
+
+    # the zero-work contract: an unchanged tick freezes every counter
+    folds0 = reg.reports_fold_ops.value()
+    recs0 = reg.reports_journal_records.value()
+    t0 = time.perf_counter()
+    rescanned = svc.scan_once()
+    t_zero = time.perf_counter() - t0
+    zero_fold_delta = reg.reports_fold_ops.value() - folds0
+    zero_journal_delta = reg.reports_journal_records.value() - recs0
+
+    global_verifier.drain()
+    vstats = global_verifier.state()["stats"]
+    store.sync()
+    state = store.state()
+    digest_before = store.digest()
+    rebuild_identical = store.rebuild() == digest_before
+    slo = global_slo.state()
+    breached = list(slo.get("breached", []))
+
+    early = rss_series[1:1 + max(1, len(rss_series) // 3)]
+    late = rss_series[-max(1, len(rss_series) // 3):]
+    rss_flat = (sum(late) / len(late)) <= (sum(early) / len(early)) * 1.15 \
+        + 64.0  # 64MB absolute slack for allocator noise on small runs
+
+    recoveries = {}
+    for reason in ("short_header", "truncated_record", "checksum", "decode",
+                   "duplicate", "snapshot", "replay", "append_error"):
+        v = reg.reports_recoveries.value({"reason": reason})
+        if v:
+            recoveries[reason] = v
+    assertions = {
+        "rebuild_identical": bool(rebuild_identical),
+        "zero_work_unchanged_tick": zero_fold_delta == 0
+        and zero_journal_delta == 0,
+        "scan_freshness_unbreached": "scan_freshness" not in breached,
+        "zero_divergence": vstats["divergences"] == 0,
+        "verifier_checked": vstats["checked"] > 0,
+        "journal_bounded": state["journal_bytes"] <= journal_max,
+        "rss_flat": bool(rss_flat),
+    }
+    store.close()
+    return {
+        "metric": "soak_resources_under_churn",
+        "value": n,
+        "unit": "resources",
+        "vs_baseline": round(n / 1_000_000, 2),
+        "resources": n,
+        "live_resources": state["resources"],
+        "ticks": ticks,
+        "churn_per_tick": churn,
+        "ambient_faults": ambient,
+        "fill_seconds": round(t_fill, 1),
+        "initial_scan_seconds": round(t_initial, 1),
+        "initial_scanned": scanned_initial,
+        "churn_tick_seconds_p50": round(
+            sorted(tick_seconds)[len(tick_seconds) // 2], 3)
+        if tick_seconds else 0.0,
+        "churn_tick_seconds_max": round(max(tick_seconds), 3)
+        if tick_seconds else 0.0,
+        "churn_fold_ops": churn_folds,
+        "deletes": deleted_live,
+        "zero_work_tick": {"rescanned": rescanned,
+                           "seconds": round(t_zero, 3),
+                           "fold_ops_delta": zero_fold_delta,
+                           "journal_records_delta": zero_journal_delta},
+        "rss_mb": rss_series,
+        "reports": {"seq": state["seq"],
+                    "journal_bytes": state["journal_bytes"],
+                    "compactions": state["compactions"],
+                    "recoveries": recoveries},
+        "verification": {"checked": vstats["checked"],
+                         "divergences": vstats["divergences"]},
+        "slo_breached": breached,
+        "assertions": assertions,
+        "ok": all(assertions.values()),
+    }
+
+
 FNS = {
     "scan": lambda: bench_scan(),
     "match": lambda: bench_match(),
@@ -2072,6 +2270,7 @@ FNS = {
     "analyze": lambda: bench_analyze(),
     "fleet": lambda: bench_fleet(),
     "mutate": lambda: bench_mutate(),
+    "soak": lambda: bench_soak(),
 }
 
 
@@ -2394,6 +2593,8 @@ def main():
         config = "columnar"
     if config == "--mutate":  # flag spelling of the mutate config
         config = "mutate"
+    if config == "--soak":  # flag spelling of the endurance soak
+        config = "soak"
     if config in ("capture", "--capture"):
         # replay a spooled flight capture as the admission workload:
         # `python bench.py --capture FILE` (kyverno-tpu flight-dump

@@ -15,6 +15,7 @@ from . import chainsaw as chainsaw_cmd
 from . import flight as flight_cmd
 from . import jp as jp_cmd
 from . import lint as lint_cmd
+from . import report as report_cmd
 from . import serve as serve_cmd
 from . import test as test_cmd
 from . import tools as tools_cmd
@@ -55,6 +56,7 @@ def build_parser() -> argparse.ArgumentParser:
     jp_cmd.add_parser(sub)
     test_cmd.add_parser(sub)
     serve_cmd.add_parser(sub)
+    report_cmd.add_parser(sub)
     tools_cmd.add_parsers(sub)
     flight_cmd.add_parsers(sub)
     chainsaw_cmd.add_parser(sub)

@@ -164,6 +164,23 @@ def add_parser(sub: argparse._SubParsersAction) -> None:
                         "path before LRU eviction + arena compaction "
                         "(default $KYVERNO_TPU_COLUMNAR_ENTRIES or "
                         "131072)")
+    # incremental report store (reports/store.py): scan verdicts fold
+    # into crash-consistent per-namespace reports, journaled when
+    # --reports-dir names a directory
+    p.add_argument("--reports-dir", default=None, metavar="DIR",
+                   help="journal the incremental report store here "
+                        "(length-prefixed CRC'd deltas + compacted "
+                        "snapshots; SIGKILL recovers to the last good "
+                        "prefix). Default $KYVERNO_TPU_REPORTS_DIR or "
+                        "in-memory")
+    p.add_argument("--no-reports", action="store_true",
+                   help="disable the incremental report store: /reports "
+                        "serves only the in-memory aggregator")
+    p.add_argument("--reports-journal-max-bytes", type=int, default=None,
+                   metavar="N",
+                   help="report journal size that triggers a compacted "
+                        "snapshot + journal reset (default "
+                        "$KYVERNO_TPU_REPORTS_JOURNAL_MAX or 4 MiB)")
     # supervised multiprocess encode pool (encode/pool.py): scales the
     # device feed past one Python process, with crash/hang supervision,
     # poison-resource quarantine, and an encode-pool breaker that
@@ -444,6 +461,16 @@ class ControlPlane:
                 store.sync()  # flush mmap arenas for the next process
             except Exception:
                 pass
+        from ..reports import get_report_store
+
+        rstore = get_report_store()
+        if rstore is not None:
+            try:
+                # clean close compacts: an empty journal at next boot
+                # means no replay recovery to count
+                rstore.close()
+            except Exception:
+                pass
         self._cleanup_on_shutdown(self.snapshot, self.lease_store)
 
 
@@ -463,9 +490,20 @@ def _metrics_server(cp: "ControlPlane", port: int) -> ThreadingHTTPServer:
             if self.path == "/metrics":
                 body, ctype = global_registry.http_body()
                 self._send(200, body, ctype)
-            elif self.path == "/reports":
+            elif self.path == "/reports" or self.path.startswith("/reports?"):
+                # default: the in-memory aggregator (admission + scan
+                # rows). ?source=store reads the crash-consistent
+                # incremental store instead — same wgpolicyk8s shape
+                source = cp.aggregator
+                if "source=store" in self.path:
+                    from ..reports import get_report_store
+
+                    source = get_report_store()
+                if source is None:
+                    self._send(404, b"report store not configured")
+                    return
                 reports = {ns or "_cluster": r.to_dict()
-                           for ns, r in cp.aggregator.aggregate().items()}
+                           for ns, r in source.aggregate().items()}
                 self._send(200, json.dumps(reports).encode(), "application/json")
             elif self.path == "/healthz":
                 self._send(200, b"ok")
@@ -602,6 +640,18 @@ def run(args: argparse.Namespace) -> int:
     if store is not None:
         global_oplog.emit("columnar_store_enabled",
                           dir=store.dir or "(memory)")
+    # incremental report store ON by default (in-memory unless
+    # --reports-dir journals it): scan verdicts fold into reports
+    # instead of being re-aggregated per read
+    from ..reports import configure_reports
+
+    rstore = configure_reports(
+        directory=args.reports_dir,
+        enabled=not args.no_reports,
+        journal_max_bytes=args.reports_journal_max_bytes)
+    if rstore is not None:
+        global_oplog.emit("report_store_enabled",
+                          dir=rstore.directory or "(memory)")
     # the encoder pool spawns BEFORE any compile: worker interpreters
     # come up (JAX-free) while the parent pays the XLA build
     from ..encode import configure_pool

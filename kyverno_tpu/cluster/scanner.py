@@ -93,6 +93,17 @@ class BackgroundScanService:
                     store.forget_uid(uid)
             except Exception:
                 pass
+            # the incremental report store unfolds the deleted
+            # resource's rows (and journals the delete) — reports must
+            # never fail a watch event
+            try:
+                from ..reports import get_report_store
+
+                rstore = get_report_store()
+                if rstore is not None:
+                    rstore.delete(uid)
+            except Exception:
+                pass
             # a deleted Namespace invalidates members too (the uid no
             # longer resolves, so derive the name from the uid key)
             if '/Namespace:' in uid:
@@ -251,6 +262,21 @@ class BackgroundScanService:
         ns_labels = self.snapshot.namespace_labels()
         pipe = self._get_pipeline(scanner)
         eng = pipe.engine
+        # incremental report store: scan rows fold keyed by (resource
+        # sha, policy-set content key) — an unchanged rescan is zero
+        # report work, a changed resource touches only its own rows
+        rstore = None
+        rstore_key = ""
+        try:
+            from ..reports import get_report_store
+
+            rstore = get_report_store()
+            if rstore is not None:
+                from ..observability.flightrecorder import policyset_key
+
+                rstore_key = policyset_key(eng)
+        except Exception:
+            rstore = None
 
         def report(chunk, result, evaluated: bool = False) -> None:
             """Report rows for one evaluated (or cache-served) chunk —
@@ -279,6 +305,15 @@ class BackgroundScanService:
                         resource_namespace=meta.get("namespace", ""),
                     ))
                 self.aggregator.put(uid, results)
+                if rstore is not None:
+                    try:
+                        rstore.apply(
+                            uid, h, rstore_key,
+                            meta.get("namespace", "") or "",
+                            res.get("kind", ""), meta.get("name", ""),
+                            [(r.policy, r.rule, r.result) for r in results])
+                    except Exception:
+                        pass  # reports must never fail a scan tick
                 with self._lock:
                     self._scanned[uid] = (h, revision)
             # flight recorder: sampled per-resource records for this
@@ -459,6 +494,11 @@ class BackgroundScanService:
                 store.sync()  # persist mmap arenas once per tick
         except Exception:
             pass
+        if rstore is not None:
+            try:
+                rstore.sync()  # compact the report journal if over cap
+            except Exception:
+                pass
         return total
 
     @staticmethod

@@ -102,7 +102,18 @@ def encode_vocab_host(resources, ns_labels, operations, encode_cfg,
     bit-identity contract survives future encode changes."""
     n = len(resources)
     d = max(pad_multiple, 1)
-    padded = ((max(n, 1) + d - 1) // d) * d
+    # batch-axis bucket: powers of two (floor 16, the engine's
+    # MIN_BUCKET rationale) so arbitrary chunk sizes reuse at most
+    # ~log2 jitted programs. Without this every distinct ragged-tail
+    # size — e.g. each incremental scan tick's dirty count — is a new
+    # N shape and a full XLA recompile (~tens of seconds and hundreds
+    # of MB of program cache per tick on an endurance soak). Pads are
+    # empty resources excluded from the returned ``n``, exactly like
+    # the mesh-multiple pads below.
+    b = 16
+    while b < n:
+        b *= 2
+    padded = ((b + d - 1) // d) * d
     res = list(resources) + [{} for _ in range(padded - n)]
     ops = (list(operations) + [""] * (padded - n)) if operations else None
     # ``encoder`` is the row-encoder seam: ShardedScanner routes its

@@ -255,6 +255,14 @@ class FlightRecorder:
             else _env_float("KYVERNO_TPU_FLIGHT_SAMPLE", 0.01))
         self._default_body_cap = _env_int("KYVERNO_TPU_FLIGHT_BODY_CAP",
                                           65536)
+        # spool bounds: a soak that spools for hours must not grow the
+        # disk without limit — keep the newest N flight-*.ndjson
+        # segments, and rotate divergences.ndjson through N size-capped
+        # segments (dropped segments are counted, never silent)
+        self._default_spool_segments = _env_int(
+            "KYVERNO_TPU_FLIGHT_SPOOL_SEGMENTS", 32)
+        self._default_divergence_bytes = _env_int(
+            "KYVERNO_TPU_FLIGHT_DIVERGENCE_MAX_BYTES", 16 << 20)
         self._clock = clock
         self._metrics = metrics
         self._lock = threading.Lock()
@@ -268,6 +276,8 @@ class FlightRecorder:
         self.sample_rate = self._default_sample
         self.body_cap = self._default_body_cap
         self.spool_dir: Optional[str] = None
+        self.max_spool_segments = self._default_spool_segments
+        self.divergence_max_bytes = self._default_divergence_bytes
         self._ring: deque = deque(maxlen=max(1, self.capacity))  # guarded-by: _lock
         self._seq = 0            # guarded-by: _lock
         self._last_spool_at = -1e9   # guarded-by: _lock
@@ -275,14 +285,18 @@ class FlightRecorder:
         # guarded-by: _lock
         self.stats: Dict[str, Any] = {
             "captured": 0, "sampled_out": 0, "spools": 0,
-            "by_outcome": {}, "divergences_spooled": 0}
+            "by_outcome": {}, "divergences_spooled": 0,
+            "spool_segments_dropped": 0,
+            "divergence_segments_dropped": 0}
 
     # -- configuration
 
     def configure(self, capacity: Optional[int] = None,
                   sample_rate: Optional[float] = None,
                   spool_dir: Optional[str] = None,
-                  body_cap: Optional[int] = None) -> None:
+                  body_cap: Optional[int] = None,
+                  max_spool_segments: Optional[int] = None,
+                  divergence_max_bytes: Optional[int] = None) -> None:
         with self._lock:
             if capacity is not None and capacity != self.capacity:
                 self.capacity = max(1, capacity)
@@ -293,6 +307,10 @@ class FlightRecorder:
                 self.spool_dir = spool_dir or None
             if body_cap is not None:
                 self.body_cap = body_cap
+            if max_spool_segments is not None:
+                self.max_spool_segments = max(0, max_spool_segments)
+            if divergence_max_bytes is not None:
+                self.divergence_max_bytes = max(0, divergence_max_bytes)
 
     def reset(self) -> None:
         """Back to construction defaults (per-test isolation)."""
@@ -547,6 +565,8 @@ class FlightRecorder:
                 "records": ring_n,
                 "spool_dir": self.spool_dir,
                 "body_cap": self.body_cap,
+                "max_spool_segments": self.max_spool_segments,
+                "divergence_max_bytes": self.divergence_max_bytes,
                 "stats": stats}
 
     # -- spool
@@ -582,6 +602,11 @@ class FlightRecorder:
                     fh.write("\n")
         except OSError:
             return None
+        dropped = self._prune_spool_segments(spool_dir)
+        if dropped:
+            with self._lock:
+                self.stats["spool_segments_dropped"] = \
+                    self.stats.get("spool_segments_dropped", 0) + dropped
         try:
             self._registry().flight_spools.inc({"reason": safe})
         except Exception:
@@ -612,14 +637,90 @@ class FlightRecorder:
         try:
             os.makedirs(spool_dir, exist_ok=True)
             path = os.path.join(spool_dir, "divergences.ndjson")
+            dropped = self._rotate_divergences(path)
             with self._lock:
                 self.stats["divergences_spooled"] += 1
+                if dropped:
+                    self.stats["divergence_segments_dropped"] = \
+                        self.stats.get("divergence_segments_dropped", 0) \
+                        + dropped
             with open(path, "a", encoding="utf-8") as fh:
                 json.dump(doc, fh, default=str)
                 fh.write("\n")
         except OSError:
             return None
         return path
+
+    # -- spool bounds (a soak must not grow the disk without limit)
+
+    def _prune_spool_segments(self, spool_dir: str) -> int:
+        """Keep only the newest ``max_spool_segments`` flight-*.ndjson
+        files (names sort chronologically: epoch + spool seq). Returns
+        how many segments were dropped; 0 disables the cap."""
+        keep = self.max_spool_segments
+        if keep <= 0:
+            return 0
+        try:
+            names = sorted(n for n in os.listdir(spool_dir)
+                           if n.startswith("flight-")
+                           and n.endswith(".ndjson"))
+        except OSError:
+            return 0
+        dropped = 0
+        for name in names[:-keep]:
+            try:
+                os.remove(os.path.join(spool_dir, name))
+                dropped += 1
+            except OSError:
+                pass
+        if dropped:
+            try:
+                self._registry().flight_spool_dropped.inc(
+                    {"kind": "segment"}, dropped)
+            except Exception:
+                pass
+        return dropped
+
+    def _rotate_divergences(self, path: str) -> int:
+        """Size-capped rotation for divergences.ndjson: once the live
+        file exceeds ``divergence_max_bytes`` it shifts to ``.1`` (and
+        ``.1``->``.2``, ...), keeping the newest ``max_spool_segments``
+        rotated segments. Returns segments dropped off the end."""
+        cap = self.divergence_max_bytes
+        if cap <= 0:
+            return 0
+        try:
+            if os.path.getsize(path) < cap:
+                return 0
+        except OSError:
+            return 0
+        keep = max(1, self.max_spool_segments)
+        dropped = 0
+        oldest = f"{path}.{keep}"
+        if os.path.exists(oldest):
+            try:
+                os.remove(oldest)
+                dropped = 1
+            except OSError:
+                return 0
+        for i in range(keep - 1, 0, -1):
+            src = f"{path}.{i}"
+            if os.path.exists(src):
+                try:
+                    os.replace(src, f"{path}.{i + 1}")
+                except OSError:
+                    pass
+        try:
+            os.replace(path, f"{path}.1")
+        except OSError:
+            return dropped
+        if dropped:
+            try:
+                self._registry().flight_spool_dropped.inc(
+                    {"kind": "divergence"}, dropped)
+            except Exception:
+                pass
+        return dropped
 
     # -- auto-spool triggers
 

@@ -245,6 +245,39 @@ class MetricsRegistry:
         self.columnar_compactions = self.counter(
             "kyverno_tpu_columnar_compactions_total",
             "columnar arena compactions reclaiming dead rows")
+        # incremental report store (reports/store.py): delta folds over
+        # verdict columns, journaled for crash consistency — the skip
+        # counter is the zero-work proof for unchanged rescans, the
+        # recovery counter labels every journal/snapshot degradation
+        self.reports_resources = self.gauge(
+            "kyverno_reports_resources",
+            "resources with report rows held in the incremental store")
+        self.reports_fold_ops = self.counter(
+            "kyverno_reports_fold_ops_total",
+            "report deltas folded (journal append + count update)")
+        self.reports_fold_skipped = self.counter(
+            "kyverno_reports_fold_skipped_total",
+            "report upserts skipped as zero-work: (resource sha, "
+            "policy-set key) unchanged since the last fold")
+        self.reports_journal_records = self.counter(
+            "kyverno_reports_journal_records_total",
+            "delta records appended to the report journal")
+        self.reports_journal_bytes = self.gauge(
+            "kyverno_reports_journal_bytes",
+            "current report journal size (resets at each compacted "
+            "snapshot)")
+        self.reports_snapshots = self.counter(
+            "kyverno_reports_snapshots_total",
+            "compacted report snapshots written (journal resets)")
+        self.reports_recoveries = self.counter(
+            "kyverno_reports_recoveries_total",
+            "report store recovery/degradation events by reason "
+            "(short_header/truncated_record/checksum/decode/duplicate/"
+            "snapshot/replay/append_error)")
+        self.reports_rebuilds = self.counter(
+            "kyverno_reports_rebuilds_total",
+            "from-scratch derived-count rebuilds (the delta-fold "
+            "bit-identity oracle, also the mid-fold failure fallback)")
         # device-side string matching (tpu/dfa.py): pattern-bearing
         # cells by resolution path — device (DFA verdict stood),
         # confirm (approximate/byte-sensitive hit confirmed by the
@@ -346,6 +379,11 @@ class MetricsRegistry:
         self.flight_spools = self.counter(
             "kyverno_flight_spools_total",
             "flight-recorder ring spools to --flight-dir, by reason")
+        self.flight_spool_dropped = self.counter(
+            "kyverno_flight_spool_dropped_total",
+            "spool segments deleted by size-capped rotation, by kind "
+            "(segment = oldest flight-*.ndjson beyond the keep window, "
+            "divergence = rotated-out divergences.ndjson segment)")
         # continuous shadow verification (observability/verification.py):
         # sampled oracle re-evaluation of recorded decisions — check
         # outcomes, bit-exact divergences (exemplar = originating trace

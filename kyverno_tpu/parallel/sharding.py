@@ -152,8 +152,14 @@ class ShardedScanner:
         return self.mesh.size
 
     def pad(self, n: int) -> int:
+        """The batch size ``n`` resources actually evaluate at: the
+        power-of-two batch bucket (encode/tasks.py encode_vocab_host —
+        bounded jit-shape churn), rounded to the mesh multiple."""
+        b = 16
+        while b < n:
+            b *= 2
         d = self.n_devices
-        return ((n + d - 1) // d) * d
+        return ((b + d - 1) // d) * d
 
     def encode(self, resources, namespace_labels=None, operations=None,
                content_hashes=None):

@@ -33,6 +33,15 @@ the failure physically happens:
     mutate.patch        a policy's template-stamp pass in the mutation
                         coordinator (mutation/coordinator.py) — a raise
                         falls that policy back to the scalar patcher
+    reports.fold        the incremental report delta fold
+                        (reports/store.py) — a raise mid-fold degrades
+                        to a full derived-count rebuild from base rows,
+                        counted, never a wrong report
+    reports.journal     the report WAL append (reports/store.py) — a
+                        raise loses the delta from the journal (counted;
+                        the in-memory fold still lands); corrupt writes
+                        a mangled wire record the replay ladder must
+                        truncate at
 
 Tests (and the ``KYVERNO_TPU_FAULTS`` env knob) arm a site with a
 probability- or count-based trigger and a mode — ``raise``, ``delay``,
@@ -49,9 +58,10 @@ resource chaos tests use it to make ONE resource reliably lethal.
 
 ``corrupt`` is only meaningful at sites that pass their RESULT through
 ``FaultRegistry.corrupt()`` (today: ``tpu.dispatch``, whose verdict
-table is shape-validated downstream). Arming corrupt at a raise/delay
-only site is rejected at arm time — a chaos run that silently injects
-nothing is worse than no chaos run.
+table is shape-validated downstream, and ``reports.journal``, whose
+mangled wire record the WAL replay ladder must truncate at). Arming
+corrupt at a raise/delay only site is rejected at arm time — a chaos
+run that silently injects nothing is worse than no chaos run.
 
 Env syntax (';'-separated site specs)::
 
@@ -87,6 +97,8 @@ SITE_FLEET_PEER_FETCH = "fleet.peer_fetch"
 SITE_FLEET_GOSSIP = "fleet.gossip"
 SITE_MUTATE_TRIAGE = "mutate.triage"
 SITE_MUTATE_PATCH = "mutate.patch"
+SITE_REPORTS_FOLD = "reports.fold"
+SITE_REPORTS_JOURNAL = "reports.journal"
 
 KNOWN_SITES = frozenset({
     SITE_TPU_DISPATCH, SITE_CONTEXT_API_CALL, SITE_CONTEXT_IMAGE_DATA,
@@ -94,13 +106,14 @@ KNOWN_SITES = frozenset({
     SITE_POLICYSET_COMPILE, SITE_ENCODE_POOL_DISPATCH, SITE_ENCODE_WORKER,
     SITE_FLEET_HEARTBEAT, SITE_FLEET_PEER_FETCH, SITE_FLEET_GOSSIP,
     SITE_MUTATE_TRIAGE, SITE_MUTATE_PATCH,
+    SITE_REPORTS_FOLD, SITE_REPORTS_JOURNAL,
 })
 
 MODES = ("raise", "delay", "corrupt", "crash")
 
 # sites whose result flows through FaultRegistry.corrupt(); every other
 # site only has the fire() (raise/delay) hook
-CORRUPTIBLE_SITES = frozenset({SITE_TPU_DISPATCH})
+CORRUPTIBLE_SITES = frozenset({SITE_TPU_DISPATCH, SITE_REPORTS_JOURNAL})
 
 # sites where mode=crash (os._exit) is meaningful: the site runs in a
 # SUPERVISED child process whose death the parent is built to absorb.

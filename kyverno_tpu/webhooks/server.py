@@ -797,6 +797,7 @@ class Handlers:
                 active.engine.cps if active is not None else None),
             "encode_pool": _encode_pool_state(),
             "columnar": _columnar_state(),
+            "reports": _reports_state(),
             "faults_armed": {
                 site: {"mode": spec.mode, "calls": spec.calls,
                        "fired": spec.fired}
@@ -1437,6 +1438,19 @@ def _columnar_state():
         from ..cluster.columnar import store_state
 
         return store_state()
+    except Exception:
+        return {"enabled": False}
+
+
+def _reports_state():
+    """The incremental report store's /debug/state block: resource and
+    namespace counts, journal occupancy, sequence number, and the
+    recovery/compaction stats the soak gate asserts on ({'enabled':
+    False} when off)."""
+    try:
+        from ..reports import reports_state
+
+        return reports_state()
     except Exception:
         return {"enabled": False}
 

@@ -1,0 +1,244 @@
+#!/bin/bash
+# Reports/soak gate: the crash-consistent incremental-report contract,
+# asserted end-to-end (ISSUE 17).
+#
+# Leg 1 drives two full /scan posts against a store-enabled control
+# plane and asserts the SECOND performs zero report-fold work
+# (kyverno_reports_fold_ops_total / kyverno_reports_journal_records_total
+# frozen, fold_skipped grew), the store rows survive on
+# /reports?source=store, and every kyverno_reports_* family passes the
+# exposition-format validator. Leg 2 arms an ambient
+# reports.journal:corrupt fault, crashes the store dirty, and asserts
+# the reload truncates to the last good prefix (recovery counted,
+# delta state == rebuild() bit-identity). Leg 3 is a minutes-scale
+# bench.py --soak with churn + ambient faults whose artifact must
+# self-assert ok. Leg 4 is the real-subprocess SIGKILL-mid-fold chaos
+# test. Leg 5 runs the reports-adjacent test files.
+#
+# Usage: ./scripts_soak_gate.sh
+set -o pipefail
+cd "$(dirname "$0")"
+rc=0
+
+echo "=== leg 1/5: unchanged rescan = zero report work + exposition ==="
+JAX_PLATFORMS=cpu timeout -k 10 600 python - <<'EOF' || rc=1
+import http.client
+import json
+import re
+import sys
+import tempfile
+
+from kyverno_tpu.api.policy import ClusterPolicy
+from kyverno_tpu.cli.serve import ControlPlane
+from kyverno_tpu.observability.metrics import global_registry as reg
+from kyverno_tpu.reports.store import configure_reports
+
+POLICIES = [ClusterPolicy.from_dict({
+    "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+    "metadata": {"name": "soak-gate"},
+    "spec": {"validationFailureAction": "Enforce", "rules": [{
+        "name": "no-privileged",
+        "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+        "validate": {"message": "privileged",
+                     "pattern": {"spec": {"containers": [
+                         {"securityContext": {"privileged": "!true"}}]}}},
+    }]}})]
+
+# same grammar scripts_obs_check.sh enforces (exemplar suffix included)
+METRIC_LINE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? ([0-9.eE+-]+|NaN)"
+    r"( # \{[^{}]*\} [0-9.eE+-]+( [0-9.eE+-]+)?)?$")
+
+
+def get(port, path):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+    conn.request("GET", path)
+    resp = conn.getresponse()
+    body = resp.read()
+    conn.close()
+    return resp.status, body
+
+
+def post(port, path, doc):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+    conn.request("POST", path, json.dumps(doc),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    body = resp.read()
+    conn.close()
+    return resp.status, body
+
+
+store = configure_reports(directory=tempfile.mkdtemp(prefix="soakgate-"))
+cp = ControlPlane(POLICIES, port=0, metrics_port=0)
+cp.start(scan_interval=3600.0)
+met = cp.metrics_server.server_address[1]
+ok = True
+try:
+    for i in range(50):
+        post(met, "/snapshot/upsert", {
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": f"p{i}", "namespace": "default",
+                         "uid": f"gate-{i}"},
+            "spec": {"containers": [{
+                "name": "c", "image": "nginx",
+                "securityContext": {"privileged": i % 4 == 0}}]}})
+    s1, b1 = post(met, "/scan", {"full": True})
+    assert s1 == 200, b1
+    folds0 = reg.reports_fold_ops.value()
+    recs0 = reg.reports_journal_records.value()
+    skips0 = reg.reports_fold_skipped.value()
+    if folds0 == 0 or recs0 == 0:
+        print("FAIL: first scan folded nothing into the report store")
+        ok = False
+    s2, b2 = post(met, "/scan", {"full": True})
+    assert s2 == 200, b2
+    dfolds = reg.reports_fold_ops.value() - folds0
+    drecs = reg.reports_journal_records.value() - recs0
+    dskips = reg.reports_fold_skipped.value() - skips0
+    if dfolds != 0 or drecs != 0:
+        print(f"FAIL: unchanged rescan did report work "
+              f"(folds={dfolds}, journal_records={drecs})")
+        ok = False
+    if dskips != 50:
+        print(f"FAIL: expected 50 zero-work skips, got {dskips}")
+        ok = False
+    st, body = get(met, "/reports?source=store")
+    assert st == 200, body
+    served = json.loads(body)
+    rows = sum(len(r.get("results", [])) for r in served.values())
+    if rows != store.state()["resources"]:
+        print(f"FAIL: /reports?source=store rows {rows} != "
+              f"store resources {store.state()['resources']}")
+        ok = False
+    st, body = get(met, "/debug/state")
+    assert st == 200 and json.loads(body)["reports"]["enabled"] is True
+    st, body = get(met, "/metrics")
+    assert st == 200
+    text = body.decode()
+    fams = ("kyverno_reports_resources", "kyverno_reports_fold_ops_total",
+            "kyverno_reports_fold_skipped_total",
+            "kyverno_reports_journal_records_total",
+            "kyverno_reports_journal_bytes",
+            "kyverno_reports_snapshots_total",
+            "kyverno_reports_recoveries_total",
+            "kyverno_reports_rebuilds_total")
+    for fam in fams:
+        if f"# TYPE {fam} " not in text:
+            print(f"FAIL: missing # TYPE for {fam}")
+            ok = False
+    for line in text.splitlines():
+        if not line.startswith("kyverno_reports_"):
+            continue
+        if not METRIC_LINE.match(line):
+            print(f"FAIL: malformed exposition line: {line!r}")
+            ok = False
+finally:
+    cp.stop()
+if not ok:
+    sys.exit(1)
+print("leg 1 OK: unchanged rescan folds=0 journal_records=0 skips=50, "
+      "store served, exposition clean")
+EOF
+
+echo "=== leg 2/5: ambient reports.journal:corrupt -> prefix recovery ==="
+JAX_PLATFORMS=cpu timeout -k 10 600 python - <<'EOF' || rc=1
+import sys
+import tempfile
+
+from kyverno_tpu.observability.metrics import global_registry as reg
+from kyverno_tpu.reports.store import ReportStore
+from kyverno_tpu.resilience.faults import global_faults
+
+
+def rows(i, result):
+    return [("soak-gate", "no-privileged", result)]
+
+
+def recoveries():
+    return (reg.reports_recoveries.value({"reason": "checksum"})
+            + reg.reports_recoveries.value({"reason": "truncated_record"}))
+
+
+d = tempfile.mkdtemp(prefix="soakgate-corrupt-")
+s1 = ReportStore(directory=d)
+for i in range(3):  # clean prefix before the fault arms
+    s1.apply(f"u{i}", f"sha{i}", "ps", f"ns{i % 2}", "Pod", f"p{i}",
+             rows(i, "fail" if i % 3 == 0 else "pass"))
+# same grammar KYVERNO_TPU_FAULTS uses: corrupt the wire bytes of the
+# next journal record — the header still describes the true payload,
+# so replay sees a framing/checksum mismatch at that record
+global_faults.arm_from_string("reports.journal:corrupt:count=1")
+try:
+    s1.apply("u3", "sha3", "ps", "ns1", "Pod", "p3", rows(3, "pass"))
+finally:
+    global_faults.disarm()
+for i in range(4, 8):  # good records AFTER the mangled one
+    s1.apply(f"u{i}", f"sha{i}", "ps", f"ns{i % 2}", "Pod", f"p{i}",
+             rows(i, "pass"))
+s1.close(compact=False)  # dirty close: crash evidence stays on disk
+
+r0 = recoveries()
+s2 = ReportStore(directory=d)  # must not raise
+r1 = recoveries()
+if r1 != r0 + 1:
+    print(f"FAIL: corrupt record not counted as recovery ({r0} -> {r1})")
+    sys.exit(1)
+n = s2.state()["resources"]
+if n != 3:
+    print(f"FAIL: expected the 3-record good prefix, got {n} resources")
+    sys.exit(1)
+if s2.digest() != s2.rebuild():
+    print("FAIL: recovered prefix state != rebuild() bit-identity")
+    sys.exit(1)
+s2.close()
+s3 = ReportStore(directory=d)  # truncation was durable: clean reopen
+if recoveries() != r1 or s3.state()["resources"] != n:
+    print("FAIL: recovery not durable across a second reopen")
+    sys.exit(1)
+s3.close()
+print(f"leg 2 OK: corrupt journal record -> truncated to {n}/8, "
+      "recovery counted once, digest == rebuild")
+EOF
+
+echo "=== leg 3/5: minutes-scale soak with churn + ambient faults ==="
+JAX_PLATFORMS=cpu BENCH_SOAK_RESOURCES=20000 BENCH_SOAK_TICKS=4 \
+BENCH_SOAK_CHURN=500 BENCH_SOAK_VERIFY_RATE=0.01 \
+timeout -k 10 1800 python - <<'EOF' || rc=1
+import json
+import subprocess
+import sys
+
+proc = subprocess.run([sys.executable, "bench.py", "--soak"],
+                      capture_output=True, text=True, timeout=1700)
+lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+if proc.returncode != 0 or not lines:
+    print(f"FAIL: soak rc={proc.returncode}\n{proc.stderr[-3000:]}")
+    sys.exit(1)
+doc = json.loads(lines[-1])
+bad = [k for k, v in doc["assertions"].items() if v is not True]
+if not doc.get("ok") or bad:
+    print(f"FAIL: soak assertions failed: {bad}")
+    print(json.dumps(doc["assertions"], indent=2))
+    sys.exit(1)
+print(f"leg 3 OK: {doc['value']} resources, "
+      f"{doc['ticks']} churn ticks, all soak assertions held")
+EOF
+
+echo "=== leg 4/5: SIGKILL mid-fold -> bit-identical recovery ==="
+JAX_PLATFORMS=cpu timeout -k 10 900 \
+  python -m pytest tests/test_reports_chaos.py -q \
+  -p no:cacheprovider -p no:xdist -p no:randomly || rc=1
+
+echo "=== leg 5/5: reports + spool + CLI test files ==="
+JAX_PLATFORMS=cpu timeout -k 10 600 \
+  python -m pytest tests/test_reports.py tests/test_flight_recorder.py \
+  tests/test_cli.py -q \
+  -p no:cacheprovider -p no:xdist -p no:randomly || rc=1
+
+if [ $rc -eq 0 ]; then
+  echo "soak gate: ALL LEGS PASSED"
+else
+  echo "soak gate: FAILURES (rc=$rc)"
+fi
+exit $rc

@@ -85,6 +85,18 @@ def _fresh_flight_recorder():
     global_oplog.reset()
 
 
+# the incremental report store (reports/store.py) is process-global
+# like the columnar store; a test that configures a journal dir must
+# not leak report rows into the next test's summaries
+@pytest.fixture(autouse=True)
+def _fresh_reports():
+    from kyverno_tpu.reports import reset_reports
+
+    reset_reports()
+    yield
+    reset_reports()
+
+
 # the fleet manager (fleet/manager.py) is process-global like the
 # caches: a test that configures replicas must not leak membership,
 # peer breakers, or the verdict-cache fan-out hook into the next test

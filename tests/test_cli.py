@@ -312,3 +312,52 @@ def test_jp_query(capsys):
         sys.stdin = sys.__stdin__
     assert rc == 0
     assert json.loads(capsys.readouterr().out) == 6
+
+
+def test_serve_help_covers_reports_flags(capsys):
+    """The report store's knobs (reports/store.py) must be
+    operator-visible: journal directory, kill switch, compaction cap."""
+    with pytest.raises(SystemExit) as exc:
+        main(["serve", "--help"])
+    assert exc.value.code == 0
+    out = capsys.readouterr().out
+    for flag in ("--reports-dir", "--no-reports",
+                 "--reports-journal-max-bytes"):
+        assert flag in out
+
+
+def test_report_help(capsys):
+    with pytest.raises(SystemExit) as exc:
+        main(["report", "--help"])
+    assert exc.value.code == 0
+    out = capsys.readouterr().out
+    for flag in ("--json", "--summary", "--rebuild-check"):
+        assert flag in out
+
+
+def test_report_bad_dir_exits_2(tmp_path, capsys):
+    assert main(["report", str(tmp_path / "nope")]) == 2
+    assert "not a reports directory" in capsys.readouterr().err
+
+
+def test_report_reads_journal_dir(tmp_path, capsys):
+    from kyverno_tpu.reports import ReportStore
+
+    d = str(tmp_path / "r")
+    store = ReportStore(directory=d)
+    store.apply("u1", "h1", "ps", "prod", "Pod", "api",
+                [("no-privileged", "privileged", "fail")])
+    store.apply("u2", "h2", "ps", "dev", "Pod", "web",
+                [("no-privileged", "privileged", "pass")])
+    store.close(compact=False)  # SIGKILL-shaped: journal carries all
+
+    assert main(["report", d, "--rebuild-check", "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["rebuild_identical"] is True
+    assert doc["state"]["resources"] == 2
+    assert doc["summary"]["fail"] == 1 and doc["summary"]["pass"] == 1
+    assert doc["reports"]["prod"]["summary"]["fail"] == 1
+
+    assert main(["report", d, "--summary"]) == 0
+    out = capsys.readouterr().out
+    assert "fail: 1" in out and "pass: 1" in out

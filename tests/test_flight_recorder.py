@@ -454,3 +454,93 @@ def test_fault_flip_rejected_outside_corrupt_mode():
     assert n == 1
     assert global_faults.armed()["tpu.dispatch"].flip is True
     global_faults.disarm()
+
+
+# ---------------------------------------------------------------------------
+# bounded spool (an endurance soak must not grow the disk without limit)
+
+
+def test_spool_segment_cap_drops_oldest(tmp_path):
+    from kyverno_tpu.observability.metrics import global_registry
+
+    spool = tmp_path / "spool"
+    global_flight.configure(sample_rate=1.0, spool_dir=str(spool),
+                            max_spool_segments=3)
+    assert global_flight.state()["max_spool_segments"] == 3
+    rec = FlightRecord("admission", "allowed", "validate",
+                       {"metadata": {"name": "p"}},
+                       [(("p", "r"), 0)])
+    global_flight.record(rec)
+    before = global_registry.flight_spool_dropped.value({"kind": "segment"})
+    paths = [global_flight.spool(reason=f"r{i}", force=True)
+             for i in range(7)]
+    assert all(paths)
+    names = sorted(n for n in os.listdir(spool) if n.startswith("flight-"))
+    assert len(names) == 3, names
+    # the SURVIVORS are the newest three segments
+    assert [n.rsplit("-", 1)[-1] for n in names] == \
+        ["r4.ndjson", "r5.ndjson", "r6.ndjson"]
+    assert global_flight.state()["stats"]["spool_segments_dropped"] == 4
+    assert global_registry.flight_spool_dropped.value({"kind": "segment"}) \
+        == before + 4
+    # each survivor still loads as a valid capture
+    assert load_capture(str(spool / names[-1]))
+
+
+def test_spool_segment_cap_zero_disables(tmp_path):
+    spool = tmp_path / "spool"
+    global_flight.configure(sample_rate=1.0, spool_dir=str(spool),
+                            max_spool_segments=0)
+    global_flight.record(FlightRecord("admission", "allowed", "validate",
+                       {"metadata": {"name": "p"}},
+                       [(("p", "r"), 0)]))
+    for i in range(5):
+        global_flight.spool(reason=f"r{i}", force=True)
+    names = [n for n in os.listdir(spool) if n.startswith("flight-")]
+    assert len(names) == 5
+    assert global_flight.state()["stats"]["spool_segments_dropped"] == 0
+
+
+def test_divergence_spool_rotates_at_size_cap(tmp_path):
+    from kyverno_tpu.observability.metrics import global_registry
+
+    spool = tmp_path / "spool"
+    global_flight.configure(sample_rate=1.0, spool_dir=str(spool),
+                            max_spool_segments=2,
+                            divergence_max_bytes=400)
+    assert global_flight.state()["divergence_max_bytes"] == 400
+    before = global_registry.flight_spool_dropped.value(
+        {"kind": "divergence"})
+    rows = [(("p", "r"), 2)]
+    exp = [(("p", "r"), 0)]
+    for i in range(40):  # each doc ~150B: forces several rotations
+        assert global_flight.spool_divergence(
+            {"seq": i, "resource": {"metadata": {"name": f"pod-{i}"}}},
+            exp, rows)
+    live = spool / "divergences.ndjson"
+    assert live.exists()
+    # rotation bounds everything: live file stays near the cap, only
+    # the newest `max_spool_segments` rotated segments survive
+    assert live.stat().st_size <= 400 + 300
+    rotated = sorted(n for n in os.listdir(spool)
+                     if n.startswith("divergences.ndjson."))
+    assert rotated == ["divergences.ndjson.1", "divergences.ndjson.2"]
+    dropped = global_flight.state()["stats"]["divergence_segments_dropped"]
+    assert dropped > 0
+    assert global_registry.flight_spool_dropped.value(
+        {"kind": "divergence"}) == before + dropped
+    # every surviving line is still valid NDJSON evidence
+    for line in live.read_text().splitlines():
+        assert json.loads(line)["kind"] == "divergence"
+
+
+def test_divergence_rotation_zero_cap_disables(tmp_path):
+    spool = tmp_path / "spool"
+    global_flight.configure(sample_rate=1.0, spool_dir=str(spool),
+                            divergence_max_bytes=0)
+    for i in range(20):
+        global_flight.spool_divergence({"seq": i}, [(("p", "r"), 0)],
+                                       [(("p", "r"), 1)])
+    assert not [n for n in os.listdir(spool)
+                if n.startswith("divergences.ndjson.")]
+    assert len((spool / "divergences.ndjson").read_text().splitlines()) == 20

@@ -1,0 +1,300 @@
+"""Incremental report store (reports/store.py + reports/journal.py).
+
+The crash-consistency contract under test:
+
+- every delta path (apply / skip / delete / fold-fault degradation)
+  leaves state bit-identical to a from-scratch ``rebuild()``;
+- a journal/snapshot round trip (clean or SIGKILL-shaped) reproduces
+  the digest exactly;
+- each rung of the journal corruption ladder (truncated record,
+  bit-flipped checksum, short header, duplicate-delta replay) recovers
+  to the last good prefix with the right
+  ``kyverno_reports_recoveries_total{reason}`` label — degraded, never
+  a wrong report (mirrors the columnar 4-corruption-mode pattern);
+- the scanner feed: an unchanged rescan does ZERO report work.
+"""
+
+import json
+import os
+
+import pytest
+
+from kyverno_tpu.observability.metrics import global_registry as reg
+from kyverno_tpu.reports import (ReportStore, configure_reports,
+                                 get_report_store, reports_state,
+                                 reset_reports)
+from kyverno_tpu.reports import journal as jn
+from kyverno_tpu.resilience.faults import (SITE_REPORTS_FOLD,
+                                           SITE_REPORTS_JOURNAL,
+                                           global_faults)
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    global_faults.disarm()
+    yield
+    global_faults.disarm()
+
+
+def _rows(i, result="pass"):
+    return [("pol-a", "r1", result), ("pol-b", "r2", "fail" if i % 3 else "pass")]
+
+
+def _fill(store, n=8, sha="h0"):
+    for i in range(n):
+        store.apply(f"u{i}", sha, "ps1", f"ns{i % 3}", "Pod", f"pod-{i}",
+                    _rows(i))
+
+
+# -- fold vs rebuild (the bit-identity oracle)
+
+
+def test_delta_paths_match_rebuild(tmp_path):
+    s = ReportStore(directory=str(tmp_path / "r"))
+    _fill(s, 10)
+    s.apply("u3", "h1", "ps1", "ns0", "Pod", "pod-3", _rows(3, "fail"))
+    s.delete("u7")
+    s.apply("u99", "h0", "ps1", "", "Namespace", "prod",
+            [("pol-a", "r1", "pass")])
+    before = s.digest()
+    assert s.rebuild() == before
+    assert s.verify_rebuild()
+    # derived counts landed where rebuild puts them
+    assert s.summary()["pass"] >= 1
+    assert "" in s.namespaces() or "ns0" in s.namespaces()
+
+
+def test_unchanged_apply_is_zero_work(tmp_path):
+    s = ReportStore(directory=str(tmp_path / "r"))
+    _fill(s, 5)
+    folds0 = reg.reports_fold_ops.value()
+    skips0 = reg.reports_fold_skipped.value()
+    recs0 = reg.reports_journal_records.value()
+    jbytes = s.state()["journal_bytes"]
+    _fill(s, 5)  # same (sha, ps_key) for every uid
+    assert reg.reports_fold_ops.value() == folds0
+    assert reg.reports_journal_records.value() == recs0
+    assert reg.reports_fold_skipped.value() == skips0 + 5
+    assert s.state()["journal_bytes"] == jbytes
+    # a changed policy-set key is NOT zero work: reports must refresh
+    s.apply("u0", "h0", "ps2", "ns0", "Pod", "pod-0", _rows(0))
+    assert reg.reports_fold_ops.value() == folds0 + 1
+
+
+def test_delete_unfolds_and_journal_replays(tmp_path):
+    d = str(tmp_path / "r")
+    s = ReportStore(directory=d)
+    _fill(s, 6)
+    s.delete("u2")
+    assert s.state()["resources"] == 5
+    digest = s.digest()
+    # SIGKILL-shaped close: no compaction, the journal carries history
+    s.close(compact=False)
+    r0 = reg.reports_recoveries.value({"reason": jn.REASON_REPLAY})
+    s2 = ReportStore(directory=d)
+    assert s2.digest() == digest
+    assert s2.rebuild() == digest
+    assert reg.reports_recoveries.value({"reason": jn.REASON_REPLAY}) == r0 + 1
+
+
+def test_clean_close_compacts_no_replay(tmp_path):
+    d = str(tmp_path / "r")
+    s = ReportStore(directory=d)
+    _fill(s, 6)
+    digest = s.digest()
+    s.close()  # compacts: snapshot written, journal reset
+    assert os.path.getsize(os.path.join(d, jn.JOURNAL_NAME)) == 0
+    r0 = reg.reports_recoveries.value({"reason": jn.REASON_REPLAY})
+    s2 = ReportStore(directory=d)
+    assert s2.digest() == digest
+    assert reg.reports_recoveries.value({"reason": jn.REASON_REPLAY}) == r0
+
+
+def test_compaction_threshold_snapshots(tmp_path):
+    d = str(tmp_path / "r")
+    s = ReportStore(directory=d, journal_max_bytes=4096)
+    snaps0 = reg.reports_snapshots.value()
+    for i in range(200):
+        s.apply(f"u{i}", f"h{i}", "ps1", "ns0", "Pod", f"pod-{i}", _rows(i))
+        s.sync()
+    assert reg.reports_snapshots.value() > snaps0
+    assert s.state()["journal_bytes"] <= 2 * 4096
+    digest = s.digest()
+    s.close(compact=False)
+    assert ReportStore(directory=d).digest() == digest
+
+
+# -- the journal corruption ladder (mirrors test_columnar's 4 modes)
+
+
+@pytest.mark.parametrize("corruption", ["truncated_record", "checksum",
+                                        "short_header", "duplicate"])
+def test_journal_corruption_recovers_to_prefix(tmp_path, corruption):
+    d = str(tmp_path / "r")
+    s = ReportStore(directory=d)
+    _fill(s, 4, sha="base")  # seq 1..4
+    prefix_digest_rows = dict(s._rows)  # base rows before the suffix
+    s.apply("u9", "h9", "ps1", "ns9", "Pod", "pod-9", _rows(9))  # seq 5
+    s.close(compact=False)
+    jpath = os.path.join(d, jn.JOURNAL_NAME)
+    size = os.path.getsize(jpath)
+    if corruption == "truncated_record":
+        # tear the LAST record: half its bytes never hit disk
+        with open(jpath, "r+b") as f:
+            f.truncate(size - 7)
+    elif corruption == "checksum":
+        # flip bytes INSIDE the last record's payload
+        with open(jpath, "r+b") as f:
+            f.seek(size - 12)
+            f.write(b"\xff\xff\xff\xff")
+    elif corruption == "short_header":
+        # a torn append that only got 3 header bytes out
+        with open(jpath, "ab") as f:
+            f.write(b"\x01\x02\x03")
+    else:  # duplicate: re-append seq 1's delta verbatim
+        payload = jn.canonical(
+            {"op": "put", "uid": "u0", "sha": "base", "ps": "ps1",
+             "ns": "ns0", "kind": "Pod", "name": "pod-0",
+             "rows": [[p, r, c] for p, r, c in _rows(0)],
+             "seq": 1}).encode()
+        with open(jpath, "ab") as f:
+            f.write(jn.frame(payload))
+    before = reg.reports_recoveries.value({"reason": corruption})
+    s2 = ReportStore(directory=d)  # must not raise
+    assert reg.reports_recoveries.value({"reason": corruption}) \
+        == before + 1
+    # recovered state is bit-identical to rebuild() over what survived
+    assert s2.digest() == s2.rebuild()
+    if corruption in ("duplicate", "short_header"):
+        # the damage sits AFTER the last good record: every delta
+        # survives (the duplicate skipped, the torn header dropped)
+        assert s2.state()["resources"] == 5
+    else:
+        # the last record died: the surviving prefix is the 4 base rows
+        assert set(s2._rows) == set(prefix_digest_rows)
+    # and the journal was cleaned up (framing damage truncated in
+    # place; the duplicate record swept by compaction): a second open
+    # counts no new corruption recovery
+    s2.close(compact=(corruption == "duplicate"))
+    mid = reg.reports_recoveries.value({"reason": corruption})
+    s3 = ReportStore(directory=d)
+    assert reg.reports_recoveries.value({"reason": corruption}) == mid
+    assert s3.digest() == s3.rebuild()
+
+
+def test_corrupt_snapshot_starts_cold(tmp_path):
+    d = str(tmp_path / "r")
+    s = ReportStore(directory=d)
+    _fill(s, 4)
+    s.close()  # writes the snapshot
+    with open(os.path.join(d, jn.SNAPSHOT_NAME), "w") as f:
+        f.write("{not json")
+    before = reg.reports_recoveries.value({"reason": jn.REASON_SNAPSHOT})
+    s2 = ReportStore(directory=d)
+    assert reg.reports_recoveries.value({"reason": jn.REASON_SNAPSHOT}) \
+        == before + 1
+    # cold, consistent, and both stale files discarded — never wrong
+    assert s2.state()["resources"] == 0
+    assert s2.digest() == s2.rebuild()
+
+
+def test_tampered_snapshot_checksum_rejected(tmp_path):
+    d = str(tmp_path / "r")
+    s = ReportStore(directory=d)
+    _fill(s, 3)
+    s.close()
+    path = os.path.join(d, jn.SNAPSHOT_NAME)
+    with open(path) as f:
+        body = json.load(f)
+    body["rows"][0][3] = "evil-ns"  # edit without recomputing checksum
+    with open(path, "w") as f:
+        json.dump(body, f)
+    before = reg.reports_recoveries.value({"reason": jn.REASON_SNAPSHOT})
+    s2 = ReportStore(directory=d)
+    assert reg.reports_recoveries.value({"reason": jn.REASON_SNAPSHOT}) \
+        == before + 1
+    assert s2.state()["resources"] == 0
+
+
+# -- fault sites
+
+
+def test_fold_fault_degrades_to_rebuild(tmp_path):
+    s = ReportStore(directory=str(tmp_path / "r"))
+    _fill(s, 4)
+    rebuilds0 = reg.reports_rebuilds.value()
+    global_faults.arm(SITE_REPORTS_FOLD, mode="raise", count=1)
+    s.apply("u0", "hX", "ps1", "ns0", "Pod", "pod-0", _rows(0, "fail"))
+    global_faults.disarm(SITE_REPORTS_FOLD)
+    assert reg.reports_rebuilds.value() == rebuilds0 + 1
+    # the degraded fold still landed the delta, bit-identically
+    assert s.digest() == s.rebuild()
+    assert any(r == [list(t) for t in _rows(0, "fail")][0]
+               for r in s._rows["u0"][5])
+
+
+def test_journal_fault_counts_append_error(tmp_path):
+    s = ReportStore(directory=str(tmp_path / "r"))
+    a0 = reg.reports_recoveries.value({"reason": jn.REASON_APPEND_ERROR})
+    global_faults.arm(SITE_REPORTS_JOURNAL, mode="raise", count=1)
+    s.apply("u0", "h0", "ps1", "ns0", "Pod", "pod-0", _rows(0))
+    global_faults.disarm(SITE_REPORTS_JOURNAL)
+    assert reg.reports_recoveries.value({"reason": jn.REASON_APPEND_ERROR}) \
+        == a0 + 1
+    # the in-memory fold still landed (degraded durability, not truth)
+    assert s.state()["resources"] == 1
+    assert s.digest() == s.rebuild()
+
+
+def test_journal_corrupt_fault_truncates_at_replay(tmp_path):
+    d = str(tmp_path / "r")
+    s = ReportStore(directory=d)
+    _fill(s, 2)  # two good records
+    global_faults.arm(SITE_REPORTS_JOURNAL, mode="corrupt", count=1)
+    s.apply("u9", "h9", "ps1", "ns9", "Pod", "pod-9", _rows(9))  # mangled
+    global_faults.disarm(SITE_REPORTS_JOURNAL)
+    _fill(s, 4)  # two more good records AFTER the bad one
+    s.close(compact=False)
+    before_ck = reg.reports_recoveries.value({"reason": jn.REASON_CHECKSUM})
+    before_tr = reg.reports_recoveries.value({"reason": jn.REASON_TRUNCATED})
+    s2 = ReportStore(directory=d)
+    # the mangled record broke framing: replay truncated at it (either
+    # rung depending on how the short write landed), prefix survived
+    assert (reg.reports_recoveries.value({"reason": jn.REASON_CHECKSUM})
+            + reg.reports_recoveries.value({"reason": jn.REASON_TRUNCATED})) \
+        == before_ck + before_tr + 1
+    assert set(s2._rows) == {"u0", "u1"}
+    assert s2.digest() == s2.rebuild()
+
+
+# -- process-global wiring
+
+
+def test_configure_reports_singleton(tmp_path):
+    reset_reports()
+    assert get_report_store() is None
+    assert reports_state() == {"enabled": False}
+    store = configure_reports(directory=str(tmp_path / "r"))
+    assert get_report_store() is store
+    assert reports_state()["enabled"] is True
+    assert reports_state()["persistent"] is True
+    configure_reports(enabled=False)
+    assert get_report_store() is None
+    # in-memory mode: enabled, not persistent
+    store = configure_reports()
+    assert store is not None and not store.state()["persistent"]
+    reset_reports()
+
+
+def test_store_aggregate_matches_wgpolicy_shape(tmp_path):
+    s = ReportStore()
+    s.apply("u1", "h1", "ps", "prod", "Pod", "api", [("p", "r", "fail")])
+    s.apply("u2", "h2", "ps", "", "Namespace", "prod", [("p", "r", "pass")])
+    reports = s.aggregate()
+    assert reports["prod"].kind == "PolicyReport"
+    assert reports[""].kind == "ClusterPolicyReport"
+    doc = reports["prod"].to_dict()
+    assert doc["summary"]["fail"] == 1
+    res = doc["results"][0]["resources"][0]
+    assert res["name"] == "api" and res["namespace"] == "prod"
+    assert res["uid"] == "u1"
