@@ -276,6 +276,10 @@ def add_parser(sub: argparse._SubParsersAction) -> None:
                    help="fixed shard count the resource keyspace is "
                         "rendezvous-hashed into (must match across "
                         "the fleet)")
+    p.add_argument("--fleet-telemetry-max-age", type=float, default=30.0,
+                   help="telemetry snapshots older than this many "
+                        "seconds are rejected as stale by the leader's "
+                        "aggregation fold (0 disables the age check)")
     p.add_argument("--distributed", action="store_true",
                    help="bring up jax.distributed (coordinator/rank "
                         "from the standard JAX env) and shard device "
@@ -716,7 +720,8 @@ def run(args: argparse.Namespace) -> int:
             listen_port=args.fleet_listen,
             peers=peers,
             lease_s=args.fleet_lease_s,
-            num_shards=args.fleet_shards)
+            num_shards=args.fleet_shards,
+            telemetry_max_age_s=args.fleet_telemetry_max_age)
     elif args.fleet_peers or args.replica_id:
         print("--fleet-peers/--replica-id need --fleet-listen "
               "(the peer protocol endpoint)", file=sys.stderr)

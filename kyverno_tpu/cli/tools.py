@@ -404,10 +404,61 @@ def _render_top(rules: Dict[str, Any], util: Dict[str, Any],
     return "\n".join(lines)
 
 
+def _render_fleet(fleet: Dict[str, Any]) -> str:
+    """The fleet health matrix + rollup, from a /debug/fleet doc. Any
+    replica can serve it: the leader computes the rollup and gossips
+    it back on heartbeats."""
+    lines: List[str] = []
+    if not fleet.get("enabled"):
+        return "fleet: disabled (serve without --fleet-listen)"
+    mem = fleet.get("membership") or {}
+    tel = fleet.get("telemetry") or {}
+    rollup = tel.get("rollup") or {}
+    lines.append(
+        f"fleet — replica {mem.get('replica_id', '?')}"
+        f"  epoch {mem.get('epoch', '?')}"
+        f"  live {len(mem.get('live') or [])}"
+        f"  leader {'yes' if tel.get('is_leader') else 'no'}")
+    if not rollup:
+        lines.append("no rollup yet (waiting for the leader's first "
+                     "telemetry fold)")
+        return "\n".join(lines)
+    age = tel.get("rollup_age_s")
+    totals = rollup.get("totals") or {}
+    burn = "  ".join(f"burn[{w}]={v:.2f}"
+                     for w, v in sorted((rollup.get("burn") or {}).items()))
+    lines.append(
+        f"rollup by {rollup.get('computed_by', '?')}"
+        f" ({age if age is not None else '?'}s old)"
+        f"  admissions {totals.get('admission_requests', 0):.0f}"
+        f"  divergences {totals.get('verification_divergences', 0):.0f}"
+        f"  {'DEGRADED' if rollup.get('degraded') else 'healthy'}")
+    if burn:
+        lines.append(burn)
+    rejects = rollup.get("rejects") or {}
+    if rejects:
+        lines.append("snapshot rejects: " + ", ".join(
+            f"{r}={n}" for r, n in sorted(rejects.items())))
+    lines.append("")
+    lines.append(f"{'REPLICA':<16}{'SEQ':>6}{'AGE':>8}{'BURN':>8}"
+                 f"{'DIVERG':>8}{'SHARDS':>8}{'HIT%':>7}")
+    for rid, row in sorted((rollup.get("replicas") or {}).items()):
+        hit = row.get("cache_hit_rate")
+        lines.append(
+            f"{rid[:15]:<16}{row.get('seq', 0):>6}"
+            f"{row.get('snapshot_age_s', 0.0):>7.1f}s"
+            f"{row.get('slo_burn', 0.0):>8.2f}"
+            f"{row.get('divergences', 0):>8.0f}"
+            f"{row.get('shards_owned') if row.get('shards_owned') is not None else '-':>8}"
+            f"{f'{hit * 100:.0f}' if hit is not None else '-':>7}")
+    return "\n".join(lines)
+
+
 def run_top(args: argparse.Namespace) -> int:
     """`kyverno-tpu top` — poll a running serve's metrics-port debug
-    surface (/debug/rules, /debug/utilization, /readyz) and render a
-    live terminal view of the policy observatory."""
+    surface (/debug/rules, /debug/utilization, /readyz — plus
+    /debug/fleet with --fleet) and render a live terminal view of the
+    policy observatory."""
     import time as _time
 
     iterations = args.iterations
@@ -421,6 +472,9 @@ def run_top(args: argparse.Namespace) -> int:
                 ready = _http_get_json(args.host, args.port, "/readyz")
             except Exception:
                 ready = {}  # 503 still renders; readiness is advisory
+            fleet = None
+            if getattr(args, "fleet", False):
+                fleet = _http_get_json(args.host, args.port, "/debug/fleet")
         except Exception as e:
             print(f"cannot reach serve metrics port "
                   f"{args.host}:{args.port}: {e}", file=sys.stderr)
@@ -428,6 +482,9 @@ def run_top(args: argparse.Namespace) -> int:
         if not args.no_clear:
             sys.stdout.write("\x1b[2J\x1b[H")
         print(_render_top(rules, util, ready, args.top))
+        if fleet is not None:
+            print()
+            print(_render_fleet(fleet))
         i += 1
         if iterations and i >= iterations:
             return 0
@@ -493,4 +550,7 @@ def add_parsers(sub) -> None:
     top.add_argument("--no-clear", action="store_true",
                      help="append frames instead of clearing the screen "
                           "(log-friendly)")
+    top.add_argument("--fleet", action="store_true",
+                     help="also render the fleet health matrix and "
+                          "telemetry rollup from /debug/fleet")
     top.set_defaults(func=run_top)

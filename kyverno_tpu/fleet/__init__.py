@@ -22,19 +22,32 @@ one logical engine:
   or truncated peer answer is a MISS, never a wrong verdict.
 - **manager** (manager.py): ties the above into one FleetManager the
   scanner, webhooks, and /debug/fleet consume.
+- **telemetry** (telemetry.py): the fleet observability plane — every
+  replica serves a checksummed telemetry snapshot on
+  ``/fleet/telemetry``; the leader pulls on the heartbeat cadence,
+  folds snapshots through a trust ladder (checksum -> schema ->
+  replay/ordering -> staleness, rejects dropped-and-counted) into the
+  monotonic ``kyverno_fleet_agg_*`` families and a fleet-wide SLO
+  burn, and gossips the rollup back so any replica answers
+  ``/debug/fleet``. Peer RPCs carry the caller's trace context, so a
+  peer-served admission is ONE connected trace across replicas.
 
 Degradation ladder: peer fetch -> local compute -> scalar oracle.
 Every remote interaction runs under a per-peer circuit breaker and a
 deadline budget (fault sites fleet.heartbeat / fleet.peer_fetch /
-fleet.gossip), so a dead or partitioned peer costs one bounded
-timeout, never a retry storm and never a missing verdict.
+fleet.gossip / fleet.telemetry), so a dead or partitioned peer costs
+one bounded timeout, never a retry storm and never a missing verdict.
 """
 
 from .manager import (FleetConfig, FleetManager, configure_fleet,
                       get_fleet, reset_fleet)
 from .shards import rendezvous_owner, shard_of
+from .telemetry import (TELEMETRY_SCHEMA_VERSION, TelemetryAggregator,
+                        TelemetrySource, snapshot_checksum)
 
 __all__ = [
     "FleetConfig", "FleetManager", "configure_fleet", "get_fleet",
     "reset_fleet", "shard_of", "rendezvous_owner",
+    "TELEMETRY_SCHEMA_VERSION", "TelemetryAggregator",
+    "TelemetrySource", "snapshot_checksum",
 ]

@@ -33,6 +33,8 @@ import numpy as np
 from .membership import FleetMembership
 from .peering import CacheKey, PeerCacheClient, PushQueue
 from .shards import DEFAULT_NUM_SHARDS, owned_shards, shard_of
+from .telemetry import (TELEMETRY_SCHEMA_VERSION, TelemetryAggregator,
+                        TelemetrySource)
 
 
 @dataclass(frozen=True)
@@ -48,6 +50,9 @@ class FleetConfig:
     push_interval_s: float = 0.2
     push_max_batch: int = 256
     fetch_max_keys: int = 1024
+    # a telemetry snapshot older than this is history, not state — the
+    # aggregator's staleness rung drops it (0 disables the age check)
+    telemetry_max_age_s: float = 30.0
 
     @property
     def heartbeat_s(self) -> float:
@@ -101,6 +106,14 @@ class FleetManager:
         # peers added after construction (tests wire ephemeral ports;
         # production uses config.peers + heartbeat discovery)
         self._extra_peers: Set[str] = set()             # guarded-by: _lock
+        # telemetry plane: every replica SERVES snapshots; only the
+        # leader PULLS and folds them, then gossips the rollup back so
+        # any replica can answer /debug/fleet with the fleet view
+        self.telemetry = TelemetrySource(self)
+        self.aggregator = TelemetryAggregator(
+            metrics=metrics, clock=clock,
+            max_age_s=config.telemetry_max_age_s)
+        self._rollup: Optional[Dict[str, Any]] = None   # guarded-by: _lock
 
     def _registry(self):
         if self._metrics is None:
@@ -184,6 +197,7 @@ class FleetManager:
         changed, _epoch, _live = self.membership.note_epoch_if_changed()
         if changed:
             self._recompute_shards(reason="membership")
+        self._telemetry_round()
         self._publish_gauges()
 
     def add_peers(self, *urls: str) -> None:
@@ -220,6 +234,14 @@ class FleetManager:
         }
         if leaving:
             doc["leaving"] = True
+        if self.membership.is_leader():
+            # the leader piggybacks its fleet rollup on every heartbeat
+            # it SENDS, so followers hold the fleet view without a
+            # second RPC (and serve /debug/fleet themselves)
+            rollup = self.rollup_view()
+            if rollup is not None:
+                doc = dict(doc)
+                doc["rollup"] = rollup
         for rid, url in self._heartbeat_targets():
             link = self.client.link(rid or url, url)
             resp = link.call("/fleet/heartbeat", doc,
@@ -250,6 +272,8 @@ class FleetManager:
             for other, other_url in (resp.get("members") or {}).items():
                 # discovery only — a third-party view never renews
                 self.membership.learn_url(other, other_url)
+            # the response may carry the leader's rollup back at us
+            self._absorb_rollup(resp.get("rollup"))
 
     def on_heartbeat(self, doc: Dict[str, Any]) -> Dict[str, Any]:
         """Server side of /fleet/heartbeat."""
@@ -263,11 +287,98 @@ class FleetManager:
         changed, _epoch, _live = self.membership.note_epoch_if_changed()
         if changed:
             self._recompute_shards(reason="membership")
+        self._absorb_rollup(doc.get("rollup"))
         members = self.membership.known_urls()
-        return {"replica_id": self.config.replica_id,
+        resp = {"replica_id": self.config.replica_id,
                 "lease_s": self.config.lease_s,
                 "epoch": self.membership.epoch,
                 "members": members}
+        if self.membership.is_leader():
+            rollup = self.rollup_view()
+            if rollup is not None:
+                resp["rollup"] = rollup
+        return resp
+
+    # -- telemetry plane
+
+    def _telemetry_round(self) -> None:
+        """Leader-only fold on the heartbeat cadence: ingest our own
+        snapshot, pull every live peer's ``/fleet/telemetry``, run each
+        through the aggregator's trust ladder, then recompute and store
+        the rollup (heartbeats gossip it back out). Followers do
+        nothing here — they serve snapshots and absorb rollups."""
+        if not self.membership.is_leader():
+            return
+        m = self._registry()
+        agg = self.aggregator
+        agg.ingest(self.telemetry.build())
+        for rid, url in self.membership.peers():
+            link = self.client.link(rid, url)
+            resp = link.call("/fleet/telemetry", {},
+                             budget_s=max(self.config.heartbeat_s, 0.25),
+                             site="fleet.telemetry",
+                             payload=rid,
+                             # control plane, like heartbeats: interval-
+                             # limited and budget-bounded, not breaker-
+                             # gated
+                             use_breaker=False)
+            if resp is None:
+                m.fleet_telemetry_pulls.inc({"peer": rid,
+                                             "outcome": "error"})
+                continue
+            reason = agg.ingest(resp)
+            m.fleet_telemetry_pulls.inc(
+                {"peer": rid,
+                 "outcome": "rejected" if reason else "ok"})
+        live = set(self.membership.live()) | {self.config.replica_id}
+        agg.prune(live)
+        rollup = agg.rollup(self.config.replica_id, self.membership.epoch)
+        with self._lock:
+            self._rollup = rollup
+        agg.publish_gauges()
+        agg.publish_burn(rollup)
+
+    def _absorb_rollup(self, rollup: Any) -> None:
+        """Keep the newest rollup we have seen (by its wall stamp); a
+        rollup from a different telemetry schema is ignored, never
+        half-trusted."""
+        if not isinstance(rollup, dict):
+            return
+        if rollup.get("schema_version") != TELEMETRY_SCHEMA_VERSION:
+            return
+        try:
+            at = float(rollup.get("at", 0.0))
+        except (TypeError, ValueError):
+            return
+        with self._lock:
+            cur = self._rollup
+            if cur is None or float(cur.get("at", 0.0)) <= at:
+                self._rollup = rollup
+
+    def rollup_view(self) -> Optional[Dict[str, Any]]:
+        """The newest fleet rollup this replica holds — computed here
+        if we lead, gossiped to us otherwise (None before the first
+        fold reaches us)."""
+        with self._lock:
+            return self._rollup
+
+    def slo_advisory(self) -> Dict[str, Any]:
+        """The advisory fleet block /readyz attaches under its slo
+        detail: fleet-aggregated divergence flips the degraded bit —
+        advisory like the rest of the slo block, never a hard fail."""
+        rollup = self.rollup_view()
+        if rollup is None:
+            return {"rollup": False, "degraded": False}
+        return {
+            "rollup": True,
+            "degraded": bool(rollup.get("degraded")),
+            "computed_by": rollup.get("computed_by"),
+            "rollup_age_s": round(
+                max(0.0, time.time() - float(rollup.get("at", 0.0))), 3),
+            "divergence_total": (rollup.get("totals") or {}).get(
+                "verification_divergences", 0.0),
+            "burn": rollup.get("burn") or {},
+        }
 
     # -- shard ownership
 
@@ -450,8 +561,10 @@ class FleetManager:
             now_wall = time.time()
             fresh = {str(s): round(now_wall - t, 3)
                      for s, t in sorted(self._shard_fresh.items())}
+            rollup = self._rollup
         return {
             "enabled": True,
+            "schema_version": TELEMETRY_SCHEMA_VERSION,
             "membership": self.membership.state(),
             "shards": {
                 "num_shards": self.config.num_shards,
@@ -465,6 +578,16 @@ class FleetManager:
                 "push_queue_depth": len(self._push_q),
                 "fetch_budget_s": self.config.fetch_budget_s,
                 "scan_fetch_budget_s": self.config.scan_fetch_budget_s,
+            },
+            "telemetry": {
+                "boot_id": self.telemetry.boot_id,
+                "seq": self.telemetry.seq,
+                "is_leader": self.membership.is_leader(),
+                "max_age_s": self.config.telemetry_max_age_s,
+                "rollup_age_s": (round(max(
+                    0.0, time.time() - float(rollup.get("at", 0.0))), 3)
+                    if rollup else None),
+                "rollup": rollup,
             },
         }
 

@@ -144,6 +144,21 @@ class PeerLink:
         must not mute heartbeats into a false failover."""
         if use_breaker and not self.breaker.allow():
             return None
+        # trace propagation: if this call happens inside a span (an
+        # admission-path peer fetch under admission.submit, a traced
+        # heartbeat), ship the SpanContext in the envelope so the
+        # receiver's handler joins OUR trace — one connected trace
+        # across replicas. No active span, no envelope.
+        try:
+            from ..observability.tracing import (context_to_wire,
+                                                 global_tracer)
+
+            wire = context_to_wire(global_tracer.current_context())
+            if wire is not None:
+                doc = dict(doc)
+                doc["trace"] = wire
+        except Exception:
+            pass
         deadline = Deadline(budget_s)
         t0 = time.monotonic()
         try:

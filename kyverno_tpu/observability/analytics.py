@@ -642,6 +642,12 @@ class SloTracker:
         # verdict-integrity samples: (t, diverged 0/1) per shadow-
         # verification check (observability/verification.py)
         self._verif: deque = deque(maxlen=max_samples)  # guarded-by: _lock
+        # lifetime totals for the fleet telemetry plane: unlike the
+        # bounded sample windows above these are true monotonic
+        # counters, so the leader can merge cross-replica DELTAS
+        # (fleet/telemetry.py) without window-alignment drift
+        self._totals: Dict[str, int] = {           # guarded-by: _lock
+            "admission_requests": 0, "admission_slow": 0, "scan_ticks": 0}
         self._hooked = False
 
     def _registry(self):
@@ -661,8 +667,12 @@ class SloTracker:
 
     def record_admission(self, latency_s: float,
                          cls: Optional[str] = None) -> None:
+        slow = latency_s > self.config.admission_p99_target_ms / 1000.0
         with self._lock:
             self._adm.append((self._clock(), latency_s, cls or "default"))
+            self._totals["admission_requests"] += 1
+            if slow:
+                self._totals["admission_slow"] += 1
 
     def admission_burn_fast(self, max_age_s: float = 0.25) -> float:
         """Cached short-window admission burn rate — the signal the
@@ -702,6 +712,7 @@ class SloTracker:
             self._last_scan = self._clock() - max(lag_s, 0.0)
             if coverage is not None:
                 self._coverage = coverage
+            self._totals["scan_ticks"] += 1
         self.update_gauges()
 
     def set_device_coverage(self, coverage: float) -> None:
@@ -725,6 +736,39 @@ class SloTracker:
             self._coverage = None
             self._verif.clear()
             self._burn_cache = (-1e9, 0.0)
+            self._totals = {"admission_requests": 0, "admission_slow": 0,
+                            "scan_ticks": 0}
+
+    # -- fleet telemetry feed (fleet/telemetry.py)
+
+    def telemetry_counters(self) -> Dict[str, int]:
+        """Lifetime monotonic totals — the delta-mergeable half of a
+        replica's telemetry snapshot."""
+        with self._lock:
+            return dict(self._totals)
+
+    def telemetry_windows(self, now: Optional[float] = None
+                          ) -> Dict[str, Dict[str, int]]:
+        """Per-window raw admission/divergence sample counts. These are
+        the numbers the leader SUMS across replicas to recompute the
+        fleet burn — shipping counts instead of each replica's own burn
+        ratio keeps the fleet rollup a weighted merge, not an average
+        of averages."""
+        now = self._clock() if now is None else now
+        target_s = self.config.admission_p99_target_ms / 1000.0
+        with self._lock:
+            adm = list(self._adm)
+            verif = list(self._verif)
+        out: Dict[str, Dict[str, int]] = {}
+        for name, span in self.config.windows.items():
+            lat = [l for (t, l, _c) in adm if t >= now - span]
+            out[name] = {
+                "requests": len(lat),
+                "slow": sum(1 for l in lat if l > target_s),
+                "divergences": sum(d for (t, d) in verif
+                                   if t >= now - span),
+            }
+        return out
 
     # -- read side
 

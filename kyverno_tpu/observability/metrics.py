@@ -68,6 +68,16 @@ class Counter:
         with self._lock:
             return [(dict(k), v) for k, v in sorted(self._values.items())]
 
+    def remove(self, labels: Optional[Dict[str, str]] = None) -> None:
+        """Drop one labeled series. Per-entity families (replica-id
+        labels in the fleet layer) call this when the entity leaves so
+        label cardinality stays bounded by the LIVE population, not by
+        every replica that ever existed."""
+        k = _labels_key(labels)
+        with self._lock:
+            self._values.pop(k, None)
+            self._exemplars.pop(k, None)
+
     def _exemplar_suffix(self, k: LabelKey) -> str:
         ex = self._exemplars.get(k)
         if ex is None:
@@ -511,6 +521,61 @@ class MetricsRegistry:
             "kyverno_fleet_gossip_total",
             "async verdict-column gossip by outcome "
             "(sent/received/error/dropped)")
+        # fleet telemetry plane (fleet/telemetry.py): the leader pulls
+        # checksummed per-replica snapshots on the heartbeat cadence
+        # and folds counter DELTAS into the kyverno_fleet_agg_*
+        # families — a restarted replica resetting to zero can never
+        # drive an aggregate backwards, and a snapshot failing the
+        # trust ladder is dropped and counted, never merged wrong.
+        # Replica labels are bounded by the operator-configured fleet
+        # size (the PR 15 rule) and pruned when a replica leaves
+        self.fleet_telemetry_pulls = self.counter(
+            "kyverno_fleet_telemetry_pulls_total",
+            "leader-side telemetry snapshot pulls by peer and outcome "
+            "(ok/rejected/error)")
+        self.fleet_telemetry_rejects = self.counter(
+            "kyverno_fleet_telemetry_rejects_total",
+            "telemetry snapshots dropped at the aggregation trust "
+            "ladder by reason (checksum/schema_version/stale_seq/"
+            "epoch/stale/decode) — a rejected snapshot is never "
+            "merged wrong")
+        self.fleet_agg_admissions = self.counter(
+            "kyverno_fleet_agg_admission_requests_total",
+            "fleet-wide admission requests folded from per-replica "
+            "telemetry counter deltas (leader-maintained)")
+        self.fleet_agg_admission_slow = self.counter(
+            "kyverno_fleet_agg_admission_slow_total",
+            "fleet-wide admissions slower than the p99 target, folded "
+            "from per-replica telemetry counter deltas")
+        self.fleet_agg_scan_ticks = self.counter(
+            "kyverno_fleet_agg_scan_ticks_total",
+            "fleet-wide background scan ticks folded from per-replica "
+            "telemetry counter deltas")
+        self.fleet_agg_verification_checked = self.counter(
+            "kyverno_fleet_agg_verification_checked_total",
+            "fleet-wide shadow-verification checks folded from "
+            "per-replica telemetry counter deltas")
+        self.fleet_agg_divergence = self.counter(
+            "kyverno_fleet_agg_divergence_total",
+            "fleet-wide shadow-verification divergences folded from "
+            "per-replica telemetry counter deltas — nonzero flips the "
+            "fleet-degraded advisory bit")
+        self.fleet_agg_burn = self.gauge(
+            "kyverno_fleet_agg_admission_burn_rate",
+            "fleet-wide admission SLO burn computed over the merged "
+            "per-replica window samples, by window")
+        self.fleet_agg_replicas_reporting = self.gauge(
+            "kyverno_fleet_agg_replicas_reporting",
+            "replicas with a fresh accepted telemetry snapshot in the "
+            "leader's aggregation view")
+        self.fleet_agg_snapshot_age = self.gauge(
+            "kyverno_fleet_agg_snapshot_age_seconds",
+            "age of the last accepted telemetry snapshot by replica "
+            "(series pruned when a replica leaves the live set)")
+        self.fleet_agg_degraded = self.gauge(
+            "kyverno_fleet_agg_degraded",
+            "1 when the fleet-aggregated divergence total is nonzero "
+            "(the advisory fleet-degraded bit /readyz surfaces)")
         # batched mutation (mutation/): device triage over the compiled
         # mutate bank, patch application by source, degradation-ladder
         # fallbacks, and shadow-verification divergence — the mutate

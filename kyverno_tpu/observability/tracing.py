@@ -56,6 +56,27 @@ class SpanContext:
     span_id: str
 
 
+def context_to_wire(ctx: Optional[SpanContext]) -> Optional[Dict[str, str]]:
+    """Serialize a SpanContext for a JSON RPC envelope (the fleet peer
+    protocol carries the caller's context so the receiver can open a
+    child span — one connected trace across replicas)."""
+    if ctx is None:
+        return None
+    return {"trace_id": ctx.trace_id, "span_id": ctx.span_id}
+
+
+def context_from_wire(doc: Any) -> Optional[SpanContext]:
+    """Parse a wire envelope back into a SpanContext; None for
+    anything malformed — a corrupt envelope degrades to an unlinked
+    span, never an error on the serving path."""
+    if not isinstance(doc, dict):
+        return None
+    tid, sid = doc.get("trace_id"), doc.get("span_id")
+    if not (isinstance(tid, str) and isinstance(sid, str) and tid and sid):
+        return None
+    return SpanContext(trace_id=tid, span_id=sid)
+
+
 @dataclass
 class SpanEvent:
     name: str

@@ -435,5 +435,13 @@ class ShadowVerifier:
                 and self._thread.is_alive(),
                 "stats": stats}
 
+    def totals(self) -> Dict[str, int]:
+        """Lifetime checked/divergence counts — the monotonic half the
+        fleet telemetry snapshot ships so the leader can delta-merge
+        divergence across replicas (fleet/telemetry.py)."""
+        with self._lock:
+            return {"checked": self.stats["checked"],
+                    "divergences": self.stats["divergences"]}
+
 
 global_verifier = ShadowVerifier()

@@ -95,6 +95,7 @@ SITE_ENCODE_WORKER = "encode.worker"
 SITE_FLEET_HEARTBEAT = "fleet.heartbeat"
 SITE_FLEET_PEER_FETCH = "fleet.peer_fetch"
 SITE_FLEET_GOSSIP = "fleet.gossip"
+SITE_FLEET_TELEMETRY = "fleet.telemetry"
 SITE_MUTATE_TRIAGE = "mutate.triage"
 SITE_MUTATE_PATCH = "mutate.patch"
 SITE_REPORTS_FOLD = "reports.fold"
@@ -105,6 +106,7 @@ KNOWN_SITES = frozenset({
     SITE_GCTX_REFRESH, SITE_SERVING_FLUSH, SITE_SERVING_HEDGE,
     SITE_POLICYSET_COMPILE, SITE_ENCODE_POOL_DISPATCH, SITE_ENCODE_WORKER,
     SITE_FLEET_HEARTBEAT, SITE_FLEET_PEER_FETCH, SITE_FLEET_GOSSIP,
+    SITE_FLEET_TELEMETRY,
     SITE_MUTATE_TRIAGE, SITE_MUTATE_PATCH,
     SITE_REPORTS_FOLD, SITE_REPORTS_JOURNAL,
 })
@@ -112,8 +114,11 @@ KNOWN_SITES = frozenset({
 MODES = ("raise", "delay", "corrupt", "crash")
 
 # sites whose result flows through FaultRegistry.corrupt(); every other
-# site only has the fire() (raise/delay) hook
-CORRUPTIBLE_SITES = frozenset({SITE_TPU_DISPATCH, SITE_REPORTS_JOURNAL})
+# site only has the fire() (raise/delay) hook. fleet.telemetry filters
+# the OUTGOING snapshot doc server-side — the chaos fixture for the
+# receiver's checksum/trust-ladder rejection path
+CORRUPTIBLE_SITES = frozenset({SITE_TPU_DISPATCH, SITE_REPORTS_JOURNAL,
+                               SITE_FLEET_TELEMETRY})
 
 # sites where mode=crash (os._exit) is meaningful: the site runs in a
 # SUPERVISED child process whose death the parent is built to absorb.

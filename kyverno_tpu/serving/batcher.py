@@ -206,35 +206,42 @@ class AdmissionPipeline:
         if self._stopped:
             raise RuntimeError("admission pipeline is stopped")
         pri = priority_of(cls)
-        if self._cache_lookup is not None:
-            t0 = time.monotonic()
-            try:
-                cached = self._cache_lookup(payload)
-            except Exception:
-                cached = None  # lookup failures take the normal path
-            if cached is not None:
-                with self._stats_lock:
-                    self.stats["cache_hits"] = \
-                        self.stats.get("cache_hits", 0) + 1
-                    self._cstat_locked(pri)["cache_hits"] += 1
-                dt = time.monotonic() - t0
-                self.metrics.serving_request_latency.observe(
-                    dt, {"path": "cached", "class": pri})
-                self.metrics.serving_class_requests.inc(
-                    {"class": pri, "outcome": "cached"})
-                self._record_slo(dt, pri)
-                self._record_flight(payload, cached, "cached", dt, "")
-                return cached
-        budget = (deadline_ms if deadline_ms is not None
-                  else self.config.deadline_ms) / 1000.0
-        grace = (eval_grace_s if eval_grace_s is not None
-                 else self.config.eval_grace_s)
         # ONE trace per request: the submit span is the root, its
         # context rides the queue entry across the flusher handoff, and
         # the latency histogram carries the trace id as an exemplar so a
-        # slow bucket links back to a concrete trace (/debug/traces)
+        # slow bucket links back to a concrete trace (/debug/traces).
+        # The span opens BEFORE the cache lookup: a lookup that falls
+        # through to a peer fetch carries this context on the wire, so
+        # a peer-served admission is one connected cross-replica trace
+        # — and even a pure cache hit gets a trace id in its flight
+        # record.
         with global_tracer.span("admission.submit") as root:
             exemplar = {"trace_id": root.trace_id}
+            if self._cache_lookup is not None:
+                t0 = time.monotonic()
+                try:
+                    cached = self._cache_lookup(payload)
+                except Exception:
+                    cached = None  # lookup failures take the normal path
+                if cached is not None:
+                    with self._stats_lock:
+                        self.stats["cache_hits"] = \
+                            self.stats.get("cache_hits", 0) + 1
+                        self._cstat_locked(pri)["cache_hits"] += 1
+                    dt = time.monotonic() - t0
+                    self.metrics.serving_request_latency.observe(
+                        dt, {"path": "cached", "class": pri},
+                        exemplar=exemplar)
+                    self.metrics.serving_class_requests.inc(
+                        {"class": pri, "outcome": "cached"})
+                    self._record_slo(dt, pri)
+                    self._record_flight(payload, cached, "cached", dt,
+                                        root.trace_id)
+                    return cached
+            budget = (deadline_ms if deadline_ms is not None
+                      else self.config.deadline_ms) / 1000.0
+            grace = (eval_grace_s if eval_grace_s is not None
+                     else self.config.eval_grace_s)
             t0 = time.monotonic()
             # burn-driven admission control BEFORE the queue: a class
             # past its burn threshold sheds now — bulk first (lowest

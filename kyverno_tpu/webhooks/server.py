@@ -746,6 +746,24 @@ class Handlers:
             detail["slo"] = global_slo.state()
         except Exception:
             pass
+        # fleet advisory: the gossiped telemetry rollup's degraded bit
+        # (fleet-aggregated shadow-verification divergence) rides the
+        # slo block — advisory like the rest of it, the gate sees a
+        # fleet limping on divergent verdicts without readiness lying
+        # about this replica's own health
+        try:
+            from ..fleet import get_fleet
+
+            fleet = get_fleet()
+            if fleet is not None and isinstance(detail.get("slo"), dict):
+                advisory = fleet.slo_advisory()
+                detail["slo"]["fleet"] = advisory
+                if advisory.get("degraded"):
+                    breached = detail["slo"].setdefault("breached", [])
+                    if "fleet_divergence" not in breached:
+                        breached.append("fleet_divergence")
+        except Exception:
+            pass
         ok = compiled and breaker.state != "open"
         detail["ready"] = ok
         return ok, detail

@@ -6,19 +6,27 @@
 # review, and asserts the COLD replica answers the identical review
 # from the fleet cache (peer fetch hit counted, no local compute) with
 # a bit-identical response, and that the kyverno_fleet_* families pass
-# the exposition surface. Leg 2 is the chaos acceptance: three
-# replicas, one SIGKILLed mid-scan, shard takeover within the lease
-# TTL, the scan completing with the exact expected verdict split
-# across survivors, and zero shadow-verification divergence at rate
-# 1.0. Leg 3 runs the fleet unit/integration suite under the dynamic
-# lock-order sanitizer and asserts zero cycles. Leg 4 is tier-1.
+# the exposition surface. Leg 2 is the telemetry-aggregation
+# acceptance (ISSUE 18): three replicas under ambient tpu.dispatch
+# corruption with shadow verification at rate 1.0 — the leader's
+# fleet divergence aggregate must equal the SUM of the replicas'
+# ground-truth divergence counters, one deliberately corrupted
+# telemetry snapshot must be rejected-and-counted (never merged), and
+# after a SIGKILL the rollup must drop the dead replica within the
+# lease TTL while keeping its folded work. Leg 3 is the chaos
+# acceptance: three replicas, one SIGKILLed mid-scan, shard takeover
+# within the lease TTL, the scan completing with the exact expected
+# verdict split across survivors, and zero shadow-verification
+# divergence at rate 1.0. Leg 4 runs the fleet unit/integration suite
+# under the dynamic lock-order sanitizer and asserts zero cycles.
+# Leg 5 is tier-1.
 #
 # Usage: ./scripts_fleet_gate.sh
 set -o pipefail
 cd "$(dirname "$0")"
 rc=0
 
-echo "=== leg 1/4: cold replica answers from the fleet cache ==="
+echo "=== leg 1/5: cold replica answers from the fleet cache ==="
 KYVERNO_TPU_FAULTS= JAX_PLATFORMS=cpu timeout -k 10 600 python - <<'EOF' || rc=1
 import http.client
 import json
@@ -180,15 +188,238 @@ finally:
             p.kill()
 EOF
 
-echo "=== leg 2/4: SIGKILL chaos — takeover + zero divergence ==="
+echo "=== leg 2/5: telemetry aggregation — divergence rollup bit-exact ==="
+KYVERNO_TPU_FAULTS= JAX_PLATFORMS=cpu timeout -k 10 700 python - <<'EOF' || rc=1
+import http.client
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+import yaml
+
+POLICY = {
+    "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+    "metadata": {"name": "agg-gate"},
+    "spec": {"validationFailureAction": "Enforce", "rules": [{
+        "name": "no-privileged",
+        "match": {"any": [{"resources": {"kinds": ["Pod"]}}]},
+        "validate": {"message": "no privileged",
+                     "pattern": {"spec": {"containers": [
+                         {"=(securityContext)":
+                          {"=(privileged)": "false"}}]}}},
+    }]}}
+
+
+def review(name):
+    return {"request": {
+        "uid": f"agg-{name}", "operation": "CREATE",
+        "namespace": "default",
+        "object": {"apiVersion": "v1", "kind": "Pod",
+                   "metadata": {"name": name, "namespace": "default"},
+                   "spec": {"containers": [{"name": "c",
+                                            "image": f"img-{name}"}]}},
+    }}
+
+
+def free_port():
+    s = socket.socket(); s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]; s.close(); return port
+
+
+def get(port, path, timeout=30):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    conn.request("GET", path)
+    resp = conn.getresponse(); body = resp.read(); conn.close()
+    return resp.status, body
+
+
+def post(port, path, doc, timeout=300):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    conn.request("POST", path, json.dumps(doc),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse(); body = resp.read(); conn.close()
+    return resp.status, body
+
+
+def metric(text, name, **labels):
+    total = 0.0
+    for line in text.splitlines():
+        if not line.startswith(name):
+            continue
+        rest = line[len(name):]
+        if rest and rest[0] not in ("{", " "):
+            continue
+        if all(f'{k}="{v}"' in rest for k, v in labels.items()):
+            try:
+                total += float(line.split(" # ")[0].rsplit(" ", 1)[-1])
+            except ValueError:
+                pass
+    return total
+
+
+tmp = tempfile.mkdtemp(prefix="fleet-agg-gate-")
+pol_file = os.path.join(tmp, "policy.yaml")
+with open(pol_file, "w") as f:
+    yaml.safe_dump(POLICY, f)
+N = 3
+fleet = [free_port() for _ in range(N)]
+adm = [free_port() for _ in range(N)]
+met = [free_port() for _ in range(N)]
+# ambient faults, per replica: agg1/agg2 flip device dispatch results
+# (shadow verification at rate 1.0 turns each flipped admission into a
+# counted divergence); agg1 ALSO corrupts exactly ONE outgoing
+# telemetry snapshot, which the leader must reject-and-count
+faults = {
+    1: "tpu.dispatch:corrupt:flip=1,count=40;"
+       "fleet.telemetry:corrupt:count=1",
+    2: "tpu.dispatch:corrupt:flip=1,count=40",
+}
+procs = []
+try:
+    for i in range(N):
+        peers = ",".join(f"http://127.0.0.1:{fleet[j]}"
+                         for j in range(N) if j != i)
+        env = dict(os.environ)
+        env.update({"JAX_PLATFORMS": "cpu",
+                    "KYVERNO_TPU_XLA_CACHE_DIR": os.path.join(tmp, "xla"),
+                    "KYVERNO_TPU_FAULTS": faults.get(i, "")})
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "kyverno_tpu", "serve", pol_file,
+             "--port", str(adm[i]), "--metrics-port", str(met[i]),
+             "--scan-interval", "9999", "--batching",
+             "--shadow-verify-rate", "1.0",
+             # the bit-exact check needs EVERY admission on the faulted
+             # device path, audited: burn-shed off (cold-start SLO burn
+             # would reroute posts to the scalar path — no dispatch, no
+             # divergence) and flight capture at 1.0 (the default 0.01
+             # sample would hide batched records from the verifier)
+             "--shed-burn-default", "0", "--shed-burn-bulk", "0",
+             "--flight-sample-rate", "1.0",
+             "--fleet-listen", str(fleet[i]), "--fleet-peers", peers,
+             "--replica-id", f"agg{i}", "--fleet-lease-s", "2.0"],
+            env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL))
+        # serialize boots on the shared warm XLA cache
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline:
+            try:
+                if get(met[i], "/healthz", timeout=2)[0] == 200:
+                    break
+            except OSError:
+                time.sleep(0.3)
+        else:
+            raise AssertionError(f"replica {i} never became healthy")
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        try:
+            views = [json.loads(get(fleet[i], "/fleet/state", 2)[1])
+                     for i in range(N)]
+            if all(len(v["membership"]["live"]) == N for v in views):
+                break
+        except OSError:
+            pass
+        time.sleep(0.3)
+    else:
+        raise AssertionError("fleet never converged")
+    # agg0 is the lexicographically smallest live id = the leader
+    assert json.loads(get(fleet[0], "/fleet/state", 2)[1]
+                      )["membership"]["is_leader"]
+
+    # drive DISTINCT admissions through the faulted replicas (distinct
+    # manifests so every review dispatches instead of hitting a cache)
+    for i in (1, 2):
+        for k in range(4):
+            status, _ = post(adm[i], "/validate", review(f"r{i}-{k}"))
+            assert status == 200, status
+
+    # converge: leader's fleet divergence aggregate == SUM of the
+    # per-replica ground-truth counters, nonzero (delta-fold is exact,
+    # so once the per-replica counters settle, equality is bit-exact)
+    deadline = time.monotonic() + 60
+    agg_total = truth = -1
+    while time.monotonic() < deadline:
+        texts = [get(met[i], "/metrics")[1].decode() for i in range(N)]
+        truth = sum(metric(t, "kyverno_verification_divergence_total")
+                    for t in texts)
+        agg_total = metric(texts[0], "kyverno_fleet_agg_divergence_total")
+        if truth > 0 and agg_total == truth:
+            break
+        time.sleep(0.5)
+    else:
+        raise AssertionError(
+            f"aggregate {agg_total} != sum of replica truths {truth}")
+    print(f"fleet divergence aggregate bit-exact: {agg_total:.0f} == "
+          f"sum of per-replica truths")
+
+    leader_text = get(met[0], "/metrics")[1].decode()
+    # exactly one poisoned snapshot was rejected-and-counted, and the
+    # leader kept folding agg1 afterwards (it appears in the rollup)
+    rejects = metric(leader_text, "kyverno_fleet_telemetry_rejects_total",
+                     reason="checksum")
+    assert rejects == 1, f"expected 1 checksum reject, saw {rejects}"
+    roll = json.loads(get(met[0], "/debug/fleet")[1]
+                      )["telemetry"]["rollup"]
+    assert set(roll["replicas"]) == {"agg0", "agg1", "agg2"}, roll["replicas"]
+    assert roll["degraded"] is True
+    assert roll["totals"]["verification_divergences"] == truth
+    # the rollup GOSSIPS BACK: a follower answers /debug/fleet with it
+    f_roll = json.loads(get(met[2], "/debug/fleet")[1]
+                        )["telemetry"]["rollup"]
+    assert f_roll and f_roll["computed_by"] == "agg0"
+    # /readyz carries the advisory degraded bit without failing ready
+    status, body = get(met[0], "/readyz")
+    ready = json.loads(body)
+    assert ready["slo"]["fleet"]["degraded"] is True
+    assert "fleet_divergence" in ready["slo"]["breached"]
+    print("poisoned snapshot rejected-and-counted; rollup gossiped; "
+          "readyz advisory degraded")
+
+    # SIGKILL agg2: the rollup must drop it within the lease TTL while
+    # keeping its already-folded divergences in the totals
+    procs[2].send_signal(signal.SIGKILL)
+    t_kill = time.monotonic()
+    deadline = t_kill + 20
+    while time.monotonic() < deadline:
+        roll = json.loads(get(met[0], "/debug/fleet")[1]
+                          )["telemetry"]["rollup"]
+        if set(roll["replicas"]) == {"agg0", "agg1"}:
+            break
+        time.sleep(0.2)
+    else:
+        raise AssertionError(f"rollup never dropped agg2: "
+                             f"{sorted(roll['replicas'])}")
+    took = time.monotonic() - t_kill
+    assert roll["totals"]["verification_divergences"] == truth, \
+        "a dead replica's folded work must stay in the totals"
+    leader_text = get(met[0], "/metrics")[1].decode()
+    assert metric(leader_text, "kyverno_fleet_agg_replicas_reporting") == 2
+    print(f"SIGKILLed replica left the rollup in {took:.1f}s "
+          f"(lease 2.0s + pull cadence); folded work retained")
+finally:
+    for p in procs:
+        p.terminate()
+    for p in procs:
+        try:
+            p.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            p.kill()
+EOF
+
+echo "=== leg 3/5: SIGKILL chaos — takeover + zero divergence ==="
 KYVERNO_TPU_FAULTS= JAX_PLATFORMS=cpu timeout -k 10 900 \
   python -m pytest tests/test_fleet_chaos.py -q -p no:cacheprovider || rc=1
 
-echo "=== leg 3/4: fleet suite under the lock-order sanitizer ==="
+echo "=== leg 4/5: fleet suite under the lock-order sanitizer ==="
 rm -f /tmp/_san_fleet.json
 KYVERNO_TPU_SANITIZE=1 KYVERNO_TPU_SANITIZE_REPORT=/tmp/_san_fleet.json \
   KYVERNO_TPU_FAULTS= JAX_PLATFORMS=cpu timeout -k 10 900 \
-  python -m pytest tests/test_fleet.py -q -p no:cacheprovider || rc=1
+  python -m pytest tests/test_fleet.py tests/test_fleet_telemetry.py \
+  -q -p no:cacheprovider || rc=1
 python - <<'EOF' || rc=1
 import json
 doc = json.load(open("/tmp/_san_fleet.json"))
@@ -199,7 +430,7 @@ print(f"fleet clean under sanitizer: {doc['locks_tracked']} locks, "
       f"{doc['edges']} edges, 0 cycles")
 EOF
 
-echo "=== leg 4/4: tier-1 ==="
+echo "=== leg 5/5: tier-1 ==="
 KYVERNO_TPU_FAULTS= JAX_PLATFORMS=cpu timeout -k 10 870 \
   python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors \
   -p no:cacheprovider -p no:xdist -p no:randomly || rc=1

@@ -250,8 +250,61 @@ def test_top_help(capsys):
     assert exc.value.code == 0
     out = capsys.readouterr().out
     for flag in ("--port", "--interval", "--iterations", "--no-clear",
-                 "--top"):
+                 "--top", "--fleet"):
         assert flag in out
+
+
+def test_top_fleet_renders_matrix_and_rollup(capsys, monkeypatch):
+    """`top --fleet` in CI mode (--iterations 1 --no-clear): the fleet
+    health matrix and rollup render from a stubbed /debug surface."""
+    from kyverno_tpu.cli import tools
+
+    fleet_doc = {
+        "enabled": True,
+        "membership": {"replica_id": "r1", "epoch": 3,
+                       "live": ["r0", "r1"]},
+        "telemetry": {
+            "is_leader": False, "rollup_age_s": 0.4,
+            "rollup": {
+                "computed_by": "r0", "degraded": True,
+                "totals": {"admission_requests": 160.0,
+                           "verification_divergences": 3.0},
+                "burn": {"5m": 1.87},
+                "rejects": {"checksum": 1},
+                "replicas": {
+                    "r0": {"seq": 9, "snapshot_age_s": 0.1,
+                           "slo_burn": 2.0, "divergences": 0,
+                           "shards_owned": 8, "cache_hit_rate": 0.75},
+                    "r1": {"seq": 7, "snapshot_age_s": 0.3,
+                           "slo_burn": 1.2, "divergences": 3,
+                           "shards_owned": 8, "cache_hit_rate": None},
+                },
+            },
+        },
+    }
+    docs = {"/debug/utilization": {}, "/readyz": {},
+            "/debug/fleet": fleet_doc}
+
+    def fake_get(host, port, path, timeout=10.0):
+        if path.startswith("/debug/rules"):
+            return {"rules_tracked": 0, "top": []}
+        return docs[path]
+
+    monkeypatch.setattr(tools, "_http_get_json", fake_get)
+    assert main(["top", "--fleet", "--iterations", "1",
+                 "--no-clear"]) == 0
+    out = capsys.readouterr().out
+    assert "fleet — replica r1" in out and "leader no" in out
+    assert "rollup by r0" in out and "DEGRADED" in out
+    assert "snapshot rejects: checksum=1" in out
+    assert "burn[5m]=1.87" in out
+    for rid in ("r0", "r1"):
+        assert rid in out
+    # the renderer degrades: fleet disabled renders a hint, not a crash
+    docs["/debug/fleet"] = {"enabled": False}
+    assert main(["top", "--fleet", "--iterations", "1",
+                 "--no-clear"]) == 0
+    assert "fleet: disabled" in capsys.readouterr().out
 
 
 def test_lint_help(capsys):

@@ -1,0 +1,464 @@
+"""Fleet observability plane (ISSUE 18): checksummed telemetry
+snapshots, trust-ladder aggregation, delta-merge monotonicity across
+restart, cross-replica trace propagation, and rollup gossip.
+
+The contracts under test:
+
+- a snapshot is sealed: any mutation fails the checksum rung, and
+  every other rung (schema, replay/ordering, staleness) drops-and-
+  counts on kyverno_fleet_telemetry_rejects_total — a rejected
+  snapshot changes NOTHING in the fold;
+- counters merge as deltas with reset detection, so a replica
+  SIGKILLed and restarted with zeroed counters can never drive a
+  fleet aggregate backwards, and the final totals equal the sum of
+  per-replica ground truth INCLUDING pre-restart work;
+- the leader pulls on the heartbeat cadence, folds, and gossips the
+  rollup back, so any replica answers with the fleet view;
+- peer RPCs carry the caller's span context: a traced heartbeat
+  renders as one connected trace across both replicas.
+"""
+
+import copy
+import json
+import time
+import urllib.request
+
+import pytest
+
+from kyverno_tpu.fleet import (FleetConfig, FleetManager, configure_fleet,
+                               reset_fleet)
+from kyverno_tpu.fleet.telemetry import (TELEMETRY_SCHEMA_VERSION,
+                                         TelemetryAggregator,
+                                         snapshot_checksum)
+from kyverno_tpu.observability.metrics import MetricsRegistry
+from kyverno_tpu.observability.metrics import global_registry as reg
+from kyverno_tpu.observability.tracing import global_tracer
+from kyverno_tpu.resilience.faults import global_faults
+from kyverno_tpu.tpu.cache import VerdictCache
+
+N_SHARDS = 16
+
+
+def _mgr(rid, lease_s=1.0, hb=0.1, **kw):
+    cfg = FleetConfig(replica_id=rid, listen_port=0, lease_s=lease_s,
+                      heartbeat_interval_s=hb, push_interval_s=0.05,
+                      num_shards=N_SHARDS, **kw)
+    return FleetManager(cfg, cache=VerdictCache(capacity=64))
+
+
+def _wait(cond, timeout=8.0, interval=0.03):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return cond()
+
+
+def _snap(rid, seq=1, boot="b1", epoch=1, counters=None, at=None,
+          windows=None):
+    doc = {"schema_version": TELEMETRY_SCHEMA_VERSION, "replica_id": rid,
+           "boot_id": boot, "seq": seq, "epoch": epoch,
+           "at": time.time() if at is None else at,
+           "counters": counters if counters is not None
+           else {"admission_requests": 1},
+           "slo_windows": windows or {}, "gauges": {}}
+    doc["sha"] = snapshot_checksum(doc)
+    return doc
+
+
+# ---------------------------------------------------------------------------
+# snapshot sealing
+
+
+def test_snapshot_is_sealed_and_stamped():
+    mgr = _mgr("sa")
+    try:
+        s1 = mgr.telemetry.build()
+        s2 = mgr.telemetry.build()
+        for s in (s1, s2):
+            assert s["schema_version"] == TELEMETRY_SCHEMA_VERSION
+            assert s["replica_id"] == "sa"
+            assert s["boot_id"] == mgr.telemetry.boot_id
+            assert snapshot_checksum(s) == s["sha"]
+            assert set(s["counters"]) >= {"admission_requests",
+                                          "verification_divergences"}
+        assert s2["seq"] == s1["seq"] + 1, "seq is monotonic per boot"
+    finally:
+        # the manager was never start()ed, so only the bound socket
+        # needs closing (FleetPeerServer.stop would block waiting for
+        # a serve_forever that never ran)
+        mgr.server._httpd.server_close()
+
+
+# ---------------------------------------------------------------------------
+# the trust ladder: every rung drops-and-counts, never merges wrong
+
+
+def test_trust_ladder_rejects_by_reason():
+    r = MetricsRegistry()
+    agg = TelemetryAggregator(metrics=r, max_age_s=5.0)
+    assert agg.ingest(_snap("ra")) == ""
+    base = agg.totals()
+
+    # checksum: ANY field mutated after sealing
+    bad = copy.deepcopy(_snap("ra", seq=2))
+    bad["counters"]["admission_requests"] = 10 ** 9
+    assert agg.ingest(bad) == "checksum"
+    # schema_version: resealed under a different schema still drops
+    skew = _snap("ra", seq=3)
+    skew["schema_version"] = TELEMETRY_SCHEMA_VERSION + 1
+    skew["sha"] = snapshot_checksum(skew)
+    assert agg.ingest(skew) == "schema_version"
+    # stale_seq: a replayed snapshot from the same boot
+    assert agg.ingest(_snap("ra", seq=1)) == "stale_seq"
+    # epoch: regression within the same boot (out-of-order world view)
+    assert agg.ingest(_snap("ra", seq=5, epoch=0)) == "epoch"
+    # stale: a snapshot older than max_age_s is history, not state
+    assert agg.ingest(_snap("ra", seq=6, at=time.time() - 60)) == "stale"
+    # decode: not even a document
+    assert agg.ingest(["not", "a", "snapshot"]) == "decode"
+    assert agg.ingest({"replica_id": "ra"}) == "decode"
+
+    # nothing merged wrong: totals unchanged by seven rejects
+    assert agg.totals() == base
+    for reason in ("checksum", "schema_version", "stale_seq", "epoch",
+                   "stale", "decode"):
+        assert r.fleet_telemetry_rejects.value({"reason": reason}) >= 1, \
+            reason
+    assert agg.rejects()["decode"] == 2
+
+
+def test_same_seq_new_boot_is_a_restart_not_a_replay():
+    agg = TelemetryAggregator(metrics=MetricsRegistry(), max_age_s=30.0)
+    assert agg.ingest(_snap("rb", seq=7, boot="boot-1",
+                            counters={"admission_requests": 70})) == ""
+    # SIGKILL + restart: seq starts over under a NEW boot id — that is
+    # a fresh history, not a replay
+    assert agg.ingest(_snap("rb", seq=1, boot="boot-2",
+                            counters={"admission_requests": 3})) == ""
+    assert agg.totals()["admission_requests"] == 73.0
+
+
+# ---------------------------------------------------------------------------
+# delta merge: restart-reset can never drive an aggregate backwards
+# (the regression satellite, unit half — the process-level half lives
+# in scripts_fleet_gate.sh)
+
+
+def test_counter_merge_monotonic_across_restart():
+    r = MetricsRegistry()
+    agg = TelemetryAggregator(metrics=r, max_age_s=30.0)
+    seen = []
+    # phase 1: two replicas doing real work
+    truth = {"ga": 0, "gb": 0}
+    seq = {"ga": 0, "gb": 0}
+    for step in (5, 9, 14):
+        for rid in ("ga", "gb"):
+            truth[rid] = step
+            seq[rid] += 1
+            assert agg.ingest(_snap(
+                rid, seq=seq[rid], boot=f"{rid}-boot1",
+                counters={"admission_requests": step})) == ""
+            seen.append(agg.totals().get("admission_requests", 0.0))
+    pre_restart_ga = truth["ga"]
+    # phase 2: ga is SIGKILLed and restarts ZEROED (new boot id)
+    for i, step in enumerate((2, 6), start=1):
+        truth["ga"] = step
+        assert agg.ingest(_snap(
+            "ga", seq=i, boot="ga-boot2",
+            counters={"admission_requests": step})) == ""
+        seen.append(agg.totals().get("admission_requests", 0.0))
+    # monotone at every observation point
+    assert seen == sorted(seen), seen
+    # final rollup equals the ground truth INCLUDING pre-restart work
+    expect = pre_restart_ga + truth["ga"] + truth["gb"]
+    assert agg.totals()["admission_requests"] == float(expect)
+    assert reg is not r  # private registry: the counter agrees too
+    assert r.fleet_agg_admissions.value() == float(expect)
+
+
+# ---------------------------------------------------------------------------
+# leader pull + fold + rollup gossip across a live trio
+
+
+def test_leader_folds_trio_and_gossips_rollup_back():
+    mgrs = [_mgr(f"t{i}") for i in range(3)]
+    # per-replica ground truth, injected because in-process replicas
+    # share the global SLO/verifier singletons
+    truths = {
+        "t0": {"admission_requests": 100, "admission_slow": 2,
+               "verification_checked": 40, "verification_divergences": 0},
+        "t1": {"admission_requests": 50, "admission_slow": 1,
+               "verification_checked": 20, "verification_divergences": 2},
+        "t2": {"admission_requests": 10, "admission_slow": 0,
+               "verification_checked": 5, "verification_divergences": 1},
+    }
+    windows = {
+        "t0": {"5m": {"requests": 100, "slow": 2, "divergences": 0}},
+        "t1": {"5m": {"requests": 50, "slow": 1, "divergences": 2}},
+        "t2": {"5m": {"requests": 10, "slow": 0, "divergences": 1}},
+    }
+    for m in mgrs:
+        rid = m.config.replica_id
+        m.telemetry.counters_provider = lambda rid=rid: truths[rid]
+        m.telemetry.windows_provider = lambda rid=rid: windows[rid]
+    for i, m in enumerate(mgrs):
+        m.add_peers(*[x.url for j, x in enumerate(mgrs) if j != i])
+    for m in mgrs:
+        m.start()
+    try:
+        assert _wait(lambda: all(len(m.membership.live()) == 3
+                                 for m in mgrs))
+        leader = mgrs[0]
+        assert leader.membership.is_leader()
+
+        def folded():
+            roll = leader.rollup_view()
+            return (roll is not None and
+                    len(roll["replicas"]) == 3 and
+                    roll["totals"].get("admission_requests") == 160.0)
+        assert _wait(folded), leader.rollup_view()
+        roll = leader.rollup_view()
+        # fleet totals are the exact sum of per-replica ground truth
+        assert roll["totals"]["verification_divergences"] == 3.0
+        assert roll["totals"]["verification_checked"] == 65.0
+        assert roll["degraded"] is True
+        # fleet burn is the WEIGHTED merge: (2+1+0)/(100+50+10) over
+        # the budget — not an average of per-replica burn rates
+        from kyverno_tpu.observability.analytics import global_slo
+        budget = global_slo.config.admission_error_budget
+        assert roll["burn"]["5m"] == pytest.approx(
+            (3 / 160) / budget, rel=1e-3)
+        # the health matrix carries per-replica rows
+        row = roll["replicas"]["t1"]
+        assert row["divergences"] == 2.0
+        assert row["snapshot_age_s"] < 5.0
+        assert row["shards_owned"] is not None
+        # the rollup gossips BACK: followers answer with the fleet view
+        assert _wait(lambda: all(
+            m.rollup_view() is not None and
+            m.rollup_view()["computed_by"] == "t0" for m in mgrs[1:]))
+        st = mgrs[2].state()
+        assert st["schema_version"] == TELEMETRY_SCHEMA_VERSION
+        assert st["telemetry"]["is_leader"] is False
+        assert st["telemetry"]["rollup"]["totals"][
+            "admission_requests"] == 160.0
+        # leader-side aggregate families advanced by the same fold
+        assert reg.fleet_agg_replicas_reporting.value() == 3.0
+        assert reg.fleet_agg_degraded.value() == 1.0
+    finally:
+        for m in mgrs:
+            m.stop(leave=False)
+
+
+def test_sigkill_restart_keeps_fleet_aggregates_monotonic():
+    """The regression satellite, end to end through live managers: a
+    replica is SIGKILLed mid-soak and restarted with ZEROED counters
+    (new process = new boot id); every leader-side aggregate stays
+    non-decreasing and the final rollup equals the sum of per-replica
+    ground truth INCLUDING the dead boot's work."""
+    leader = _mgr("m0", lease_s=0.8, hb=0.1)
+    worker = _mgr("m1", lease_s=0.8, hb=0.1)
+    work = {"m0": 10, "m1": 50}
+    leader.telemetry.counters_provider = \
+        lambda: {"admission_requests": work["m0"]}
+    worker.telemetry.counters_provider = \
+        lambda: {"admission_requests": work["m1"]}
+    leader.add_peers(worker.url)
+    worker.add_peers(leader.url)
+    leader.start()
+    worker.start()
+    observed = []
+    try:
+        assert _wait(lambda: len(leader.membership.live()) == 2)
+        assert leader.membership.is_leader()
+
+        def total():
+            roll = leader.rollup_view()
+            return (roll or {}).get("totals", {}).get(
+                "admission_requests", 0.0)
+        assert _wait(lambda: total() == 60.0), leader.rollup_view()
+        observed.append(total())
+        work["m1"] = 75  # more work lands before the kill
+        assert _wait(lambda: total() == 85.0)
+        observed.append(total())
+        worker.kill()  # SIGKILL: no leave, counters die with it
+        # restart: same replica id, FRESH boot id, counters back at 0
+        worker = _mgr("m1", lease_s=0.8, hb=0.1)
+        work["m1"] = 0
+        worker.telemetry.counters_provider = \
+            lambda: {"admission_requests": work["m1"]}
+        worker.add_peers(leader.url)
+        leader.add_peers(worker.url)
+        worker.start()
+        assert _wait(lambda: len(leader.membership.live()) == 2)
+        observed.append(total())
+        work["m1"] = 30  # post-restart work
+        assert _wait(lambda: total() == 115.0), \
+            (total(), leader.rollup_view())
+        observed.append(total())
+        # non-decreasing at every observation point, and the final
+        # rollup is the full ground truth: 10 + 75 (dead boot) + 30
+        assert observed == sorted(observed), observed
+        assert reg.fleet_agg_admissions.value() >= 115.0
+    finally:
+        leader.stop(leave=False)
+        worker.stop(leave=False)
+
+
+def test_dead_replica_leaves_matrix_within_lease_ttl():
+    mgrs = [_mgr(f"d{i}", lease_s=0.8, hb=0.1) for i in range(3)]
+    for i, m in enumerate(mgrs):
+        m.add_peers(*[x.url for j, x in enumerate(mgrs) if j != i])
+    for m in mgrs:
+        m.start()
+    try:
+        assert _wait(lambda: all(len(m.membership.live()) == 3
+                                 for m in mgrs))
+        leader = mgrs[0]
+        assert _wait(lambda: leader.rollup_view() is not None and
+                     len(leader.rollup_view()["replicas"]) == 3)
+        before = leader.rollup_view()["totals"].get(
+            "admission_requests", 0.0)
+        mgrs[2].kill()  # SIGKILL semantics: no leave notification
+        assert _wait(lambda: len(leader.rollup_view()["replicas"]) == 2,
+                     timeout=6.0), leader.rollup_view()["replicas"]
+        # the dead replica's folded work stays in the totals
+        assert leader.rollup_view()["totals"].get(
+            "admission_requests", 0.0) >= before
+        assert "d2" not in leader.rollup_view()["replicas"]
+    finally:
+        for m in mgrs:
+            m.stop(leave=False)
+
+
+# ---------------------------------------------------------------------------
+# /fleet/telemetry over HTTP + the chaos fixture
+
+
+def test_fleet_telemetry_route_and_state_schema_version():
+    mgr = configure_fleet(FleetConfig(
+        replica_id="hx", listen_port=0, lease_s=1.0,
+        heartbeat_interval_s=0.1, num_shards=N_SHARDS))
+    try:
+        doc = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{mgr.server.port}/fleet/telemetry",
+            timeout=5).read())
+        assert doc["replica_id"] == "hx"
+        assert doc["schema_version"] == TELEMETRY_SCHEMA_VERSION
+        assert snapshot_checksum(doc) == doc["sha"]
+        st = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{mgr.server.port}/fleet/state",
+            timeout=5).read())
+        assert st["schema_version"] == TELEMETRY_SCHEMA_VERSION
+        assert st["telemetry"]["boot_id"] == mgr.telemetry.boot_id
+    finally:
+        reset_fleet()
+
+
+def test_corrupted_snapshot_is_rejected_and_counted_once():
+    a, b = _mgr("ca"), _mgr("cb")
+    a.add_peers(b.url)
+    b.add_peers(a.url)
+    r0 = reg.fleet_telemetry_rejects.value({"reason": "checksum"})
+    # exactly ONE outgoing snapshot is damaged server-side; the
+    # leader's checksum rung must drop-and-count it, then keep folding
+    global_faults.arm("fleet.telemetry", mode="corrupt", count=1)
+    try:
+        for m in (a, b):
+            m.start()
+        assert _wait(lambda: all(len(m.membership.live()) == 2
+                                 for m in (a, b)))
+        leader = a if a.membership.is_leader() else b
+        follower = b if leader is a else a
+        assert _wait(lambda: reg.fleet_telemetry_rejects.value(
+            {"reason": "checksum"}) == r0 + 1)
+        # the fold recovers on the next pull: the follower appears in
+        # the matrix despite the poisoned first snapshot
+        assert _wait(lambda: leader.rollup_view() is not None and
+                     follower.config.replica_id in
+                     leader.rollup_view()["replicas"])
+        assert reg.fleet_telemetry_rejects.value(
+            {"reason": "checksum"}) == r0 + 1, \
+            "count=1 fault corrupts exactly one snapshot"
+        assert any(labels.get("outcome") == "rejected"
+                   for labels, _v in reg.fleet_telemetry_pulls.series())
+    finally:
+        global_faults.disarm("fleet.telemetry")
+        for m in (a, b):
+            m.stop(leave=False)
+
+
+# ---------------------------------------------------------------------------
+# trace propagation: one connected trace across replicas
+
+
+def test_heartbeat_rpc_joins_the_callers_trace():
+    a, b = _mgr("ta"), _mgr("tb")
+    a.add_peers(b.url)
+    b.server.start()
+    a.server.start()
+    try:
+        with global_tracer.span("test.fleet.root") as root:
+            a.membership.renew_self()
+            a._send_heartbeats()  # runs on THIS thread, inside the span
+        assert _wait(lambda: any(
+            s.name == "fleet.rpc.heartbeat" and s.trace_id == root.trace_id
+            for s in global_tracer.finished("fleet.rpc.heartbeat")))
+        spans = [s for s in global_tracer.trace(root.trace_id)
+                 if s.name == "fleet.rpc.heartbeat"]
+        assert spans[0].attributes["replica"] == "tb"
+        assert spans[0].attributes["caller"] == "ta"
+        assert spans[0].parent_span_id == root.span_id
+    finally:
+        a.server.stop()
+        b.server.stop()
+
+
+def test_untraced_heartbeat_opens_no_server_span():
+    a, b = _mgr("ua"), _mgr("ub")
+    a.add_peers(b.url)
+    b.server.start()
+    a.server.start()
+    try:
+        n0 = len(global_tracer.finished("fleet.rpc.heartbeat"))
+        a.membership.renew_self()
+        a._send_heartbeats()  # no active span on this thread
+        time.sleep(0.1)
+        assert len(global_tracer.finished("fleet.rpc.heartbeat")) == n0, \
+            "an envelope-free request must not fabricate span noise"
+    finally:
+        a.server.stop()
+        b.server.stop()
+
+
+# ---------------------------------------------------------------------------
+# /readyz advisory: fleet divergence flips the degraded bit
+
+
+def test_readyz_carries_fleet_advisory_and_degraded_bit():
+    from kyverno_tpu.cluster import PolicyCache
+    from kyverno_tpu.webhooks import build_handlers
+
+    mgr = configure_fleet(FleetConfig(
+        replica_id="rz", listen_port=0, lease_s=1.0,
+        heartbeat_interval_s=0.1, num_shards=N_SHARDS))
+    h = build_handlers(PolicyCache())
+    try:
+        mgr.telemetry.counters_provider = lambda: {
+            "admission_requests": 9, "verification_divergences": 4}
+        mgr.tick()
+        adv = mgr.slo_advisory()
+        assert adv["rollup"] and adv["degraded"]
+        assert adv["divergence_total"] == 4.0
+        _ok, detail = h.ready()
+        fleet_block = detail["slo"]["fleet"]
+        assert fleet_block["degraded"] is True
+        assert "fleet_divergence" in detail["slo"]["breached"]
+    finally:
+        reset_fleet()
+        for attr in ("pipeline", "batcher"):
+            obj = getattr(h, attr, None)
+            if obj is not None:
+                obj.stop()

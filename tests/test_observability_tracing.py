@@ -253,6 +253,19 @@ def test_exposition_format_is_scrapeable():
     reg.fleet_peer_fetch.inc({"peer": "r1", "outcome": "hit"})
     reg.fleet_peer_rejects.inc({"reason": "checksum"})
     reg.fleet_gossip.inc({"outcome": "sent"}, value=8)
+    # fleet telemetry plane: leader pull outcomes, trust-ladder
+    # rejects, delta-folded fleet aggregates, fleet burn/health gauges
+    reg.fleet_telemetry_pulls.inc({"peer": "r1", "outcome": "ok"})
+    reg.fleet_telemetry_rejects.inc({"reason": "checksum"})
+    reg.fleet_agg_admissions.inc(value=12)
+    reg.fleet_agg_admission_slow.inc(value=1)
+    reg.fleet_agg_scan_ticks.inc(value=3)
+    reg.fleet_agg_verification_checked.inc(value=5)
+    reg.fleet_agg_divergence.inc()
+    reg.fleet_agg_burn.set(0.4, {"window": "5m"})
+    reg.fleet_agg_replicas_reporting.set(3)
+    reg.fleet_agg_snapshot_age.set(0.2, {"replica": "r1"})
+    reg.fleet_agg_degraded.set(1)
 
     text = reg.exposition()
     # every new family is present (cardinality guard has its own test)
@@ -284,7 +297,18 @@ def test_exposition_format_is_scrapeable():
                 "kyverno_fleet_heartbeats_total",
                 "kyverno_fleet_peer_fetch_total",
                 "kyverno_fleet_peer_rejects_total",
-                "kyverno_fleet_gossip_total"):
+                "kyverno_fleet_gossip_total",
+                "kyverno_fleet_telemetry_pulls_total",
+                "kyverno_fleet_telemetry_rejects_total",
+                "kyverno_fleet_agg_admission_requests_total",
+                "kyverno_fleet_agg_admission_slow_total",
+                "kyverno_fleet_agg_scan_ticks_total",
+                "kyverno_fleet_agg_verification_checked_total",
+                "kyverno_fleet_agg_divergence_total",
+                "kyverno_fleet_agg_admission_burn_rate",
+                "kyverno_fleet_agg_replicas_reporting",
+                "kyverno_fleet_agg_snapshot_age_seconds",
+                "kyverno_fleet_agg_degraded"):
         assert f"# TYPE {fam} " in text, fam
     # per-class SLO burn series render alongside the aggregate ones
     assert 'kyverno_slo_admission_burn_rate{class="bulk",window=' in text
@@ -347,6 +371,48 @@ def test_exposition_format_is_scrapeable():
     # the exemplar itself parses and carries the trace id
     assert f'# {{trace_id="{"ab" * 16}"}} 0.07' in text
     assert f'trace_id="{"cd" * 16}"' in text
+
+
+def test_fleet_replica_label_cardinality_tracks_live_set():
+    """The per-replica snapshot-age gauge must not accumulate a series
+    for every replica that EVER reported — prune() removes the series
+    when a replica leaves, so replica-label cardinality is bounded by
+    the live population."""
+    from kyverno_tpu.fleet.telemetry import (TELEMETRY_SCHEMA_VERSION,
+                                             TelemetryAggregator,
+                                             snapshot_checksum)
+
+    def snap(rid):
+        doc = {"schema_version": TELEMETRY_SCHEMA_VERSION,
+               "replica_id": rid, "boot_id": "b1", "seq": 1, "epoch": 1,
+               "at": time.time(),
+               "counters": {"admission_requests": 1},
+               "slo_windows": {}, "gauges": {}}
+        doc["sha"] = snapshot_checksum(doc)
+        return doc
+
+    reg = MetricsRegistry()
+    agg = TelemetryAggregator(metrics=reg, max_age_s=30.0)
+    fleet = [f"r{i}" for i in range(5)]
+    for rid in fleet:
+        assert agg.ingest(snap(rid)) == ""
+    agg.publish_gauges()
+    text = reg.exposition()
+    for rid in fleet:
+        assert f'kyverno_fleet_agg_snapshot_age_seconds{{replica="{rid}"}}' \
+            in text
+    # three replicas leave: their matrix rows AND gauge series go, the
+    # already-folded totals stay (work that happened, happened)
+    agg.prune({"r0", "r1"})
+    agg.publish_gauges()
+    text = reg.exposition()
+    for rid in ("r0", "r1"):
+        assert f'kyverno_fleet_agg_snapshot_age_seconds{{replica="{rid}"}}' \
+            in text
+    for rid in ("r2", "r3", "r4"):
+        assert f'replica="{rid}"' not in text
+    assert agg.totals()["admission_requests"] == 5.0
+    assert reg.fleet_agg_replicas_reporting.value() == 2.0
 
 
 # ---------------------------------------------------------------------------
