@@ -46,6 +46,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..resilience import storage as st
 from ..tpu.cache import (EncodeRowCache, _EncodedRows, extract_rows,
                          resource_content_hash)
 from ..tpu.flatten import (ROOT_HASH, VOCAB_MATRIX_FIELDS, EncodeConfig,
@@ -116,6 +117,10 @@ class _LaneTable:
         self.dead_rows = 0
         self.dead_entries = 0
         self.dirty = False
+        # degraded-storage memory mode: arenas fell back to anonymous
+        # arrays after an I/O error; the dir path is KEPT so a heal
+        # probe can rebuild the mmap backing (ColumnarStore.sync)
+        self.memory_only = False
         self.ids: "OrderedDict[str, int]" = OrderedDict()  # hash -> eid
         self.uid_segs: "OrderedDict[str, _UidSegs]" = OrderedDict()
         self.lanes: Dict[str, np.ndarray] = {}
@@ -199,16 +204,25 @@ class _LaneTable:
         cap = max(cap, self.GROW_MIN_ROWS)
         if self.lanes and next(iter(self.lanes.values())).shape[0] >= cap:
             return
-        if self.dir:
-            os.makedirs(self.dir, exist_ok=True)
-            new = {name: self._map(self._lane_path(name),
-                                   _ROW_LANE_DTYPES[name], (cap,))
-                   for name in _ROW_LANES}
-        else:
-            new = {name: np.zeros((cap,), dtype=_ROW_LANE_DTYPES[name])
-                   for name in _ROW_LANES}
-            for name, arr in self.lanes.items():
-                new[name][: arr.shape[0]] = arr
+        if self.dir and not self.memory_only:
+            try:
+                st.makedirs(self.dir, st.SURFACE_COLUMNAR)
+                self.lanes = {name: self._map(self._lane_path(name),
+                                              _ROW_LANE_DTYPES[name], (cap,))
+                              for name in _ROW_LANES}
+                return
+            except OSError as e:
+                # an arena grow hit the sick disk on the ENCODE path:
+                # fall back to anonymous arrays so the append (and its
+                # verdicts) proceed bit-identically — only durability
+                # degrades, counted on the columnar surface
+                st.storage_health(st.SURFACE_COLUMNAR).record_error(
+                    e, op="map_rows")
+                self.memory_only = True
+        new = {name: np.zeros((cap,), dtype=_ROW_LANE_DTYPES[name])
+               for name in _ROW_LANES}
+        for name, arr in self.lanes.items():
+            new[name][: arr.shape[0]] = arr
         self.lanes = new
 
     def _alloc_pool(self, cap: int) -> None:
@@ -216,19 +230,65 @@ class _LaneTable:
         if self.pool is not None and self.pool.shape[0] >= cap:
             return
         w = self.cfg.byte_pool_width
-        if self.dir:
-            os.makedirs(self.dir, exist_ok=True)
-            new_pool = self._map(os.path.join(self.dir, "pool.bin"),
-                                 np.uint8, (cap, w))
-            new_len = self._map(os.path.join(self.dir, "pool_len.bin"),
-                                np.int32, (cap,))
-        else:
-            new_pool = np.zeros((cap, w), dtype=np.uint8)
-            new_len = np.zeros((cap,), dtype=np.int32)
-            if self.pool is not None:
-                new_pool[: self.pool.shape[0]] = self.pool
-                new_len[: self.pool_len.shape[0]] = self.pool_len
+        if self.dir and not self.memory_only:
+            try:
+                st.makedirs(self.dir, st.SURFACE_COLUMNAR)
+                self.pool = self._map(os.path.join(self.dir, "pool.bin"),
+                                      np.uint8, (cap, w))
+                self.pool_len = self._map(
+                    os.path.join(self.dir, "pool_len.bin"), np.int32, (cap,))
+                return
+            except OSError as e:
+                st.storage_health(st.SURFACE_COLUMNAR).record_error(
+                    e, op="map_pool")
+                self.memory_only = True
+        new_pool = np.zeros((cap, w), dtype=np.uint8)
+        new_len = np.zeros((cap,), dtype=np.int32)
+        if self.pool is not None:
+            new_pool[: self.pool.shape[0]] = self.pool
+            new_len[: self.pool_len.shape[0]] = self.pool_len
         self.pool, self.pool_len = new_pool, new_len
+
+    def to_memory(self) -> None:
+        """Degraded-storage memory mode: copy every mmap arena into an
+        anonymous array and stop touching the disk. The dir path stays
+        so ``remount()`` can rebuild the backing on heal."""
+        if self.memory_only or not self.dir:
+            self.memory_only = True
+            return
+        lanes = {name: np.array(arr) for name, arr in self.lanes.items()}
+        pool = np.array(self.pool) if self.pool is not None else None
+        pool_len = np.array(self.pool_len) \
+            if self.pool_len is not None else None
+        self.lanes, self.pool, self.pool_len = lanes, pool, pool_len
+        self.memory_only = True
+        self.dirty = True
+
+    def remount(self) -> None:
+        """Heal: rebuild the mmap backing from the anonymous arenas —
+        fresh files written at current capacity, contents copied in.
+        Raises OSError (leaving the memory arenas untouched) if the
+        disk is still sick; the caller keeps the surface degraded."""
+        if not self.memory_only or not self.dir:
+            return
+        st.makedirs(self.dir, st.SURFACE_COLUMNAR)
+        new_lanes = {}
+        for name in _ROW_LANES:
+            arr = self._map(self._lane_path(name), _ROW_LANE_DTYPES[name],
+                            self.lanes[name].shape)
+            arr[:] = self.lanes[name]
+            new_lanes[name] = arr
+        new_pool = new_len = None
+        if self.pool is not None:
+            new_pool = self._map(os.path.join(self.dir, "pool.bin"),
+                                 np.uint8, self.pool.shape)
+            new_pool[:] = self.pool
+            new_len = self._map(os.path.join(self.dir, "pool_len.bin"),
+                                np.int32, self.pool_len.shape)
+            new_len[:] = self.pool_len
+        self.lanes, self.pool, self.pool_len = new_lanes, new_pool, new_len
+        self.memory_only = False
+        self.dirty = True  # next sync writes a fresh manifest
 
     def _ensure_entries(self, n: int) -> None:
         cap = self.row_off.shape[0]
@@ -311,9 +371,15 @@ class ColumnarStore:
         # dead rows (tests lower it to exercise the path)
         self.compact_min_rows = 1024
         if self.dir:
-            os.makedirs(self.dir, exist_ok=True)
-            with self._lock:
-                self._load_dir_locked()
+            try:
+                st.makedirs(self.dir, st.SURFACE_COLUMNAR)
+                with self._lock:
+                    self._load_dir_locked()
+            except OSError:
+                # unwritable store dir at boot (counted + degraded by
+                # the shim): every table starts in anonymous memory
+                # mode; sync()'s probes rebuild the backing on heal
+                pass
 
     def _registry(self):
         if self._metrics is None:
@@ -634,28 +700,42 @@ class ColumnarStore:
         psrc = np.repeat(t.pool_off[order], slots) + _within(slots, stotal)
         old_lanes, old_pool, old_len = t.lanes, t.pool, t.pool_len
         t.lanes, t.pool, t.pool_len = {}, None, None
-        if t.dir:
+        wrote_disk = False
+        if t.dir and not t.memory_only:
             # write fresh files then rename over: a concurrent reader's
             # old mapping survives on the unlinked inode
-            for name in _ROW_LANES:
-                path = t._lane_path(name)
-                tmp = path + ".tmp"
-                data = old_lanes[name][src]
-                with open(tmp, "wb") as f:
-                    f.write(np.ascontiguousarray(data).tobytes())
-                os.replace(tmp, path)
-            for path, data in ((os.path.join(t.dir, "pool.bin"),
-                                old_pool[psrc]),
-                               (os.path.join(t.dir, "pool_len.bin"),
-                                old_len[psrc])):
-                tmp = path + ".tmp"
-                with open(tmp, "wb") as f:
-                    f.write(np.ascontiguousarray(data).tobytes())
-                os.replace(tmp, path)
+            try:
+                for name in _ROW_LANES:
+                    path = t._lane_path(name)
+                    tmp = path + ".tmp"
+                    data = old_lanes[name][src]
+                    with st.open_truncate(tmp, st.SURFACE_COLUMNAR,
+                                          binary=True) as f:
+                        st.write_frame(
+                            f, np.ascontiguousarray(data).tobytes(),
+                            st.SURFACE_COLUMNAR, path=tmp)
+                    st.atomic_replace(tmp, path, st.SURFACE_COLUMNAR)
+                for path, data in ((os.path.join(t.dir, "pool.bin"),
+                                    old_pool[psrc]),
+                                   (os.path.join(t.dir, "pool_len.bin"),
+                                    old_len[psrc])):
+                    tmp = path + ".tmp"
+                    with st.open_truncate(tmp, st.SURFACE_COLUMNAR,
+                                          binary=True) as f:
+                        st.write_frame(
+                            f, np.ascontiguousarray(data).tobytes(),
+                            st.SURFACE_COLUMNAR, path=tmp)
+                    st.atomic_replace(tmp, path, st.SURFACE_COLUMNAR)
+                wrote_disk = True
+            except OSError:
+                # mid-compaction I/O error (counted + degraded by the
+                # shim): finish the compaction into anonymous arenas —
+                # the row data lives in old_lanes/old_pool, nothing lost
+                t.memory_only = True
         t.rows_used, t.pool_used = total, stotal
         t._alloc_rows(max(total, t.GROW_MIN_ROWS))
         t._alloc_pool(max(stotal, t.GROW_MIN_SLOTS))
-        if not t.dir:
+        if not wrote_disk:
             if total:
                 for name in _ROW_LANES:
                     t.lanes[name][:total] = old_lanes[name][src]
@@ -696,10 +776,24 @@ class ColumnarStore:
         it.)"""
         if not self.dir:
             return
+        health = st.storage_health(st.SURFACE_COLUMNAR)
+        if not health.allow():
+            return  # degraded, no probe due: stay on anonymous arenas
+        if health.degraded:
+            # a due re-probe: try to rebuild the mmap backing for every
+            # memory-mode table; still-sick disks keep us degraded
+            try:
+                with self._lock:
+                    for t in self._tables.values():
+                        t.remount()
+            except OSError as e:
+                health.record_error(e, op="remount")
+                return
+            health.record_success()
         snaps = []
         with self._lock:
             for t in self._tables.values():
-                if not t.dirty or not t.dir:
+                if not t.dirty or not t.dir or t.memory_only:
                     continue
                 n = t.n_entries
                 snaps.append({
@@ -731,19 +825,32 @@ class ColumnarStore:
                 t.dirty = False
         for snap in snaps:
             t, man = snap["t"], snap["manifest"]
-            for arr in list(snap["lanes"].values()) + [snap["pool"],
-                                                       snap["pool_len"]]:
-                if isinstance(arr, np.memmap):
-                    arr.flush()
-            man["checksum"] = _content_checksum(
-                snap["lanes"], snap["pool"], snap["pool_len"],
-                man["rows_used"], man["pool_used"])
-            man["entries_checksum"] = _entries_checksum(
-                man["entries"], man["ids"])
-            tmp = self._manifest_path(t) + ".tmp"
-            with open(tmp, "w") as f:
-                json.dump(man, f)
-            os.replace(tmp, self._manifest_path(t))
+            try:
+                for arr in list(snap["lanes"].values()) + [snap["pool"],
+                                                           snap["pool_len"]]:
+                    if isinstance(arr, np.memmap):
+                        st.mmap_sync(arr, st.SURFACE_COLUMNAR, path=t.dir)
+                man["checksum"] = _content_checksum(
+                    snap["lanes"], snap["pool"], snap["pool_len"],
+                    man["rows_used"], man["pool_used"])
+                man["entries_checksum"] = _entries_checksum(
+                    man["entries"], man["ids"])
+                tmp = self._manifest_path(t) + ".tmp"
+                with st.open_truncate(tmp, st.SURFACE_COLUMNAR) as f:
+                    st.write_frame(f, json.dumps(man), st.SURFACE_COLUMNAR,
+                                   path=tmp)
+                st.atomic_replace(tmp, self._manifest_path(t),
+                                  st.SURFACE_COLUMNAR)
+            except OSError:
+                # sick disk mid-sync (counted + degraded by the shim):
+                # drop this table — and any we haven't flushed yet — to
+                # anonymous arenas; reads keep serving bit-identically
+                with self._lock:
+                    t.dirty = True
+                    for tbl in self._tables.values():
+                        if tbl.dir:
+                            tbl.to_memory()
+                return
 
     def _load_dir_locked(self) -> None:
         """Reattach every valid table under ``self.dir``; anything
@@ -844,7 +951,8 @@ class ColumnarStore:
                 "dead_rows": t.dead_rows,
                 "uids_tracked": len(t.uid_segs),
                 "bytes": t.row_bytes(),
-                "mmap": bool(t.dir),
+                "mmap": bool(t.dir) and not t.memory_only,
+                "memory_only": t.memory_only,
             } for t in self._tables.values()]
         return {
             "enabled": True,

@@ -577,8 +577,16 @@ class FlightRecorder:
         the path, or None (no dir / cooldown). Auto-triggers (breaker
         transitions, SLO burns) respect a cooldown so a flapping
         breaker cannot flood the disk; explicit dumps force."""
+        from ..resilience import storage as st
+
         spool_dir = self.spool_dir
         if not spool_dir:
+            return None
+        # degraded-storage ladder (surface flight_spool): while the
+        # disk is sick, spools are counted drops — the in-memory ring
+        # keeps recording, and a due re-probe lets one spool attempt
+        # through to heal the surface
+        if not st.storage_health(st.SURFACE_FLIGHT).allow():
             return None
         now = self._clock()
         with self._lock:
@@ -590,16 +598,21 @@ class FlightRecorder:
             records = list(self._ring)
             self.stats["spools"] += 1
         try:
-            os.makedirs(spool_dir, exist_ok=True)
+            st.makedirs(spool_dir, st.SURFACE_FLIGHT)
             safe = "".join(c if (c.isalnum() or c in "-_.") else "_"
                            for c in reason)[:60] or "spool"
             path = os.path.join(
                 spool_dir, f"flight-{int(time.time())}-{seq:04d}-"
                            f"{safe}.ndjson")
-            with open(path, "w", encoding="utf-8") as fh:
+            # one frame per record: a write that dies mid-segment
+            # leaves whole-line prefixes load_capture() can still read
+            with st.open_truncate(path, st.SURFACE_FLIGHT) as fh:
                 for rec in records:
-                    json.dump(rec.to_dict(self.body_cap), fh, default=str)
-                    fh.write("\n")
+                    st.write_frame(
+                        fh,
+                        json.dumps(rec.to_dict(self.body_cap),
+                                   default=str) + "\n",
+                        st.SURFACE_FLIGHT, path=path)
         except OSError:
             return None
         dropped = self._prune_spool_segments(spool_dir)
@@ -627,15 +640,21 @@ class FlightRecorder:
         """Append one shadow-verification divergence (the full record +
         both verdict tables) to ``divergences.ndjson`` in the spool
         dir — no cooldown: every divergence is evidence."""
+        from ..resilience import storage as st
+
         spool_dir = self.spool_dir
         if not spool_dir:
+            return None
+        # its own surface (``divergences``): divergence evidence and
+        # routine flight spools degrade independently
+        if not st.storage_health(st.SURFACE_DIVERGENCES).allow():
             return None
         doc = {"kind": "divergence", "ts": round(time.time(), 3),
                "record": record_doc,
                "expected": [[p, r, int(c)] for (p, r), c in expected],
                "got": [[p, r, int(c)] for (p, r), c in got]}
         try:
-            os.makedirs(spool_dir, exist_ok=True)
+            st.makedirs(spool_dir, st.SURFACE_DIVERGENCES)
             path = os.path.join(spool_dir, "divergences.ndjson")
             dropped = self._rotate_divergences(path)
             with self._lock:
@@ -644,9 +663,9 @@ class FlightRecorder:
                     self.stats["divergence_segments_dropped"] = \
                         self.stats.get("divergence_segments_dropped", 0) \
                         + dropped
-            with open(path, "a", encoding="utf-8") as fh:
-                json.dump(doc, fh, default=str)
-                fh.write("\n")
+            with st.open_append(path, st.SURFACE_DIVERGENCES) as fh:
+                st.write_frame(fh, json.dumps(doc, default=str) + "\n",
+                               st.SURFACE_DIVERGENCES, path=path)
         except OSError:
             return None
         return path
@@ -685,7 +704,15 @@ class FlightRecorder:
         """Size-capped rotation for divergences.ndjson: once the live
         file exceeds ``divergence_max_bytes`` it shifts to ``.1`` (and
         ``.1``->``.2``, ...), keeping the newest ``max_spool_segments``
-        rotated segments. Returns segments dropped off the end."""
+        rotated segments. Returns segments dropped off the end.
+
+        Every step of the replace chain goes through the storage shim:
+        each rename either fully lands or leaves the previous file
+        intact (os.replace is atomic), so a mid-rotation EIO is a
+        counted degrade that leaves every segment a loadable NDJSON
+        prefix — never a torn or vanished file."""
+        from ..resilience import storage as st
+
         cap = self.divergence_max_bytes
         if cap <= 0:
             return 0
@@ -707,11 +734,12 @@ class FlightRecorder:
             src = f"{path}.{i}"
             if os.path.exists(src):
                 try:
-                    os.replace(src, f"{path}.{i + 1}")
+                    st.atomic_replace(src, f"{path}.{i + 1}",
+                                      st.SURFACE_DIVERGENCES)
                 except OSError:
-                    pass
+                    pass  # counted + degraded by the shim; chain goes on
         try:
-            os.replace(path, f"{path}.1")
+            st.atomic_replace(path, f"{path}.1", st.SURFACE_DIVERGENCES)
         except OSError:
             return dropped
         if dropped:

@@ -57,7 +57,12 @@ class OpLog:
                   stderr: Optional[bool] = None) -> None:
         """``path``: JSONL sink file (append; "" / None leaves the file
         sink untouched, "off" closes it). ``stderr``: toggle the human-
-        format stderr sink."""
+        format stderr sink. An unopenable file sink degrades the
+        ``oplog`` storage surface instead of raising — stderr still
+        narrates, and emit()'s re-probes retry the open."""
+        from ..resilience import storage as st
+
+        err: Optional[OSError] = None
         with self._lock:
             if path == "off":
                 if self._fh is not None:
@@ -72,10 +77,18 @@ class OpLog:
                         self._fh.close()
                     except Exception:
                         pass
-                self._fh = open(path, "a", encoding="utf-8")
-                self._path = path
+                try:
+                    self._fh = st.open_append(path, st.SURFACE_OPLOG,
+                                              record=False)
+                except OSError as e:
+                    self._fh, err = None, e
+                self._path = path  # kept: emit()'s probes retry the open
             if stderr is not None:
                 self._stderr = stderr
+        if err is not None:
+            # recorded OUTSIDE our lock: the degrade transition's own
+            # op-log event re-enters emit()
+            st.storage_health(st.SURFACE_OPLOG).record_error(err, op="open")
 
     def reset(self) -> None:
         self.configure(path="off", stderr=False)
@@ -84,7 +97,8 @@ class OpLog:
 
     @property
     def enabled(self) -> bool:
-        return self._stderr or self._fh is not None
+        return self._stderr or self._fh is not None \
+            or self._path is not None
 
     def state(self) -> Dict[str, Any]:
         with self._lock:
@@ -94,7 +108,8 @@ class OpLog:
     # -- emission
 
     def emit(self, event: str, level: str = "info", **fields: Any) -> None:
-        if not (self._stderr or self._fh is not None):
+        if not (self._stderr or self._fh is not None
+                or self._path is not None):
             with self._lock:
                 self.events_emitted += 1  # counted even when unsunk (tests)
             return
@@ -120,18 +135,41 @@ class OpLog:
         for k, v in fields.items():
             if v is not None:
                 rec[k] = v
+        # degraded-storage ladder (surface ``oplog``): the file sink is
+        # drop-and-count while the disk is sick — the stderr sink keeps
+        # narrating regardless. Health accounting happens AFTER our
+        # (non-reentrant) lock is released, because the degrade/heal
+        # transition emits an op-log event of its own.
+        from ..resilience import storage as st
+
+        health = st.storage_health(st.SURFACE_OPLOG)
+        err: Optional[OSError] = None
+        wrote = False
         with self._lock:
             self.events_emitted += 1
-            if self._fh is not None:
-                json.dump(rec, self._fh, default=str)
-                self._fh.write("\n")
-                self._fh.flush()
+            if self._path is not None and health.allow():
+                try:
+                    if self._fh is None:
+                        self._fh = st.open_append(self._path,
+                                                  st.SURFACE_OPLOG,
+                                                  record=False)
+                    st.write_frame(self._fh,
+                                   json.dumps(rec, default=str) + "\n",
+                                   st.SURFACE_OPLOG, path=self._path,
+                                   flush=True, record=False)
+                    wrote = True
+                except OSError as e:
+                    err = e
             if self._stderr:
                 extras = " ".join(
                     f"{k}={v}" for k, v in rec.items()
                     if k not in ("ts", "level", "event"))
                 print(f"{rec['ts']} {level.upper():5s} {event} "
                       f"{extras}".rstrip(), file=sys.stderr)
+        if err is not None:
+            health.record_error(err, op="write")
+        elif wrote:
+            health.record_success()
 
 
 global_oplog = OpLog()

@@ -618,6 +618,20 @@ class MetricsRegistry:
         self.faults_injected = self.counter(
             "kyverno_resilience_faults_injected_total",
             "injected faults fired by site and mode")
+        # degraded-storage ladder (resilience/storage.py): OS-level I/O
+        # errors per durability surface, which surfaces are currently
+        # running in their memory mode, and completed heals — a full
+        # disk must be an alert with a bounded blast radius, never a
+        # crash or a wrong verdict
+        self.storage_errors = self.counter(
+            "kyverno_storage_errors_total",
+            "storage I/O errors by durability surface and error kind")
+        self.storage_degraded = self.gauge(
+            "kyverno_storage_degraded",
+            "1 while a durability surface runs degraded (memory mode)")
+        self.storage_heals = self.counter(
+            "kyverno_storage_heals_total",
+            "degraded->ok heals per durability surface")
         # policy-set lifecycle (lifecycle/manager.py): the served
         # compiled revision, hot-swap promotions, compile-ahead
         # failures, and the quarantine population — a policy churn

@@ -160,13 +160,20 @@ class OTLPJsonFileExporter:
     and streamable, loadable by any OTLP-JSON-aware tool."""
 
     def __init__(self, path: str, service_name: str = "kyverno-tpu") -> None:
+        from ..resilience import storage as st
+
         self.path = path
         self.service_name = service_name
         self._lock = threading.Lock()
         # monotonic -> wall anchor taken once, so a run's spans share a
         # consistent epoch even if the system clock steps mid-run
         self._epoch = time.time() - time.monotonic()
-        self._fh = open(path, "a", buffering=1)
+        try:
+            self._fh = st.open_append(path, st.SURFACE_TRACE, buffering=1)
+        except OSError:
+            # degraded from birth (read-only/full disk at boot): spans
+            # drop-and-count; __call__'s probes retry the open
+            self._fh = None
 
     def _nanos(self, monotonic_t: float) -> str:
         return str(int((monotonic_t + self._epoch) * 1e9))
@@ -197,13 +204,29 @@ class OTLPJsonFileExporter:
             "scopeSpans": [{"scope": {"name": "kyverno_tpu"},
                             "spans": [otlp_span]}],
         }]})
+        # degraded-storage ladder (surface trace_export): a span is
+        # never worth blocking or crashing the span-finishing thread
+        # for — while the disk is sick, export is a counted drop, and
+        # a due re-probe retries the open/write until it heals
+        from ..resilience import storage as st
+
+        if not st.storage_health(st.SURFACE_TRACE).allow():
+            return
         with self._lock:
-            self._fh.write(line + "\n")
+            try:
+                if self._fh is None:
+                    self._fh = st.open_append(self.path, st.SURFACE_TRACE,
+                                              buffering=1)
+                st.write_frame(self._fh, line + "\n", st.SURFACE_TRACE,
+                               path=self.path)
+            except OSError:
+                pass
 
     def close(self) -> None:
         with self._lock:
             try:
-                self._fh.close()
+                if self._fh is not None:
+                    self._fh.close()
             except Exception:
                 pass
 

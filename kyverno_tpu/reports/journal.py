@@ -101,15 +101,25 @@ def _rows_checksum(seq: int, rows: List[Any]) -> str:
 def write_snapshot(path: str, seq: int, rows: List[Any]) -> None:
     """Atomic compacted snapshot: serialized to ``.tmp``, fsynced,
     renamed into place — a crash mid-write leaves the previous
-    snapshot untouched."""
+    snapshot untouched. Every step routes through the storage shim
+    (surface ``reports``) so a full/erroring disk degrades the store
+    to memory-only folding instead of raising out of compaction."""
+    from ..resilience import storage as st
+
     body = {"version": SNAPSHOT_VERSION, "seq": seq, "rows": rows,
             "checksum": _rows_checksum(seq, rows)}
     tmp = path + ".tmp"
-    with open(tmp, "w", encoding="utf-8") as f:
-        json.dump(body, f, separators=(",", ":"))
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, path)
+    try:
+        with st.open_truncate(tmp, st.SURFACE_REPORTS) as f:
+            st.write_frame(f, canonical(body), st.SURFACE_REPORTS, path=tmp)
+            st.fsync(f, st.SURFACE_REPORTS, path=tmp)
+        st.atomic_replace(tmp, path, st.SURFACE_REPORTS)
+    except OSError:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
 
 
 def load_snapshot(path: str) -> Optional[Tuple[int, List[Any]]]:

@@ -33,6 +33,7 @@ from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
 
 from ..cluster.reports import RESULT_NAMES, PolicyReport, ReportResult
 from ..observability.metrics import global_registry
+from ..resilience import storage as st
 from ..resilience.faults import (SITE_REPORTS_FOLD, SITE_REPORTS_JOURNAL,
                                  global_faults)
 from . import journal as jn
@@ -66,10 +67,14 @@ class ReportStore:
         self._seq = 0                            # guarded-by: _lock
         self._journal_fh = None                  # guarded-by: _lock
         self._journal_bytes = 0                  # guarded-by: _lock
+        self._heal_compact = False               # guarded-by: _lock
         self.stats = {"recovered_records": 0, "verify_checks": 0,
                       "compactions": 0}          # guarded-by: _lock
         if directory:
-            os.makedirs(directory, exist_ok=True)
+            try:
+                st.makedirs(directory, st.SURFACE_REPORTS)
+            except OSError:
+                pass  # degraded at boot: fold in memory, heal by probe
             with self._lock:
                 self._load_locked()
 
@@ -182,9 +187,25 @@ class ReportStore:
     def _journal_locked(self, doc: Dict[str, Any]) -> None:
         self._seq += 1
         doc["seq"] = self._seq
-        if self._journal_fh is None:
+        if not self.directory:
             return
+        health = st.storage_health(st.SURFACE_REPORTS)
+        if not health.allow():
+            # degraded storage, no re-probe due: memory-only folding.
+            # The fold stays bit-identical; only durability is lost,
+            # and the loss is counted like any other failed append.
+            self.metrics.reports_recoveries.inc(
+                {"reason": jn.REASON_APPEND_ERROR})
+            return
+        was_degraded = health.degraded
+        jpath = os.path.join(self.directory, jn.JOURNAL_NAME)
         try:
+            if self._journal_fh is None:
+                # a boot-time or mid-run open failure left us without a
+                # WAL: each granted probe retries the open itself
+                self._journal_fh = st.open_append(jpath, st.SURFACE_REPORTS,
+                                                  binary=True)
+                self._journal_bytes = self._journal_fh.tell()
             global_faults.fire(SITE_REPORTS_JOURNAL,
                                payload=str(doc.get("uid", "")))
             text = jn.canonical(doc)
@@ -196,21 +217,41 @@ class ReportStore:
             wire = payload if wire_text is text \
                 else str(wire_text or "").encode("utf-8")
             rec = jn.frame(payload, wire=wire)
-            self._journal_fh.write(rec)
-            self._journal_fh.flush()
+            st.write_frame(self._journal_fh, rec, st.SURFACE_REPORTS,
+                           path=jpath, flush=True)
             self._journal_bytes += len(rec)
             self.metrics.reports_journal_records.inc()
             self.metrics.reports_journal_bytes.set(float(self._journal_bytes))
         except Exception:
             # a failed append must not take report maintenance down:
             # the delta still folds in memory and the LOSS is counted —
-            # after a restart the state is older, never wrong
+            # after a restart the state is older, never wrong. (An
+            # OSError also degraded the reports surface via the shim.)
             self.metrics.reports_recoveries.inc(
                 {"reason": jn.REASON_APPEND_ERROR})
+            return
+        if was_degraded and not health.degraded:
+            # the probe append landed: the surface just healed. The
+            # on-disk journal has a hole (drops while degraded), so
+            # durability is re-established by an immediate compaction.
+            # Deferred to after the caller's fold: compacting HERE
+            # would snapshot state without this very delta and then
+            # truncate its journal record — losing the healing row.
+            self._heal_compact = True
 
     def _maybe_compact_locked(self) -> None:
+        if self._heal_compact:
+            # full in-memory state (healing delta now folded) to
+            # snapshot, journal truncated: durability re-established
+            self._heal_compact = False
+            self._compact_locked()
+            return
         if self._journal_fh is not None \
-                and self._journal_bytes > self.journal_max_bytes:
+                and self._journal_bytes > self.journal_max_bytes \
+                and not st.storage_health(st.SURFACE_REPORTS).degraded:
+            # while degraded, compaction would just hammer the sick
+            # disk — the journal-append probes own the heal path, and
+            # healing compacts immediately anyway
             self._compact_locked()
 
     def _compact_locked(self) -> None:
@@ -294,9 +335,10 @@ class ReportStore:
             self.metrics.reports_recoveries.inc({"reason": jn.REASON_REPLAY})
             self.stats["recovered_records"] += replayed
         try:
-            self._journal_fh = open(jpath, "ab")
+            self._journal_fh = st.open_append(jpath, st.SURFACE_REPORTS,
+                                              binary=True)
         except OSError:
-            self._journal_fh = None
+            self._journal_fh = None  # degraded: appends probe the re-open
         self._journal_bytes = len(data)
         self.metrics.reports_journal_bytes.set(float(self._journal_bytes))
         self.metrics.reports_resources.set(float(len(self._rows)))
@@ -440,7 +482,10 @@ def configure_reports(directory: Optional[str] = None, enabled: bool = True,
             _store = None
             return None
         if directory:
-            os.makedirs(directory, exist_ok=True)
+            try:
+                os.makedirs(directory, exist_ok=True)
+            except OSError:
+                pass  # ReportStore.__init__ routes this through the ladder
         kw: Dict[str, Any] = {}
         if journal_max_bytes is not None:
             kw["journal_max_bytes"] = journal_max_bytes

@@ -9,13 +9,18 @@ layers:
   the pluggable context backends and the GlobalContext refresh loop.
 - ``faults``: named-site fault injection (``KYVERNO_TPU_FAULTS``) so
   chaos behavior is reproducible in CI.
+- ``storage``: the shim every durability surface writes through, plus
+  the per-surface OK/DEGRADED ladder that turns ENOSPC/EIO/EROFS into
+  a counted memory-mode instead of a crash.
 """
 
 from .breaker import CLOSED, HALF_OPEN, OPEN, CircuitBreaker, tpu_breaker
 from .faults import (FaultConfigError, FaultInjected, FaultRegistry,
-                     FaultSpec, global_faults)
+                     FaultSpec, ShortWrite, global_faults)
 from .retry import (DEFAULT_RETRY, Deadline, PermanentError,
                     RetryBudgetExceeded, RetryPolicy, retry_call)
+from .storage import (StorageHealth, StorageHealthRegistry, global_storage,
+                      reset_storage, storage_health, storage_state)
 
 __all__ = [
     "CLOSED",
@@ -31,7 +36,14 @@ __all__ = [
     "PermanentError",
     "RetryBudgetExceeded",
     "RetryPolicy",
+    "ShortWrite",
+    "StorageHealth",
+    "StorageHealthRegistry",
     "global_faults",
+    "global_storage",
+    "reset_storage",
     "retry_call",
+    "storage_health",
+    "storage_state",
     "tpu_breaker",
 ]

@@ -42,6 +42,23 @@ the failure physically happens:
                         the in-memory fold still lands); corrupt writes
                         a mangled wire record the replay ladder must
                         truncate at
+    storage.open        opening/creating a durability file or dir
+                        (resilience/storage.py shim) — every surface's
+                        open_append/makedirs routes through it
+    storage.write       a durability write (journal frame, spool line,
+                        oplog record, span export, arena flush)
+    storage.fsync       the fsync of a durability file
+    storage.replace     the atomic os.replace() publishing a snapshot,
+                        manifest, or rotated spool file
+
+The four ``storage.*`` sites additionally accept the OS-error modes
+``enospc`` / ``eio`` / ``erofs`` — ``fire()`` raises a real ``OSError``
+with the matching errno instead of ``FaultInjected``, so the injected
+failure and a genuine disk failure travel the SAME except-clause — and
+``storage.write`` accepts ``short`` (write a partial prefix, then
+raise EIO: the torn-write fixture). Scope a storage fault to one
+surface with ``match=<surface>`` (the shim's payload is
+``"<surface>:<path>"``).
 
 Tests (and the ``KYVERNO_TPU_FAULTS`` env knob) arm a site with a
 probability- or count-based trigger and a mode — ``raise``, ``delay``,
@@ -76,6 +93,7 @@ Env syntax (';'-separated site specs)::
 
 from __future__ import annotations
 
+import errno as _errno
 import os
 import threading
 import time
@@ -100,6 +118,10 @@ SITE_MUTATE_TRIAGE = "mutate.triage"
 SITE_MUTATE_PATCH = "mutate.patch"
 SITE_REPORTS_FOLD = "reports.fold"
 SITE_REPORTS_JOURNAL = "reports.journal"
+SITE_STORAGE_OPEN = "storage.open"
+SITE_STORAGE_WRITE = "storage.write"
+SITE_STORAGE_FSYNC = "storage.fsync"
+SITE_STORAGE_REPLACE = "storage.replace"
 
 KNOWN_SITES = frozenset({
     SITE_TPU_DISPATCH, SITE_CONTEXT_API_CALL, SITE_CONTEXT_IMAGE_DATA,
@@ -109,9 +131,25 @@ KNOWN_SITES = frozenset({
     SITE_FLEET_TELEMETRY,
     SITE_MUTATE_TRIAGE, SITE_MUTATE_PATCH,
     SITE_REPORTS_FOLD, SITE_REPORTS_JOURNAL,
+    SITE_STORAGE_OPEN, SITE_STORAGE_WRITE, SITE_STORAGE_FSYNC,
+    SITE_STORAGE_REPLACE,
 })
 
-MODES = ("raise", "delay", "corrupt", "crash")
+MODES = ("raise", "delay", "corrupt", "crash",
+         "enospc", "eio", "erofs", "short")
+
+# OS-error modes: fire() raises OSError with the matching errno — the
+# SAME exception class and errno a real full/erroring/read-only disk
+# produces, so the degraded-storage ladder cannot tell (and must not
+# care) whether the failure was injected or genuine.
+OS_ERROR_MODES = {
+    "enospc": _errno.ENOSPC,
+    "eio": _errno.EIO,
+    "erofs": _errno.EROFS,
+}
+
+STORAGE_SITES = frozenset({SITE_STORAGE_OPEN, SITE_STORAGE_WRITE,
+                           SITE_STORAGE_FSYNC, SITE_STORAGE_REPLACE})
 
 # sites whose result flows through FaultRegistry.corrupt(); every other
 # site only has the fire() (raise/delay) hook. fleet.telemetry filters
@@ -129,6 +167,16 @@ CRASHABLE_SITES = frozenset({SITE_ENCODE_WORKER})
 
 class FaultInjected(RuntimeError):
     """The error an armed ``raise`` fault throws at its site."""
+
+
+class ShortWrite(OSError):
+    """Raised by an armed ``short`` fault at ``storage.write``. The
+    write shim catches it, writes a partial prefix of the buffer for
+    real, then re-raises it as the EIO a torn write surfaces as — the
+    fixture for every loadable-prefix recovery property."""
+
+    def __init__(self) -> None:
+        super().__init__(_errno.EIO, "injected short write")
 
 
 class FaultConfigError(ValueError):
@@ -246,6 +294,15 @@ class FaultRegistry:
                 f"site {site!r} does not run in a supervised child process "
                 f"(crashable: {sorted(CRASHABLE_SITES)}) — crashing it "
                 f"would kill the engine, not exercise recovery")
+        if mode in OS_ERROR_MODES and site not in STORAGE_SITES:
+            raise FaultConfigError(
+                f"mode {mode!r} is an OS-error mode; only the storage shim "
+                f"sites ({sorted(STORAGE_SITES)}) route OSError through the "
+                f"degraded-storage ladder")
+        if mode == "short" and site != SITE_STORAGE_WRITE:
+            raise FaultConfigError(
+                f"mode 'short' (partial write then EIO) is only meaningful "
+                f"at {SITE_STORAGE_WRITE!r}")
         spec = FaultSpec(site=site, mode=mode, p=p, count=count,
                          delay_s=delay_s, seed=seed, match=match, flip=flip)
         with self._lock:
@@ -329,6 +386,12 @@ class FaultRegistry:
             # the supervised-worker death path: no cleanup, no excuses —
             # exactly what an OOM kill or a segfaulting extension does
             os._exit(70)
+        if spec.mode in OS_ERROR_MODES:
+            code = OS_ERROR_MODES[spec.mode]
+            raise OSError(code, os.strerror(code), str(payload() if
+                          callable(payload) else payload or site))
+        if spec.mode == "short":
+            raise ShortWrite()
         raise FaultInjected(f"injected fault at {site}")
 
     def corrupt(self, site: str, value: Any) -> Any:

@@ -461,7 +461,16 @@ def enable_xla_compile_cache(cache_dir: Optional[str] = None,
     programs then survive process restarts: a serve restart or the
     bench probe warm-starts in seconds instead of re-paying the full
     XLA build. ``none``/``off``/empty disables. Idempotent; returns
-    the active directory or None when disabled."""
+    the active directory or None when disabled.
+
+    An unwritable cache dir (read-only/full disk) NEVER fails a
+    compile: the persistent cache is simply not enabled — one op-log
+    event, the ``xla_cache`` storage surface degrades, and every
+    compile runs warm-start-less but correct. The writability check is
+    a real probe-file write: ``makedirs(exist_ok=True)`` succeeds on an
+    existing dir even on a read-only filesystem."""
+    from ..resilience import storage as stg
+
     global _xla_cache_dir
     if cache_dir is None:
         cache_dir = os.environ.get("KYVERNO_TPU_XLA_CACHE_DIR",
@@ -474,7 +483,20 @@ def enable_xla_compile_cache(cache_dir: Optional[str] = None,
             return cache_dir
         import jax
 
-        os.makedirs(cache_dir, exist_ok=True)
+        try:
+            stg.makedirs(cache_dir, stg.SURFACE_XLA_CACHE)
+            stg.probe_writable(cache_dir, stg.SURFACE_XLA_CACHE)
+        except OSError:
+            # degraded + counted by the shim; announce the single
+            # consequence (no warm starts) and keep compiling
+            try:
+                from ..observability.log import global_oplog
+
+                global_oplog.emit("xla_cache_disabled", level="warn",
+                                  dir=cache_dir)
+            except Exception:
+                pass
+            return None
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         # default thresholds skip small/fast programs; a policy set's
         # device_fn at MIN_BUCKET can compile fast on CPU yet still be

@@ -764,6 +764,18 @@ class Handlers:
                         breached.append("fleet_divergence")
         except Exception:
             pass
+        # storage advisory: degraded durability surfaces ride readiness
+        # the same way SLO burn does — visible, NEVER fatal. A replica
+        # on a full disk still serves bit-identical verdicts; flipping
+        # readiness would trade reduced durability for an outage.
+        try:
+            from ..resilience.storage import global_storage
+
+            degraded = global_storage.degraded_surfaces()
+            if degraded:
+                detail["storage_degraded"] = degraded
+        except Exception:
+            pass
         ok = compiled and breaker.state != "open"
         detail["ready"] = ok
         return ok, detail
@@ -823,6 +835,7 @@ class Handlers:
             "flight": _flight_state(),
             "verification": _verification_state(),
             "fleet": _fleet_state(),
+            "storage": _storage_state(),
             "phase_breakdown": global_profiler.breakdown(),
         }
         if self.pipeline is not None:
@@ -1458,6 +1471,19 @@ def _columnar_state():
         return store_state()
     except Exception:
         return {"enabled": False}
+
+
+def _storage_state():
+    """The degraded-storage ladder's /debug/state block: per-surface
+    ok/degraded state, error/drop/heal counts — only surfaces that
+    have actually been exercised appear (introspection must not
+    invent health state for unused surfaces)."""
+    try:
+        from ..resilience.storage import storage_state
+
+        return storage_state()
+    except Exception:
+        return {}
 
 
 def _reports_state():

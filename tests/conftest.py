@@ -97,6 +97,18 @@ def _fresh_reports():
     reset_reports()
 
 
+# the degraded-storage ladder (resilience/storage.py) is process-
+# global per surface: a test that degrades a surface (injected ENOSPC
+# etc.) must not leave the next test's durability writes gated
+@pytest.fixture(autouse=True)
+def _fresh_storage_health():
+    from kyverno_tpu.resilience.storage import reset_storage
+
+    reset_storage()
+    yield
+    reset_storage()
+
+
 # the fleet manager (fleet/manager.py) is process-global like the
 # caches: a test that configures replicas must not leak membership,
 # peer breakers, or the verdict-cache fan-out hook into the next test

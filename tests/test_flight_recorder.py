@@ -544,3 +544,81 @@ def test_divergence_rotation_zero_cap_disables(tmp_path):
     assert not [n for n in os.listdir(spool)
                 if n.startswith("divergences.ndjson.")]
     assert len((spool / "divergences.ndjson").read_text().splitlines()) == 20
+
+
+# ---------------------------------------------------------------------------
+# degraded-storage ladder on the spool surfaces (ISSUE 19)
+
+
+def test_spool_short_write_mid_segment_leaves_loadable_prefix(tmp_path):
+    """A write that dies mid-segment (torn by the storage.write short
+    fault: half the third frame really lands) must leave a capture
+    whose whole-line prefix still loads — and the surface degrades
+    instead of the caller raising."""
+    from kyverno_tpu.resilience import storage as rst
+
+    spool = tmp_path / "sp"
+    global_flight.configure(capacity=8, sample_rate=1.0,
+                            spool_dir=str(spool))
+    for i in range(4):
+        global_flight.record_admission(
+            {"kind": "Pod", "metadata": {"name": f"p{i}"}},
+            [(("pol", "r"), 0)], "batched")
+    # Random(0) draws 0.844, 0.758, 0.421 against p=0.5: the first two
+    # frames land whole, the THIRD write tears — deterministic chaos
+    global_faults.arm("storage.write", mode="short", p=0.5, seed=0)
+    try:
+        assert global_flight.spool(force=True) is None  # no raise
+    finally:
+        global_faults.disarm()
+    h = rst.storage_health(rst.SURFACE_FLIGHT)
+    assert h.degraded
+    segs = [n for n in os.listdir(spool) if n.startswith("flight-")]
+    assert len(segs) == 1
+    torn = load_capture(os.path.join(spool, segs[0]))
+    assert [r["resource"]["metadata"]["name"] for r in torn] == ["p0", "p1"]
+    # the ring was untouched: a probe spool after heal captures all 4
+    h.force_probe()
+    out = global_flight.spool(force=True)
+    assert out is not None and not h.degraded
+    assert len(load_capture(out)) == 4
+
+
+def test_rotation_replace_fault_counts_and_keeps_evidence(tmp_path):
+    """EIO on the rotation's os.replace chain: the error is counted on
+    the divergences surface, the live file is left intact (os.replace
+    is atomic — failed means unmoved), the divergence evidence still
+    appends, and every file on disk stays whole-line loadable."""
+    from kyverno_tpu.observability.metrics import global_registry
+    from kyverno_tpu.resilience import storage as rst
+
+    spool = tmp_path / "sp"
+    # one ~150-byte line blows the cap: EVERY divergence rotates
+    global_flight.configure(sample_rate=1.0, spool_dir=str(spool),
+                            divergence_max_bytes=100, max_spool_segments=3)
+    doc = {"seq": 1, "resource": {"kind": "Pod",
+                                  "metadata": {"name": "p"}}}
+    exp, got = [(("pol", "r"), 0)], [(("pol", "r"), 2)]
+    for _ in range(3):
+        assert global_flight.spool_divergence(doc, exp, got)
+    errors0 = global_registry.storage_errors.value(
+        {"surface": "divergences", "kind": "eio"})
+    # fail ONE os.replace of the next rotation's shift chain
+    global_faults.arm("storage.replace", mode="eio", count=1,
+                      match="divergences")
+    try:
+        path = global_flight.spool_divergence(doc, exp, got)
+    finally:
+        global_faults.disarm()
+    assert path is not None  # evidence landed despite the failed rotate
+    assert global_registry.storage_errors.value(
+        {"surface": "divergences", "kind": "eio"}) == errors0 + 1
+    assert rst.storage_health(rst.SURFACE_DIVERGENCES).state()["errors"] >= 1
+    # os.replace is atomic: a failed step means unmoved, never torn —
+    # every file on disk is still whole-line NDJSON evidence
+    assert load_capture(str(spool / "divergences.ndjson"))
+    for name in os.listdir(spool):
+        for rec in load_capture(os.path.join(spool, name)):
+            assert rec["resource"]["metadata"]["name"] == "p"
+    # the flight_spool surface never saw the divergence-side fault
+    assert not rst.storage_health(rst.SURFACE_FLIGHT).degraded

@@ -266,6 +266,10 @@ def test_exposition_format_is_scrapeable():
     reg.fleet_agg_replicas_reporting.set(3)
     reg.fleet_agg_snapshot_age.set(0.2, {"replica": "r1"})
     reg.fleet_agg_degraded.set(1)
+    # degraded-storage ladder: per-surface error/heal counters + gauge
+    reg.storage_errors.inc({"surface": "reports", "kind": "enospc"})
+    reg.storage_degraded.set(1, {"surface": "reports"})
+    reg.storage_heals.inc({"surface": "reports"})
 
     text = reg.exposition()
     # every new family is present (cardinality guard has its own test)
@@ -308,7 +312,9 @@ def test_exposition_format_is_scrapeable():
                 "kyverno_fleet_agg_admission_burn_rate",
                 "kyverno_fleet_agg_replicas_reporting",
                 "kyverno_fleet_agg_snapshot_age_seconds",
-                "kyverno_fleet_agg_degraded"):
+                "kyverno_fleet_agg_degraded",
+                "kyverno_storage_errors_total", "kyverno_storage_degraded",
+                "kyverno_storage_heals_total"):
         assert f"# TYPE {fam} " in text, fam
     # per-class SLO burn series render alongside the aggregate ones
     assert 'kyverno_slo_admission_burn_rate{class="bulk",window=' in text
