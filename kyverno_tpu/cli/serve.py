@@ -292,6 +292,22 @@ def add_parser(sub: argparse._SubParsersAction) -> None:
                         "over-approximating reduced tables (device hits "
                         "confirmed by the scalar oracle) beyond it "
                         "(default $KYVERNO_TPU_DFA_STATE_BUDGET or 192)")
+    p.add_argument("--dfa-stride", type=int, default=None, metavar="K",
+                   choices=(1, 2, 4),
+                   help="largest transition stride the DFA bank may "
+                        "compile: stride-K tables consume K bytes per "
+                        "scan step (table columns grow as classes**K; "
+                        "per-pattern stride is chosen under a "
+                        "table-growth budget). 1 disables multi-stride "
+                        "(default $KYVERNO_TPU_DFA_STRIDE or 4)")
+    p.add_argument("--dfa-approx-error", type=float, default=None,
+                   metavar="E",
+                   help="over-approximation error ceiling for "
+                        "budget-blowing patterns: reduced tables whose "
+                        "sampled acceptance delta vs the exact automaton "
+                        "exceeds E fall back to accept-all TOP-collapse; "
+                        "0 disables approximate reduction entirely "
+                        "(default $KYVERNO_TPU_DFA_APPROX_ERROR or 0.02)")
     p.set_defaults(func=run)
 
 
@@ -631,6 +647,13 @@ def run(args: argparse.Namespace) -> int:
         # reloads included) via tpu/dfa.py state_budget()
         os.environ["KYVERNO_TPU_DFA_STATE_BUDGET"] = \
             str(args.dfa_state_budget)
+    if args.dfa_stride is not None:
+        # bank-finalize knob (tpu/dfa.py max_stride())
+        os.environ["KYVERNO_TPU_DFA_STRIDE"] = str(args.dfa_stride)
+    if args.dfa_approx_error is not None:
+        # compile-time knob (tpu/dfa.py approx_error_ceiling())
+        os.environ["KYVERNO_TPU_DFA_APPROX_ERROR"] = \
+            str(args.dfa_approx_error)
     xla_dir = enable_xla_compile_cache(args.xla_cache_dir)
     if xla_dir:
         global_oplog.emit("xla_cache_enabled", dir=xla_dir)

@@ -305,7 +305,34 @@ class MetricsRegistry:
             "total DFA states across the active bank's tables")
         self.dfa_bytes = self.gauge(
             "kyverno_tpu_dfa_table_bytes",
-            "packed size of the active DFA bank's device arrays")
+            "packed size of the active DFA bank's device arrays "
+            "(stride-1 tables plus multi-stride tables)")
+        # multi-stride + approximate-reduction pattern engine
+        # (tpu/dfa.py): stride selection, reduction outcomes and the
+        # CONFIRM traffic the approximations cost
+        self.dfa_stride_tables = self.gauge(
+            "kyverno_dfa_stride_tables",
+            "active bank's pattern tables by chosen transition stride")
+        self.dfa_stride_bytes = self.gauge(
+            "kyverno_dfa_stride_table_bytes",
+            "packed size of the active bank's stride>1 transition tables")
+        self.dfa_approx_states_merged = self.gauge(
+            "kyverno_dfa_approx_states_merged",
+            "exact DFA states folded away by minimization / k-lookahead "
+            "reduction across the active bank")
+        self.dfa_approx_error_max = self.gauge(
+            "kyverno_dfa_approx_error_max",
+            "largest sampled over-approximation error among the active "
+            "bank's reduced patterns (0-1)")
+        self.dfa_top_collapse = self.counter(
+            "kyverno_dfa_top_collapse_total",
+            "patterns that fell back to accept-all TOP-collapse at "
+            "compile, by reason (error_ceiling / approx_disabled / "
+            "explore_overflow)")
+        self.dfa_confirm_cells = self.counter(
+            "kyverno_dfa_confirm_cells_total",
+            "device pattern cells escalated to scalar-oracle CONFIRM "
+            "(the price of over-approximated tables)")
         # pipelined scan (tpu/pipeline.py): how much host work hid
         # behind device time in the last pipelined scan (0 = strictly
         # serial, higher = more overlap), plus chunk accounting

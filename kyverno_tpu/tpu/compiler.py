@@ -146,15 +146,17 @@ def _program_pattern_specs(prog: RuleProgram) -> List[Tuple[str, str, str]]:
     return specs
 
 
-def _register_program_patterns(bank: DfaBank, prog: RuleProgram) -> bool:
+def _register_program_patterns(bank: DfaBank, prog: RuleProgram,
+                               owner: Optional[str] = None) -> bool:
     """Register a program's patterns; returns whether it has any
-    (pattern-cell accounting rides prog.uses_patterns)."""
+    (pattern-cell accounting rides prog.uses_patterns). ``owner``
+    attributes the patterns to a policy/rule for /debug/rules."""
     specs = _program_pattern_specs(prog)
     for kind, pattern, family in specs:
         if kind == "re2":
-            bank.add_re2(pattern, family)
+            bank.add_re2(pattern, family, owner=owner)
         else:
-            bank.add_glob(pattern, family)
+            bank.add_glob(pattern, family, owner=owner)
     return bool(specs)
 
 
@@ -285,6 +287,11 @@ class CompiledPolicySet:
             _reg.dfa_tables.set(stats["tables"])
             _reg.dfa_states.set(stats["states"])
             _reg.dfa_bytes.set(stats["bytes"])
+            for k, n in stats["stride_hist"].items():
+                _reg.dfa_stride_tables.set(n, {"stride": k})
+            _reg.dfa_stride_bytes.set(stats["stride_bytes"])
+            _reg.dfa_approx_states_merged.set(stats["states_merged"])
+            _reg.dfa_approx_error_max.set(stats["max_approx_error"])
         except Exception:  # noqa: BLE001
             pass  # metrics must never block the serving path
 
@@ -371,8 +378,8 @@ def _compile_policy_set(
                 # committing the program: a full bank demotes the rule
                 # to host instead of compiling an unevaluable program
                 try:
-                    prog.uses_patterns = _register_program_patterns(bank,
-                                                                    prog)
+                    prog.uses_patterns = _register_program_patterns(
+                        bank, prog, owner=f"{policy.name}/{rule.name}")
                 except DfaUnsupported as e:
                     raise Unsupported(f"pattern: {e}")
                 row = len(programs)
@@ -439,7 +446,8 @@ def _compile_policy_set(
                 # cache eligibility. Host-route instead.
                 raise Unsupported("context: dynamic operand slots")
             try:
-                prog.uses_patterns = _register_program_patterns(bank, prog)
+                prog.uses_patterns = _register_program_patterns(
+                    bank, prog, owner=f"{policy.name}/{rule.name}")
             except DfaUnsupported as e:
                 raise Unsupported(f"pattern: {e}")
             row = len(mutate_programs)

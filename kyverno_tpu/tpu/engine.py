@@ -1152,6 +1152,16 @@ class TpuEngine:
                     global_pattern_cells.record(entry.policy_name,
                                                 device=live - c - h,
                                                 confirm=c)
+                    if c:
+                        # the ongoing price of over-approximated /
+                        # byte-sensitive tables: cells the oracle had
+                        # to re-check (kyverno_dfa_confirm_cells_total)
+                        try:
+                            from ..observability.metrics import (
+                                global_registry as _reg)
+                            _reg.dfa_confirm_cells.inc(value=c)
+                        except Exception:  # noqa: BLE001
+                            pass
 
         from ..engine.match import matches_resource_description
 

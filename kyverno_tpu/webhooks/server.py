@@ -1562,6 +1562,15 @@ def handle_debug_path(path: str, handlers: Optional[Handlers] = None
             return 400, b'{"error": "top must be an integer"}\n', \
                 "application/json"
         doc = global_rule_stats.report(top=top)
+        # per-pattern compile status (exact / minimized / approximated
+        # / top_collapse, chosen stride, owning rules): which rules pay
+        # scalar CONFIRM trips and why — the un-silenced budget footgun
+        cps = _active_cps(handlers) if handlers is not None else None
+        if cps is not None and getattr(cps, "dfa", None) is not None:
+            try:
+                doc["patterns"] = cps.dfa.pattern_report()
+            except Exception:
+                pass
         return 200, (json.dumps(doc) + "\n").encode(), "application/json"
     if route == "/debug/analysis":
         # the last completed policy-set static analysis (analysis/):

@@ -19,6 +19,7 @@ from kyverno_tpu.tpu.dfa import (
     compile_glob,
     compile_re2,
     nonascii_mask,
+    prove_miss_definitive,
 )
 from kyverno_tpu.utils.wildcard import match as glob_oracle
 
@@ -237,3 +238,207 @@ def test_nonascii_mask():
     byt, lens = _pack_strings(["ascii", "café", "", "名前"])
     na = np.asarray(nonascii_mask(byt, lens))
     assert na.tolist() == [False, True, False, True]
+
+
+# ---------------------------------------------------------------------------
+# multi-stride tables: every compiled stride vs the host oracle
+
+
+def _stride_corpus(rng: random.Random, n: int = 60):
+    """Seeded subjects deliberately covering multi-byte UTF-8 runs that
+    straddle stride-group boundaries and lengths that are NOT a
+    multiple of any stride (1..31, coprime mixes)."""
+    fixed = [
+        "", "a", "ab", "abc", "abcd", "abcde", "nginx-1.25", "café",
+        "名前-x", "xcafé", "xxcafé", "xxxcafé", "aéb", "é", "éé",
+        "tmp-1", "registry.corp/img:v3", "a" * 31, "ab" * 13,
+        "名前" * 5, "x" * 7 + "é",
+    ]
+    pool = "abcx01-./é名"
+    out = list(fixed)
+    for _ in range(n):
+        out.append("".join(rng.choice(pool)
+                           for _ in range(rng.randint(0, 14))))
+    return out
+
+
+def test_stride_sweep_fuzz_parity():
+    """The referee: the SAME bank compiled at every stride cap must
+    produce bit-identical accepts to the stride-1 host table walk —
+    including tail lengths with len mod k != 0 and multi-byte UTF-8
+    crossing group boundaries."""
+    rng = random.Random(4242)
+    subjects = _stride_corpus(rng)
+    byt, lens = _pack_strings(subjects)
+    for cap in (1, 2, 4):
+        bank = DfaBank(budget=64)
+        for p in GLOB_CASES:
+            bank.add_glob(p, "pool")
+        for p in RE2_CASES:
+            bank.add_re2(p, "pool")
+        bank.finalize(stride=cap)
+        if cap > 1:
+            # the table-growth budget must let SOME patterns go wide
+            assert any(int(s) > 1 for s in bank.strides[:len(bank)])
+        else:
+            assert all(int(s) == 1 for s in bank.strides[:len(bank)])
+        ids = bank.families["pool"]
+        acc = np.asarray(bank_match(bank, ids, byt, lens))
+        for k, pid in enumerate(ids):
+            d = bank.patterns[pid]
+            for i, s in enumerate(subjects):
+                assert bool(acc[i, k]) == d.match_bytes(
+                    s.encode()[:32]), (cap, d.pattern, s)
+
+
+def test_host_strided_walk_is_stride_exact():
+    """Stride composition is exact: T_2k = T_k o T_k accepts the same
+    language at every stride for every length (incl. len mod k != 0)."""
+    rng = random.Random(99)
+    subjects = _stride_corpus(rng, n=40)
+    for pat in GLOB_CASES:
+        d = compile_glob(pat, budget=64)
+        for s in subjects:
+            b = s.encode()[:32]
+            want = d.match_bytes(b)
+            for k in (2, 4):
+                assert d.match_bytes_strided(b, k) == want, (pat, s, k)
+
+
+# ---------------------------------------------------------------------------
+# approximate reduction: measured-error quotients, proven containment
+
+
+REDUCE_PATTERNS = [
+    ("re2", "^(ab|cd){1,10}x[0-9]{3}$"),
+    ("re2", "^v[0-9]{1,4}\\.[0-9]{1,4}\\.[0-9]{1,4}$"),
+    ("re2", "^(alpha|beta|gamma|delta)-(one|two|three)$"),
+    ("glob", "*-suffix-*-mid-*-tail"),
+    ("glob", "prefix-????-*-????-end"),
+]
+
+
+def _compile(kind, pat, **kw):
+    return compile_glob(pat, **kw) if kind == "glob" else \
+        compile_re2(pat, **kw)
+
+
+def test_approximated_automata_fuzz_vs_oracle():
+    """Every reduced automaton (minimized, k-lookahead, TOP-collapsed)
+    obeys the ladder: exact ones agree with the host oracle everywhere,
+    approximate ones may only ever OVER-accept — a miss is definitive."""
+    rng = random.Random(2024)
+    for kind, pat in REDUCE_PATTERNS:
+        exact = _compile(kind, pat, budget=4096, ceiling=0.0)
+        assert exact.exact
+        for budget, ceiling in ((8, 0.05), (16, 0.05), (24, 0.02),
+                                (8, 0.0)):
+            red = _compile(kind, pat, budget=budget, ceiling=ceiling)
+            for _ in range(150):
+                s = "".join(rng.choice("abcdx0123-.eglmnoprt")
+                            for _ in range(rng.randint(0, 24)))
+                want = exact.match_str(s)
+                got = red.match_str(s)
+                if red.exact:
+                    assert got == want, (pat, budget, s)
+                elif not got:
+                    assert not want, (pat, budget, s)  # miss definitive
+
+
+def test_approximated_automata_device_parity_all_strides():
+    """Approximated tables ride the same multi-stride packing: the
+    device kernel must agree with each reduced automaton's own host
+    walk at every stride cap."""
+    rng = random.Random(31337)
+    subjects = _stride_corpus(rng, n=40)
+    byt, lens = _pack_strings(subjects)
+    for cap in (1, 2, 4):
+        bank = DfaBank(budget=12, ceiling=0.05)
+        for kind, pat in REDUCE_PATTERNS:
+            if kind == "glob":
+                bank.add_glob(pat, "pool")
+            else:
+                bank.add_re2(pat, "pool")
+        bank.finalize(stride=cap)
+        assert bank.stats()["approx"] >= 1  # reduction actually engaged
+        ids = bank.families["pool"]
+        acc = np.asarray(bank_match(bank, ids, byt, lens))
+        for k, pid in enumerate(ids):
+            d = bank.patterns[pid]
+            for i, s in enumerate(subjects):
+                assert bool(acc[i, k]) == d.match_bytes(
+                    s.encode()[:32]), (cap, d.pattern, s)
+
+
+def test_miss_definitive_proven_property_style():
+    """The PR's core invariant, PROVEN (product-state BFS over every
+    reachable pair), not sampled: L(exact) ⊆ L(approx) for every
+    reduction outcome, so a device miss implies an oracle miss."""
+    for kind, pat in REDUCE_PATTERNS:
+        exact = _compile(kind, pat, budget=4096, ceiling=0.0)
+        for budget, ceiling in ((8, 0.05), (16, 0.02), (8, 0.0),
+                                (24, 0.1)):
+            red = _compile(kind, pat, budget=budget, ceiling=ceiling)
+            assert prove_miss_definitive(exact, red), \
+                (pat, budget, ceiling, red.approx_method)
+            if red.exact:
+                # minimized tables are language-EQUAL: containment
+                # must hold in both directions
+                assert prove_miss_definitive(red, exact), (pat, budget)
+
+
+def test_minimization_recovers_exactness_over_budget():
+    """A pattern whose subset construction overshoots the budget but
+    whose MINIMAL automaton fits stays exact — no CONFIRM trips at all
+    (this is where the confirm-rate win comes from)."""
+    pat = "*-suffix-*-mid-*"
+    full = compile_glob(pat, budget=4096, ceiling=0.0)
+    assert full.n_states > 14
+    mini = compile_glob(pat, budget=14, ceiling=0.02)
+    assert mini.exact and mini.approx_method == "minimized"
+    assert mini.n_states <= 14 and mini.states_merged > 0
+    rng = random.Random(7)
+    for _ in range(300):
+        s = "".join(rng.choice("-abcdefimstux")
+                    for _ in range(rng.randint(0, 28)))
+        assert mini.match_str(s) == glob_oracle(pat, s), s
+
+
+def test_top_collapse_counted_and_reported():
+    """The silent-footgun fix: a ceiling of 0 disables reduction, the
+    pattern TOP-collapses, and the compile emits
+    kyverno_dfa_top_collapse_total{reason=...} plus a pattern_report
+    row operators can see in /debug/rules."""
+    from kyverno_tpu.observability.metrics import global_registry
+
+    before = global_registry.dfa_top_collapse.value(
+        {"reason": "approx_disabled"})
+    bank = DfaBank(budget=6, ceiling=0.0)
+    bank.add_re2("^(ab|cd){1,10}x[0-9]{3}zq$", "pool", owner="pol/rule-x")
+    bank.finalize()
+    after = global_registry.dfa_top_collapse.value(
+        {"reason": "approx_disabled"})
+    assert after == before + 1
+    assert bank.stats()["top_collapsed"] == 1
+    rep = bank.pattern_report()
+    assert rep[0]["status"] == "top_collapse"
+    assert rep[0]["confirm_on_hit"] is True
+    assert rep[0]["rules"] == ["pol/rule-x"]
+    assert rep[0]["stride"] >= 1
+
+
+def test_stride_selection_respects_table_growth_budget():
+    """Stride choice is a budget decision: a tiny entry cap forces
+    stride 1, a roomy one lets narrow-alphabet patterns go to 4."""
+    bank = DfaBank(budget=64)
+    bank.add_glob("nginx-*", "pool")
+    bank.finalize(stride=4, stride_entries=4)
+    assert int(bank.strides[0]) == 1
+    bank2 = DfaBank(budget=64)
+    bank2.add_glob("nginx-*", "pool")
+    bank2.finalize(stride=4)
+    assert int(bank2.strides[0]) == 4
+    st = bank2.stats()
+    assert st["stride_hist"].get("4") == 1 and st["stride_bytes"] > 0
+    # the chosen stride is cache-key material
+    assert bank.digest() != bank2.digest()

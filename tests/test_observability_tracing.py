@@ -270,6 +270,14 @@ def test_exposition_format_is_scrapeable():
     reg.storage_errors.inc({"surface": "reports", "kind": "enospc"})
     reg.storage_degraded.set(1, {"surface": "reports"})
     reg.storage_heals.inc({"surface": "reports"})
+    # multi-stride + approximate-reduction pattern engine (tpu/dfa.py)
+    reg.dfa_stride_tables.set(5, {"stride": "4"})
+    reg.dfa_stride_tables.set(2, {"stride": "1"})
+    reg.dfa_stride_bytes.set(4096)
+    reg.dfa_approx_states_merged.set(11)
+    reg.dfa_approx_error_max.set(0.004)
+    reg.dfa_top_collapse.inc({"reason": "error_ceiling"})
+    reg.dfa_confirm_cells.inc(value=3)
 
     text = reg.exposition()
     # every new family is present (cardinality guard has its own test)
@@ -314,7 +322,13 @@ def test_exposition_format_is_scrapeable():
                 "kyverno_fleet_agg_snapshot_age_seconds",
                 "kyverno_fleet_agg_degraded",
                 "kyverno_storage_errors_total", "kyverno_storage_degraded",
-                "kyverno_storage_heals_total"):
+                "kyverno_storage_heals_total",
+                "kyverno_dfa_stride_tables",
+                "kyverno_dfa_stride_table_bytes",
+                "kyverno_dfa_approx_states_merged",
+                "kyverno_dfa_approx_error_max",
+                "kyverno_dfa_top_collapse_total",
+                "kyverno_dfa_confirm_cells_total"):
         assert f"# TYPE {fam} " in text, fam
     # per-class SLO burn series render alongside the aggregate ones
     assert 'kyverno_slo_admission_burn_rate{class="bulk",window=' in text
